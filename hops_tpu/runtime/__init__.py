@@ -1,0 +1,9 @@
+"""Runtime core: topology, config, logging, run directories, filesystem.
+
+This layer replaces the reference's L2 utility layer (``hops`` modules,
+SURVEY.md §1 L2, §2.2) — environment discovery, security material,
+filesystem and project scoping — re-imagined for a TPU slice instead of
+a Spark/YARN cluster.
+"""
+
+from hops_tpu.runtime import config, devices, fs, logging, rundir  # noqa: F401

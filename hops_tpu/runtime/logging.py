@@ -1,0 +1,106 @@
+"""Structured logging for the framework.
+
+The reference scattered logs across Spark executor stdout, per-run
+``output.log`` files, and log4j (SURVEY.md §5 "Metrics / logging").
+Here: one stdlib-logging-based layer that (a) prefixes records with the
+process/host index — the moral equivalent of the per-executor prefixes
+Spark gave the reference — and (b) can tee into a per-run ``output.log``
+inside the active run directory.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import time
+from pathlib import Path
+from typing import Any
+
+_FORMAT = "%(asctime)s [%(hosttag)s] %(levelname)s %(name)s: %(message)s"
+
+
+class _HostTagFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not hasattr(record, "hosttag"):
+            try:
+                import jax
+
+                record.hosttag = f"h{jax.process_index()}"
+            except Exception:
+                record.hosttag = "h?"
+        return True
+
+
+_configured = False
+
+
+def get_logger(name: str = "hops_tpu") -> logging.Logger:
+    global _configured
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        handler.addFilter(_HostTagFilter())
+        root = logging.getLogger("hops_tpu")
+        root.addHandler(handler)
+        from hops_tpu.runtime import config
+
+        root.setLevel(config.runtime().log_level)
+        root.propagate = False
+        _configured = True
+    return logging.getLogger(name)
+
+
+def attach_run_log(path: str | Path) -> logging.Handler:
+    """Tee framework logs into a per-run ``output.log`` (the reference
+    returned such a path from every launcher — SURVEY.md §2.3)."""
+    handler = logging.FileHandler(path)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    handler.addFilter(_HostTagFilter())
+    logging.getLogger("hops_tpu").addHandler(handler)
+    return handler
+
+
+def detach_run_log(handler: logging.Handler) -> None:
+    logging.getLogger("hops_tpu").removeHandler(handler)
+    handler.close()
+
+
+class MetricLogger:
+    """Append-only JSONL metric stream for a run (TensorBoard-lite).
+
+    Events: ``{"step": int, "tag": str, "value": float, "time": float}``.
+    The experiments UI / tooling reads these; ``hops_tpu.experiment.
+    tensorboard`` wraps it behind a SummaryWriter-style API.
+    """
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._f = self.path.open("a")
+
+    def log(self, step: int, tag: str, value: Any) -> None:
+        self._f.write(
+            json.dumps(
+                {"step": int(step), "tag": tag, "value": _jsonable(value), "time": time.time()}
+            )
+            + "\n"
+        )
+        self._f.flush()
+
+    def close(self) -> None:
+        self._f.close()
+
+
+def _jsonable(v: Any) -> Any:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def read_metrics(path: str | Path) -> list[dict[str, Any]]:
+    p = Path(path)
+    if not p.exists():
+        return []
+    return [json.loads(line) for line in p.read_text().splitlines() if line.strip()]

@@ -1,0 +1,37 @@
+"""Test fixtures: fake 8-chip mesh on CPU + isolated workspace.
+
+Per SURVEY.md §4 the reference had no test suite; multi-worker paths
+were only exercised on a live YARN cluster. We close that gap with the
+fake-mesh fixture: 8 virtual CPU devices emulate an 8-chip slice
+in-process, so every distributed code path (pjit shardings, collectives,
+multi-chip launchers) runs in CI without TPU hardware.
+
+Env vars must be set before JAX initializes a backend, hence module
+scope here.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+import jax  # noqa: E402
+
+# The env var alone is not enough when a sitecustomize has already
+# imported jax (its config snapshots JAX_PLATFORMS at import time).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def workspace(tmp_path, monkeypatch):
+    """Point the framework workspace at a per-test temp dir."""
+    monkeypatch.setenv("HOPS_TPU_WORKSPACE", str(tmp_path / "workspace"))
+    from hops_tpu.runtime import config
+
+    config.configure(workspace=str(tmp_path / "workspace"), project="testproj")
+    yield tmp_path / "workspace"
