@@ -1,0 +1,39 @@
+"""Experiment layer — launchers, hparam drivers, registry, tensorboard.
+
+Reference surface (SURVEY.md §2.3): ``experiment.launch / .mirrored /
+.grid_search / .differential_evolution`` plus ``tensorboard.logdir()``.
+The maggy-style async driver lives in ``hops_tpu.search`` and is
+re-exported as ``experiment.lagom``.
+"""
+
+from hops_tpu.experiment import registry, tensorboard  # noqa: F401
+from hops_tpu.experiment.core import (  # noqa: F401
+    collective_all_reduce,
+    launch,
+    mirrored,
+    parameter_server,
+)
+
+
+def grid_search(*args, **kwargs):
+    """Exhaustive cartesian hparam sweep (reference:
+    ``experiment.grid_search``, grid_search_fashion_mnist.ipynb:311)."""
+    from hops_tpu.search.drivers import grid_search as _gs
+
+    return _gs(*args, **kwargs)
+
+
+def differential_evolution(*args, **kwargs):
+    """Genetic search over bounded ranges (reference:
+    ``experiment.differential_evolution``, evolutionary_search_mnist.ipynb:267)."""
+    from hops_tpu.search.drivers import differential_evolution as _de
+
+    return _de(*args, **kwargs)
+
+
+def lagom(*args, **kwargs):
+    """Async parallel-trial driver (reference: ``maggy.experiment.lagom``,
+    SURVEY.md §2.4)."""
+    from hops_tpu.search.drivers import lagom as _lagom
+
+    return _lagom(*args, **kwargs)

@@ -1,0 +1,180 @@
+"""Experiment launchers: ``launch`` / ``mirrored`` / ``collective_all_reduce``.
+
+The reference's core UX (SURVEY.md §2.3): the user hands the launcher a
+**wrapper function containing the whole training program**; the launcher
+provisions the run (directory, logging, distribution context), executes
+it, collects the returned metrics dict, syncs the logdir into the
+project's Experiments dataset, registers the run, and returns
+``(experiment_dir, metrics_dict)`` where the dict carries a ``'log'``
+path — e.g. ``('…/Experiments/application_…_3', {'accuracy': 0.83,
+'log': '…/output.log'})``.
+
+On Spark the launcher scheduled the wrapper onto executors; here the
+wrapper runs SPMD on the slice: ``launch`` gives it the default device,
+``mirrored`` a single-host data-parallel mesh, ``collective_all_reduce``
+the full-slice mesh (every host executes the same wrapper; host 0 is
+chief). ``parameter_server`` exists as a documented alias (SURVEY.md
+§2.9 row 3).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import sys
+import time
+import traceback
+from pathlib import Path
+from typing import Any, Callable
+
+from hops_tpu.experiment import registry
+from hops_tpu.parallel import multihost
+from hops_tpu.parallel.strategy import (
+    CollectiveAllReduceStrategy,
+    MirroredStrategy,
+    Strategy,
+)
+from hops_tpu.runtime import rundir
+from hops_tpu.runtime.logging import attach_run_log, detach_run_log, get_logger, scalarize
+
+log = get_logger(__name__)
+
+
+class _Tee(io.TextIOBase):
+    def __init__(self, *streams):
+        self.streams = streams
+
+    def write(self, s):
+        for st in self.streams:
+            st.write(s)
+        return len(s)
+
+    def flush(self):
+        for st in self.streams:
+            st.flush()
+
+
+def _normalize_metrics(result: Any, metric_key: str | None) -> dict[str, Any]:
+    if result is None:
+        metrics: dict[str, Any] = {"metric": None}
+    elif isinstance(result, dict):
+        metrics = dict(result)
+        if metric_key is not None:
+            metrics["metric"] = metrics.get(metric_key)
+        elif "metric" not in metrics and len(metrics) == 1:
+            metrics["metric"] = next(iter(metrics.values()))
+    else:
+        metrics = {"metric": result}
+    return metrics
+
+
+def _run_wrapper(
+    fn: Callable[..., Any],
+    kwargs: dict[str, Any] | None,
+    name: str,
+    kind: str,
+    local_logdir: bool,
+    metric_key: str | None,
+    strategy: Strategy | None,
+) -> tuple[str, dict[str, Any]]:
+    """Shared launcher mechanics for all experiment kinds."""
+    run = rundir.new_run(name=name, local_logdir=local_logdir)
+    chief = multihost.is_chief()
+    if chief:
+        registry.register(
+            {"run_id": run.run_id, "name": name, "kind": kind, "status": "RUNNING"}
+        )
+    start = time.time()
+    out_path = Path(run.logdir) / "output.log"
+    handler = attach_run_log(out_path)
+    status, metrics, err = "FINISHED", {}, None
+    with rundir.activate(run):
+        tee_out = _Tee(sys.stdout, out_path.open("a"))
+        try:
+            with contextlib.redirect_stdout(tee_out):
+                ctx = strategy.scope() if strategy is not None else contextlib.nullcontext()
+                with ctx:
+                    result = fn(**kwargs) if kwargs else fn()
+            metrics = _normalize_metrics(result, metric_key)
+        except Exception as e:  # noqa: BLE001 — failures must land in the registry
+            status, err = "FAILED", e
+            tee_out.write(traceback.format_exc())
+        finally:
+            tee_out.flush()
+            detach_run_log(handler)
+    final_path = run.finalize()
+    if chief:
+        registry.register(
+            {
+                "run_id": run.run_id,
+                "name": name,
+                "kind": kind,
+                "status": status,
+                "metrics": {k: scalarize(v) for k, v in metrics.items()},
+                "metric_key": metric_key,
+                "duration_s": time.time() - start,
+                "path": final_path,
+                "num_replicas": strategy.num_replicas_in_sync if strategy else 1,
+            }
+        )
+    if err is not None:
+        raise err
+    metrics["log"] = str(Path(final_path) / "output.log")
+    return final_path, metrics
+
+
+def launch(
+    fn: Callable[..., Any],
+    args: dict[str, Any] | None = None,
+    name: str = "no-name",
+    local_logdir: bool = False,
+    metric_key: str | None = None,
+) -> tuple[str, dict[str, Any]]:
+    """Single experiment (reference: ``experiment.launch``,
+    notebooks/ml/Experiment/Tensorflow/mnist.ipynb:228)."""
+    return _run_wrapper(fn, args, name, "launch", local_logdir, metric_key, None)
+
+
+def mirrored(
+    fn: Callable[..., Any],
+    args: dict[str, Any] | None = None,
+    name: str = "no-name",
+    local_logdir: bool = False,
+    metric_key: str | None = None,
+) -> tuple[str, dict[str, Any]]:
+    """Single-host data-parallel training over this host's chips
+    (reference: ``experiment.mirrored`` + ``MirroredStrategy``,
+    mirroredstrategy_mnist_example.ipynb:231). The wrapper sees the
+    strategy via ``parallel.get_strategy()`` or by constructing
+    ``MirroredStrategy()`` itself."""
+    return _run_wrapper(fn, args, name, "mirrored", local_logdir, metric_key, MirroredStrategy())
+
+
+def collective_all_reduce(
+    fn: Callable[..., Any],
+    args: dict[str, Any] | None = None,
+    name: str = "no-name",
+    local_logdir: bool = False,
+    metric_key: str | None = None,
+) -> tuple[str, dict[str, Any]]:
+    """Whole-slice data-parallel training; gradient AllReduce over
+    ICI/DCN (reference: multi-worker ``experiment.mirrored`` with
+    ``MultiWorkerMirroredStrategy``+NCCL, and the
+    ``collective_all_reduce`` mode named in BASELINE.json)."""
+    return _run_wrapper(
+        fn, args, name, "collective_all_reduce", local_logdir, metric_key,
+        CollectiveAllReduceStrategy(),
+    )
+
+
+def parameter_server(
+    fn: Callable[..., Any],
+    args: dict[str, Any] | None = None,
+    name: str = "no-name",
+    local_logdir: bool = False,
+    metric_key: str | None = None,
+) -> tuple[str, dict[str, Any]]:
+    """Alias of :func:`collective_all_reduce` — parameter servers have no
+    TPU-native analog (SURVEY.md §2.9 row 3); the docs-only reference
+    mode lowers to the same XLA collective path."""
+    return collective_all_reduce(fn, args, name, local_logdir, metric_key)

@@ -1,0 +1,53 @@
+"""TensorBoard-contract module: per-run logdir + scalar/profiler APIs.
+
+Matches the surface of the reference's ``hops.tensorboard``
+(``tensorboard.logdir()`` — notebooks/ml/Experiment/Tensorflow/
+mnist.ipynb:55-61, SURVEY.md §2.3): user code asks for the current
+run's directory and writes logs/checkpoints/events there. Scalars go to
+a JSONL event stream readable by the registry tooling; profiler traces
+use ``jax.profiler`` into the same dir (viewable in TensorBoard/XProf —
+the reference's `profile_batch` equivalent, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from pathlib import Path
+from typing import Iterator
+
+import jax
+
+from hops_tpu.runtime import rundir
+from hops_tpu.runtime.logging import MetricLogger
+
+_writers: dict[str, MetricLogger] = {}
+
+
+def logdir() -> str:
+    """The active run's log/checkpoint/working directory."""
+    return rundir.logdir()
+
+
+def _writer() -> MetricLogger:
+    ld = logdir()
+    if ld not in _writers:
+        _writers[ld] = MetricLogger(Path(ld) / "metrics.jsonl")
+    return _writers[ld]
+
+
+def scalar(step: int, tag: str, value) -> None:
+    """Log a scalar event into the run's metric stream."""
+    _writer().log(step, tag, value)
+
+
+def flush() -> None:
+    for w in _writers.values():
+        w._f.flush()
+
+
+@contextlib.contextmanager
+def profile(tag: str = "trace") -> Iterator[None]:
+    """Capture a jax.profiler trace window into the run dir (the
+    reference's Keras ``profile_batch='5,10'`` — SURVEY.md §5)."""
+    with jax.profiler.trace(str(Path(logdir()) / tag)):
+        yield

@@ -1,0 +1,10 @@
+"""Model zoo: TPU-first re-implementations of the reference's model set.
+
+The reference's models live inside notebooks (MNIST CNN/FFN in
+notebooks/ml/Experiment/*, ResNet-50 in notebooks/ml/Benchmarks/
+benchmark.ipynb, wide-and-deep named by the TFX Chicago-Taxi config —
+SURVEY.md §6). Here they are proper flax modules with bfloat16 compute
+on the MXU and shared train-step factories.
+"""
+
+from hops_tpu.models import common, mnist, resnet, widedeep  # noqa: F401

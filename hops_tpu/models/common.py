@@ -1,0 +1,114 @@
+"""Shared training-state plumbing and step factories.
+
+One canonical ``train_step``/``eval_step`` shape used by every launcher:
+``step(state, batch) -> (state, metrics)`` with batch sharded on the
+``data`` mesh axis and params replicated — under jit, XLA emits the
+gradient AllReduce (the NCCL replacement, SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+from flax.training import train_state
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState + dropout RNG folded per step."""
+
+    rng: jax.Array = None
+
+
+def create_train_state(
+    model: nn.Module,
+    rng: jax.Array,
+    input_shape: tuple[int, ...],
+    optimizer: optax.GradientTransformation | None = None,
+    learning_rate: float = 1e-3,
+    input_dtype: Any = jnp.float32,
+) -> TrainState:
+    params_rng, dropout_rng = jax.random.split(rng)
+    dummy = jnp.zeros(input_shape, input_dtype)
+    variables = model.init({"params": params_rng, "dropout": dropout_rng}, dummy, train=False)
+    tx = optimizer if optimizer is not None else optax.adam(learning_rate)
+    return TrainState.create(
+        apply_fn=model.apply, params=variables["params"], tx=tx, rng=dropout_rng
+    )
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return (jnp.argmax(logits, -1) == labels).mean()
+
+
+def make_train_step(
+    loss_fn: Callable[..., Any] | None = None,
+) -> Callable[[TrainState, dict[str, jax.Array]], tuple[TrainState, dict[str, jax.Array]]]:
+    """Classification train step: grads + update + loss/accuracy metrics.
+
+    Works for any model whose apply is ``apply({'params': p}, x, train=)``.
+    """
+
+    def train_step(state: TrainState, batch: dict[str, jax.Array]):
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def compute_loss(params):
+            logits = state.apply_fn(
+                {"params": params}, batch["image"], train=True, rngs={"dropout": step_rng}
+            )
+            if loss_fn is not None:
+                return loss_fn(logits, batch["label"]), logits
+            return cross_entropy_loss(logits, batch["label"]), logits
+
+        (loss, logits), grads = jax.value_and_grad(compute_loss, has_aux=True)(state.params)
+        # Replicated-params + sharded-batch shardings make XLA reduce
+        # `grads` across the data axis here (AllReduce over ICI).
+        new_state = state.apply_gradients(grads=grads)
+        return new_state, {"loss": loss, "accuracy": accuracy(logits, batch["label"])}
+
+    return train_step
+
+
+def make_eval_step() -> Callable[..., dict[str, jax.Array]]:
+    def eval_step(state: TrainState, batch: dict[str, jax.Array]):
+        logits = state.apply_fn({"params": state.params}, batch["image"], train=False)
+        return {
+            "loss": cross_entropy_loss(logits, batch["label"]),
+            "accuracy": accuracy(logits, batch["label"]),
+        }
+
+    return eval_step
+
+
+@dataclasses.dataclass
+class SyntheticClassData:
+    """Learnable synthetic classification data — the reference's
+    "simulated data twin" idea (SURVEY.md §4.2): class-prototype images
+    plus noise, so models actually reach high accuracy and golden-metric
+    tests are meaningful without downloading datasets."""
+
+    num_classes: int = 10
+    shape: tuple[int, ...] = (28, 28, 1)
+    noise: float = 0.35
+    seed: int = 0
+
+    def batches(self, batch_size: int, num_batches: int):
+        rng = jax.random.PRNGKey(self.seed)
+        proto_rng, _ = jax.random.split(rng)
+        protos = jax.random.normal(proto_rng, (self.num_classes, *self.shape))
+        for i in range(num_batches):
+            step_rng = jax.random.fold_in(rng, i + 1)
+            lab_rng, noise_rng = jax.random.split(step_rng)
+            labels = jax.random.randint(lab_rng, (batch_size,), 0, self.num_classes)
+            images = protos[labels] + self.noise * jax.random.normal(
+                noise_rng, (batch_size, *self.shape)
+            )
+            yield {"image": images, "label": labels}

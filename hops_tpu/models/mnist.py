@@ -1,0 +1,52 @@
+"""MNIST models — the reference's workhorse examples.
+
+Shapes follow the notebooks (conv-conv-dense CNN in
+notebooks/ml/Experiment/Tensorflow/mnist.ipynb cell 2; small FFN in
+notebooks/ml/End_To_End_Pipeline/tensorflow/model_repo_and_serving.ipynb)
+but are fresh flax implementations with bfloat16 MXU compute.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class CNN(nn.Module):
+    """Conv(32)-pool-Conv(64)-pool-Dense(128)-dropout-Dense(10)."""
+
+    num_classes: int = 10
+    dropout_rate: float = 0.5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (3, 3), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class FFN(nn.Module):
+    """Flatten-Dense(128)-Dense(10), the end-to-end-pipeline model."""
+
+    num_classes: int = 10
+    hidden: int = 128
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype).reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,77 @@
+"""ResNet for the benchmark harness.
+
+The reference benchmarked ResNet-50 on synthetic 224x224x3 batches
+(notebooks/ml/Benchmarks/benchmark.ipynb cell 2, SURVEY.md §6). This is
+a fresh flax ResNet-v1.5 (stride-2 in the 3x3 of bottlenecks, as the
+benchmark model family) with bfloat16 compute so conv FLOPs land on the
+MXU, float32 batch-norm statistics for stability.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+Conv = partial(nn.Conv, use_bias=False)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: tuple[int, int] = (1, 1)
+    dtype: jnp.dtype = jnp.bfloat16
+    norm: Callable[..., Any] = nn.BatchNorm
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(
+            self.norm, use_running_average=not train, momentum=0.9, dtype=jnp.float32
+        )
+        residual = x
+        y = Conv(self.filters, (1, 1), dtype=self.dtype)(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = Conv(self.filters, (3, 3), self.strides, dtype=self.dtype)(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = Conv(self.filters * 4, (1, 1), dtype=self.dtype)(y)
+        y = norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = Conv(
+                self.filters * 4, (1, 1), self.strides, dtype=self.dtype, name="proj"
+            )(residual)
+            residual = norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = Conv(self.width, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9, dtype=jnp.float32)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(self.width * 2**i, strides, self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet50(num_classes: int = 1000, dtype: jnp.dtype = jnp.bfloat16) -> ResNet:
+    return ResNet([3, 4, 6, 3], num_classes=num_classes, dtype=dtype)
+
+
+def ResNet18ish(num_classes: int = 10, dtype: jnp.dtype = jnp.bfloat16) -> ResNet:
+    """Small bottleneck variant for CI-scale tests."""
+    return ResNet([1, 1, 1, 1], num_classes=num_classes, width=16, dtype=dtype)

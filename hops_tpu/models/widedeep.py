@@ -1,0 +1,70 @@
+"""Wide-and-deep tabular model (Chicago-Taxi Trainer equivalent).
+
+BASELINE.json's fifth config is the TFX Chicago-Taxi wide-and-deep
+Trainer (the notebooks are absent from the reference snapshot —
+BASELINE.md, SURVEY.md §6 — only the capability is required). Fresh
+flax implementation: wide = linear over one-hot/hashed categoricals,
+deep = MLP over embeddings + dense features; logits summed.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class WideAndDeep(nn.Module):
+    """Inputs: ``{"dense": [B, num_dense] float, "categorical":
+    [B, num_cat] int32 (already hashed/bucketized)}``."""
+
+    vocab_sizes: Sequence[int]
+    embed_dim: int = 8
+    hidden: Sequence[int] = (128, 64)
+    num_classes: int = 2
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        dense = batch["dense"].astype(self.dtype)
+        cats = batch["categorical"]
+
+        # Wide path: per-feature one-hot linear logits.
+        wide_logits = 0.0
+        for i, vocab in enumerate(self.vocab_sizes):
+            onehot = jax.nn.one_hot(cats[:, i], vocab, dtype=self.dtype)
+            wide_logits = wide_logits + nn.Dense(
+                self.num_classes, use_bias=False, dtype=self.dtype, name=f"wide_{i}"
+            )(onehot)
+
+        # Deep path: embeddings + dense features through an MLP.
+        embs = [
+            nn.Embed(vocab, self.embed_dim, dtype=self.dtype, name=f"embed_{i}")(cats[:, i])
+            for i, vocab in enumerate(self.vocab_sizes)
+        ]
+        x = jnp.concatenate(embs + [dense], axis=-1)
+        for j, width in enumerate(self.hidden):
+            x = nn.Dense(width, dtype=self.dtype, name=f"deep_{j}")(x)
+            x = nn.relu(x)
+        deep_logits = nn.Dense(self.num_classes, dtype=self.dtype, name="deep_out")(x)
+
+        return (wide_logits + deep_logits).astype(jnp.float32)
+
+
+def make_taxi_batch(rng: jax.Array, batch_size: int, vocab_sizes: Sequence[int], num_dense: int = 5):
+    """Synthetic Chicago-Taxi-shaped batch (tips classification twin)."""
+    d_rng, c_rng, l_rng = jax.random.split(rng, 3)
+    cats = jnp.stack(
+        [
+            jax.random.randint(jax.random.fold_in(c_rng, i), (batch_size,), 0, v)
+            for i, v in enumerate(vocab_sizes)
+        ],
+        axis=1,
+    )
+    dense = jax.random.normal(d_rng, (batch_size, num_dense))
+    # Learnable rule: label correlates with first dense feature + first cat parity.
+    label = ((dense[:, 0] + (cats[:, 0] % 2) * 0.5) > 0.25).astype(jnp.int32)
+    del l_rng
+    return {"dense": dense, "categorical": cats, "label": label}

@@ -1,0 +1,18 @@
+"""Distribution layer: meshes, shardings, strategies, collectives.
+
+Replaces the reference's distribution substrate (tf.distribute strategies
+over Spark executors with NCCL allreduce — SURVEY.md §2.9) with SPMD over
+``jax.sharding.Mesh``: shardings are annotated, XLA inserts the
+collectives (AllReduce/AllGather/ReduceScatter) over ICI within a slice
+and DCN across slices.
+"""
+
+from hops_tpu.parallel import mesh, multihost, strategy  # noqa: F401
+from hops_tpu.parallel.strategy import (  # noqa: F401
+    CollectiveAllReduceStrategy,
+    MirroredStrategy,
+    ParameterServerStrategy,
+    Strategy,
+    current_strategy,
+    get_strategy,
+)

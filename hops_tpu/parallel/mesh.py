@@ -1,0 +1,100 @@
+"""Mesh construction and sharding helpers.
+
+The mesh is the TPU-native unit of distribution: what the reference
+modeled as "Spark executors each holding GPUs" (SURVEY.md §1 L1) becomes
+axes of a ``jax.sharding.Mesh`` laid out over the slice's ICI fabric.
+Axis conventions used across the framework:
+
+- ``data``    — batch (data-parallel) axis
+- ``fsdp``    — parameter-sharding axis (ZeRO-style, optional)
+- ``model``   — tensor-parallel axis
+- ``seq``     — sequence/context-parallel axis (ring attention)
+
+Meshes are built host-major so that the innermost axes map onto
+intra-host ICI links and collectives ride ICI, not DCN.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hops_tpu.runtime import devices as rt_devices
+
+
+def make_mesh(
+    shape: Sequence[int] | Mapping[str, int] | None = None,
+    axis_names: Sequence[str] = ("data",),
+    devices: Sequence[Any] | None = None,
+) -> Mesh:
+    """Build a mesh over ``devices`` (default: all chips).
+
+    ``shape`` may be a dict ``{"data": 4, "model": 2}``, a tuple matching
+    ``axis_names``, or ``None`` (all devices on the first axis). ``-1``
+    in one position means "whatever is left".
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    # Host-major ordering keeps intra-host neighbors adjacent on the
+    # innermost mesh axis.
+    devs = sorted(devs, key=lambda d: (d.process_index, d.id))
+    if isinstance(shape, Mapping):
+        axis_names = tuple(shape.keys())
+        shape = tuple(shape.values())
+    if shape is None:
+        shape = (len(devs),) + (1,) * (len(axis_names) - 1)
+    shape = list(shape)
+    if -1 in shape:
+        known = math.prod(s for s in shape if s != -1)
+        shape[shape.index(-1)] = len(devs) // known
+    if math.prod(shape) != len(devs):
+        raise ValueError(f"mesh shape {tuple(shape)} != {len(devs)} devices")
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, tuple(axis_names))
+
+
+def local_mesh(axis_names: Sequence[str] = ("data",)) -> Mesh:
+    """Mesh over this host's chips only (the reference's single-host
+    MirroredStrategy domain, SURVEY.md §2.9 row 1)."""
+    return make_mesh(axis_names=axis_names, devices=jax.local_devices())
+
+
+def global_mesh(axis_names: Sequence[str] = ("data",)) -> Mesh:
+    """Mesh over every chip in the slice (MultiWorkerMirrored domain)."""
+    return make_mesh(axis_names=axis_names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
+    """Leading-dim sharding for batches along the data axis."""
+    return NamedSharding(mesh, P(axis))
+
+
+def shard_batch(mesh: Mesh, batch: Any, axis: str = "data") -> Any:
+    """Place a host-local batch tree onto the mesh, sharded on ``axis``.
+
+    Multi-host: each process contributes its local shard and the result
+    is a global array (the TPU answer to the reference's
+    ``AutoShardPolicy.OFF`` + per-worker dataset slicing, SURVEY.md §2.9
+    row 2).
+    """
+    sharding = batch_sharding(mesh, axis)
+
+    def _place(x: Any) -> jax.Array:
+        x = np.asarray(x)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(_place, batch)
+
+
+def replicate(mesh: Mesh, tree: Any) -> Any:
+    """Replicate a pytree (params/opt state) across the mesh."""
+    return jax.device_put(tree, replicated(mesh))

@@ -1,0 +1,128 @@
+"""Distribution strategies — the user-facing API of the parallel layer.
+
+Mirrors the ergonomics the reference exposed through
+``tf.distribute.MirroredStrategy`` / ``MultiWorkerMirroredStrategy``
+inside ``experiment.mirrored`` wrapper functions (reference:
+mirroredstrategy_mnist_example.ipynb:125-131,
+multiworkermirroredstrategy_mnist_example.ipynb:137-141; SURVEY.md
+§2.9), but lowers to pjit-style sharded ``jax.jit`` over a Mesh: params
+replicated, batch sharded on the ``data`` axis, gradient AllReduce
+emitted by XLA over ICI — no NCCL, no TF_CONFIG, no cluster spec.
+
+Typical wrapper-function use::
+
+    def train_fn():
+        strategy = distribute.MirroredStrategy()
+        state = strategy.replicate(create_state(...))
+        step = strategy.step(train_step)        # compiled SPMD step
+        for batch in data:
+            state, metrics = step(state, strategy.distribute_batch(batch))
+        return {"accuracy": float(metrics["accuracy"])}
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Iterator
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from hops_tpu.parallel import mesh as mesh_lib
+
+_current: list["Strategy"] = []
+
+
+class Strategy:
+    """Base: data-parallel SPMD over an arbitrary mesh."""
+
+    def __init__(self, mesh: Mesh | None = None, data_axis: str = "data"):
+        self.mesh = mesh if mesh is not None else mesh_lib.global_mesh()
+        self.data_axis = data_axis
+
+    # -- introspection (reference: strategy.num_replicas_in_sync) ------------
+
+    @property
+    def num_replicas_in_sync(self) -> int:
+        return self.mesh.shape[self.data_axis]
+
+    @property
+    def num_hosts(self) -> int:
+        return jax.process_count()
+
+    def global_batch_size(self, per_replica: int) -> int:
+        """Reference pattern: ``BATCH_SIZE_PER_REPLICA * num_replicas``."""
+        return per_replica * self.num_replicas_in_sync
+
+    # -- placement ------------------------------------------------------------
+
+    def replicate(self, tree: Any) -> Any:
+        return mesh_lib.replicate(self.mesh, tree)
+
+    def distribute_batch(self, batch: Any) -> Any:
+        return mesh_lib.shard_batch(self.mesh, batch, self.data_axis)
+
+    # -- execution ------------------------------------------------------------
+
+    def step(
+        self,
+        fn: Callable[..., Any],
+        donate_state: bool = True,
+    ) -> Callable[..., Any]:
+        """Compile ``fn(state, batch, ...) -> (state, aux)`` as one SPMD
+        step: state replicated, batch sharded, XLA inserts the gradient
+        collectives. The compiled step is cached by jit."""
+        rep = mesh_lib.replicated(self.mesh)
+        data = NamedSharding(self.mesh, P(self.data_axis))
+        return jax.jit(
+            fn,
+            in_shardings=(rep, data),
+            out_shardings=(rep, rep),
+            donate_argnums=(0,) if donate_state else (),
+        )
+
+    def run(self, fn: Callable[..., Any], state: Any, batch: Any) -> Any:
+        return self.step(fn)(state, self.distribute_batch(batch))
+
+    # -- scope (reference: ``with strategy.scope():``) ------------------------
+
+    @contextlib.contextmanager
+    def scope(self) -> Iterator["Strategy"]:
+        _current.append(self)
+        try:
+            yield self
+        finally:
+            _current.pop()
+
+
+class MirroredStrategy(Strategy):
+    """Data parallelism over the chips of ONE host (reference:
+    single-host ``tf.distribute.MirroredStrategy``)."""
+
+    def __init__(self, data_axis: str = "data"):
+        super().__init__(mesh_lib.local_mesh((data_axis,)), data_axis)
+
+
+class CollectiveAllReduceStrategy(Strategy):
+    """Data parallelism over the WHOLE slice; gradients AllReduce over
+    ICI/DCN (reference: ``MultiWorkerMirroredStrategy`` with NCCL —
+    SURVEY.md §2.9 row 2)."""
+
+    def __init__(self, data_axis: str = "data"):
+        super().__init__(mesh_lib.global_mesh((data_axis,)), data_axis)
+
+
+# The reference docs name ParameterServerStrategy as a supported mode but
+# never call it (SURVEY.md §2.3 last row); parameter servers have no
+# TPU-native analog, so it is a documented alias of collective allreduce.
+ParameterServerStrategy = CollectiveAllReduceStrategy
+
+
+def current_strategy() -> "Strategy | None":
+    """The innermost active ``strategy.scope()``, if any."""
+    return _current[-1] if _current else None
+
+
+def get_strategy() -> "Strategy":
+    """Active strategy, or a default over all visible chips."""
+    return _current[-1] if _current else Strategy()

@@ -25,22 +25,40 @@ def _coerce(value: Any, typ: Any) -> Any:
     """Coerce a string/JSON value to the annotated dataclass field type."""
     if typ is Any or value is None:
         return value
+    # Unwrap Optional / unions: coerce to the first non-None member.
+    import types as _types
+    import typing as _typing
+
+    if isinstance(typ, _types.UnionType) or getattr(typ, "__origin__", None) is _typing.Union:
+        members = [a for a in typ.__args__ if a is not type(None)]
+        for i, m in enumerate(members):
+            try:
+                return _coerce(value, m)
+            except (ValueError, TypeError):
+                if i == len(members) - 1:
+                    raise
+        return value
     origin = getattr(typ, "__origin__", None)
-    if dataclasses.is_dataclass(typ) and isinstance(value, dict):
-        return from_dict(typ, value)
+    if dataclasses.is_dataclass(typ):
+        if isinstance(value, str):
+            value = json.loads(value)
+        if isinstance(value, dict):
+            return from_dict(typ, value)
     if origin in (list, tuple) and isinstance(value, str):
         try:
             value = json.loads(value)
         except json.JSONDecodeError:
-            # CLI form: "mesh=4,2" / "axes=data,model"
-            args = getattr(typ, "__args__", ())
-            elem = args[0] if args and args[0] is not Ellipsis else str
-            value = [
-                _coerce(v.strip(), elem if elem in (int, float, str, bool) else str)
-                for v in value.split(",")
-            ]
-    if origin is tuple and isinstance(value, list):
-        return tuple(value)
+            value = value.split(",")  # CLI form: "mesh=4,2" / "axes=data,model"
+    if origin in (list, tuple):
+        if not isinstance(value, (list, tuple)):
+            value = [value]  # single-element override: "mesh=4"
+        args = getattr(typ, "__args__", ())
+        elem = args[0] if args and args[0] is not Ellipsis else Any
+        coerce_elem = elem if elem in (int, float, str, bool) else Any
+        value = [
+            _coerce(v.strip() if isinstance(v, str) else v, coerce_elem) for v in value
+        ]
+        return tuple(value) if origin is tuple else value
     if typ is bool and isinstance(value, str):
         return value.lower() in ("1", "true", "yes", "on")
     if typ in (int, float, str) and not isinstance(value, typ):
@@ -133,4 +151,8 @@ def configure(**kwargs: Any) -> RuntimeConfig:
     """Update the process-global runtime config in place."""
     global _current
     _current = dataclasses.replace(_current, **kwargs)
+    if "log_level" in kwargs:
+        import logging as _stdlog
+
+        _stdlog.getLogger("hops_tpu").setLevel(_current.log_level)
     return _current

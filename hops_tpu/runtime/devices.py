@@ -117,7 +117,10 @@ def fake_mesh_env(n: int = 8) -> dict[str, str]:
     """Env vars that emulate an ``n``-chip slice on CPU (SURVEY.md §4.4).
 
     Must be applied before JAX initializes a backend; used by the test
-    suite's conftest and by subprocess-based trial executors.
+    suite's conftest and by subprocess-based trial executors. If jax was
+    already imported (e.g. by a sitecustomize), additionally call
+    ``jax.config.update("jax_platforms", "cpu")`` — the env var alone is
+    snapshotted at import time.
     """
     flags = os.environ.get("XLA_FLAGS", "")
     return {

@@ -36,6 +36,10 @@ _configured = False
 
 
 def get_logger(name: str = "hops_tpu") -> logging.Logger:
+    # Route every logger under the configured "hops_tpu" hierarchy so
+    # user-code loggers inherit the handler, level and host tag.
+    if name != "hops_tpu" and not name.startswith("hops_tpu."):
+        name = f"hops_tpu.{name}"
     global _configured
     if not _configured:
         handler = logging.StreamHandler(sys.stderr)
@@ -92,11 +96,15 @@ class MetricLogger:
         self._f.close()
 
 
-def _jsonable(v: Any) -> Any:
+def scalarize(v: Any) -> Any:
+    """Best-effort float coercion for metric values (str fallback)."""
     try:
         return float(v)
     except (TypeError, ValueError):
         return str(v)
+
+
+_jsonable = scalarize
 
 
 def read_metrics(path: str | Path) -> list[dict[str, Any]]:

@@ -1,0 +1,112 @@
+"""Launcher tests: the reference's UX contract on the fake mesh.
+
+Golden-record style mirrors SURVEY.md §4.1 — returned (path, metrics)
+tuples are the observable contract.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hops_tpu import experiment
+from hops_tpu.experiment import registry, tensorboard
+from hops_tpu.parallel import get_strategy
+
+
+class TestLaunch:
+    def test_launch_returns_path_and_metrics(self):
+        def train_fn():
+            print("hello from wrapper")
+            tensorboard.scalar(0, "loss", 1.0)
+            return {"accuracy": 0.92}
+
+        path, metrics = experiment.launch(train_fn, name="mnist", metric_key="accuracy")
+        assert "Experiments" in path
+        assert metrics["accuracy"] == 0.92
+        assert metrics["metric"] == 0.92
+        # output.log captured user stdout
+        assert "hello from wrapper" in Path(metrics["log"]).read_text()
+        # metrics.jsonl written via tensorboard facade
+        events = (Path(path) / "metrics.jsonl").read_text()
+        assert json.loads(events.splitlines()[0])["tag"] == "loss"
+
+    def test_launch_with_args(self):
+        def train_fn(lr, steps):
+            return {"lr_used": lr, "steps": steps}
+
+        _, metrics = experiment.launch(train_fn, args={"lr": 0.1, "steps": 5})
+        assert metrics["lr_used"] == 0.1
+
+    def test_scalar_return_becomes_metric(self):
+        _, metrics = experiment.launch(lambda: 0.5)
+        assert metrics["metric"] == 0.5
+
+    def test_registry_records_run(self):
+        experiment.launch(lambda: {"m": 1.0}, name="reg-test", metric_key="m")
+        runs = registry.list_runs("reg-test")
+        assert len(runs) == 1
+        assert runs[0]["status"] == "FINISHED"
+        assert runs[0]["metrics"]["m"] == 1.0
+
+    def test_failure_registered_and_reraised(self):
+        def bad():
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError):
+            experiment.launch(bad, name="fail-test")
+        runs = registry.list_runs("fail-test")
+        assert runs[0]["status"] == "FAILED"
+
+    def test_best_run(self):
+        experiment.launch(lambda: {"acc": 0.5}, name="best", metric_key="acc")
+        experiment.launch(lambda: {"acc": 0.9}, name="best", metric_key="acc")
+        best = registry.best_run("best", metric="acc")
+        assert best["metrics"]["acc"] == 0.9
+        worst = registry.best_run("best", metric="acc", direction="min")
+        assert worst["metrics"]["acc"] == 0.5
+
+
+class TestDistributedLaunchers:
+    def test_mirrored_exposes_strategy(self):
+        def train_fn():
+            s = get_strategy()
+            return {"replicas": s.num_replicas_in_sync}
+
+        _, metrics = experiment.mirrored(train_fn, name="mir")
+        assert metrics["replicas"] == 8
+
+    def test_collective_all_reduce_trains(self):
+        """End-to-end: data-parallel training of a tiny linear model over
+        the 8-device mesh inside the launcher."""
+
+        def train_fn():
+            s = get_strategy()
+            w = s.replicate(jnp.zeros((4,)))
+            import numpy as np
+
+            rs = np.random.RandomState(0)
+            x = rs.randn(64, 4).astype("float32")
+            true_w = np.array([1.0, -2.0, 3.0, 0.5], "float32")
+            y = x @ true_w
+
+            def step(w, batch):
+                def loss(w):
+                    return jnp.mean((batch["x"] @ w - batch["y"]) ** 2)
+
+                return w - 0.1 * jax.grad(loss)(w), {"loss": loss(w)}
+
+            compiled = s.step(step, donate_state=False)
+            for _ in range(100):
+                w, m = compiled(w, s.distribute_batch({"x": x, "y": y}))
+            return {"final_loss": float(m["loss"])}
+
+        _, metrics = experiment.collective_all_reduce(train_fn, name="car")
+        assert metrics["final_loss"] < 1e-3
+
+    def test_parameter_server_alias(self):
+        _, metrics = experiment.parameter_server(lambda: {"ok": 1.0}, name="ps")
+        assert metrics["ok"] == 1.0
+        assert registry.list_runs("ps")[0]["kind"] == "collective_all_reduce"

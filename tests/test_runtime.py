@@ -75,6 +75,29 @@ class TestConfig:
         assert config.load(C, overrides=["flag=true"]).flag is True
         assert config.load(C, overrides=["flag=0"]).flag is False
 
+    def test_single_element_tuple_override(self):
+        @dataclasses.dataclass
+        class C:
+            mesh: tuple[int, ...] = (1, 1)
+
+        assert config.load(C, overrides=["mesh=4"]).mesh == (4,)
+
+    def test_optional_and_nested_env(self, monkeypatch):
+        @dataclasses.dataclass
+        class Inner:
+            lr: float = 0.1
+
+        @dataclasses.dataclass
+        class C:
+            steps: int | None = None
+            inner: Inner = dataclasses.field(default_factory=Inner)
+
+        monkeypatch.setenv("HOPS_TPU_STEPS", "5")
+        monkeypatch.setenv("HOPS_TPU_INNER", '{"lr": 0.5}')
+        cfg = config.load(C)
+        assert cfg.steps == 5
+        assert cfg.inner.lr == 0.5
+
 
 class TestFs:
     def test_project_path_scoping(self):
@@ -158,6 +181,31 @@ class TestRunDir:
         final = run.finalize()
         assert (fs.Path(final) / "model.bin").read_bytes() == b"w"
         assert "Experiments" in final
+        assert run.finalize() == final  # idempotent
+
+    def test_concurrent_activations_are_isolated(self):
+        import threading
+
+        results = {}
+
+        def trial(name):
+            run = rundir.new_run()
+            with rundir.activate(run):
+                import time
+
+                time.sleep(0.02)
+                results[name] = rundir.logdir() == run.logdir
+
+        threads = [threading.Thread(target=trial, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(results.values())
+
+    def test_session_id_override(self, monkeypatch):
+        monkeypatch.setattr(rundir, "_session_id", "application_fixed_1")
+        assert rundir.new_run().run_id.startswith("application_fixed_1")
 
 
 class TestMetricLogger:
