@@ -1,0 +1,139 @@
+"""Benchmark harness — the TPU port of the reference's benchmark notebook.
+
+Reference: notebooks/ml/Benchmarks/benchmark.ipynb — ResNet-50 on
+synthetic 224x224x3 batches under MirroredStrategy, bs=8/GPU (SURVEY.md
+§6). Here: ResNet-50 fwd+bwd+SGD on synthetic data, bf16 on the MXU,
+per-chip batch sized for TPU (64 by default), data-parallel over all
+visible chips.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_samples_per_sec_per_chip", "value": N,
+   "unit": "samples/s/chip", "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md), so the recorded
+baseline is self-measured: the first TPU run's value is stored in
+BASELINE_SELF.json and later rounds report improvement against it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_FILE = Path(__file__).parent / "BASELINE_SELF.json"
+
+
+def _sync(tree) -> float:
+    """Force completion via a device-to-host transfer.
+
+    ``jax.block_until_ready`` is unreliable on relayed backends (it can
+    return before execution finishes); an actual value transfer cannot.
+    """
+    return float(jax.tree.leaves(tree)[0])
+
+
+def run_bench(
+    per_chip_batch: int = 64,
+    image_size: int = 224,
+    steps: int = 30,
+    warmup: int = 5,
+    smoke: bool = False,
+) -> dict:
+    from hops_tpu.models import common
+    from hops_tpu.models.resnet import ResNet18ish, ResNet50
+    from hops_tpu.parallel.strategy import Strategy
+
+    if smoke:
+        model = ResNet18ish(dtype=jnp.float32)
+        per_chip_batch, image_size, steps, warmup = 8, 32, 4, 1
+    else:
+        model = ResNet50(num_classes=1000)
+
+    strategy = Strategy()  # data-parallel over all visible chips
+    n_chips = strategy.num_replicas_in_sync
+    global_batch = per_chip_batch * n_chips
+
+    state = strategy.replicate(
+        common.create_bn_train_state(
+            model, jax.random.PRNGKey(0), (per_chip_batch, image_size, image_size, 3)
+        )
+    )
+    step_fn = strategy.step(common.make_bn_train_step())
+
+    rs = np.random.RandomState(0)
+    batch = strategy.distribute_batch(
+        {
+            "image": rs.randn(global_batch, image_size, image_size, 3).astype(np.float32),
+            "label": rs.randint(0, 10, (global_batch,)),
+        }
+    )
+
+    for _ in range(warmup):
+        state, metrics = step_fn(state, batch)
+    _sync(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step_fn(state, batch)
+    _sync(metrics)
+    elapsed = time.perf_counter() - t0
+
+    samples_per_sec = global_batch * steps / elapsed
+    return {
+        "samples_per_sec": samples_per_sec,
+        "samples_per_sec_per_chip": samples_per_sec / n_chips,
+        "step_time_ms": elapsed / steps * 1e3,
+        "n_chips": n_chips,
+        "global_batch": global_batch,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
+    parser.add_argument("--batch", type=int, default=64, help="per-chip batch size")
+    parser.add_argument("--steps", type=int, default=30)
+    args = parser.parse_args()
+
+    result = run_bench(per_chip_batch=args.batch, steps=args.steps, smoke=args.smoke)
+    value = result["samples_per_sec_per_chip"]
+
+    baseline = None
+    if BASELINE_FILE.exists() and not args.smoke:
+        recorded = json.loads(BASELINE_FILE.read_text())
+        if recorded.get("platform") == result["platform"]:
+            baseline = recorded.get("samples_per_sec_per_chip")
+    if baseline is None and not args.smoke:
+        BASELINE_FILE.write_text(
+            json.dumps(
+                {
+                    "samples_per_sec_per_chip": value,
+                    "platform": result["platform"],
+                    "recorded": time.strftime("%Y-%m-%d"),
+                },
+                indent=2,
+            )
+        )
+        baseline = value
+
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_samples_per_sec_per_chip",
+                "value": round(value, 2),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(value / baseline, 4) if baseline else 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

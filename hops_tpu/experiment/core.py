@@ -89,7 +89,8 @@ def _run_wrapper(
     handler = attach_run_log(out_path)
     status, metrics, err = "FINISHED", {}, None
     with rundir.activate(run):
-        tee_out = _Tee(sys.stdout, out_path.open("a"))
+        out_file = out_path.open("a")
+        tee_out = _Tee(sys.stdout, out_file)
         try:
             with contextlib.redirect_stdout(tee_out):
                 ctx = strategy.scope() if strategy is not None else contextlib.nullcontext()
@@ -101,7 +102,11 @@ def _run_wrapper(
             tee_out.write(traceback.format_exc())
         finally:
             tee_out.flush()
+            out_file.close()
             detach_run_log(handler)
+            from hops_tpu.experiment import tensorboard as _tb
+
+            _tb.close(run.logdir)
     final_path = run.finalize()
     if chief:
         registry.register(

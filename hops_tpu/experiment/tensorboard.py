@@ -42,7 +42,17 @@ def scalar(step: int, tag: str, value) -> None:
 
 def flush() -> None:
     for w in _writers.values():
-        w._f.flush()
+        w.flush()
+
+
+def close(run_logdir: str | None = None) -> None:
+    """Close and evict the writer for ``run_logdir`` (default: the active
+    run). Launchers call this when a run finalizes so long-lived drivers
+    don't accumulate open file handles."""
+    key = run_logdir or rundir.logdir()
+    w = _writers.pop(key, None)
+    if w is not None:
+        w.close()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,11 @@
+"""Host-side messaging: RPC control plane + pubsub + TLS material.
+
+The reference used Spark driver<->executor RPC for trial control,
+SSL-Kafka for logs/streams and per-project X.509 material (SURVEY.md
+§2.2, §5 "Distributed communication backend"). Device-side collectives
+are XLA's job (hops_tpu.parallel); this package is the host-side
+control/data plane: a tiny JSON-line RPC layer (trial heartbeats, job
+control) and a pubsub abstraction (inference logging, streaming ingest).
+"""
+
+from hops_tpu.messaging import rpc  # noqa: F401

@@ -54,32 +54,101 @@ def make_train_step(
 ) -> Callable[[TrainState, dict[str, jax.Array]], tuple[TrainState, dict[str, jax.Array]]]:
     """Classification train step: grads + update + loss/accuracy metrics.
 
-    Works for any model whose apply is ``apply({'params': p}, x, train=)``.
+    Works for any model whose apply is ``apply({'params': p}, x, train=)``
+    — with or without BatchNorm: when the state carries ``batch_stats``
+    (``BNTrainState``), running statistics are threaded through as a
+    mutable collection. The dropout RNG is folded per step from
+    ``state.rng``. The presence of ``batch_stats`` is static at trace
+    time, so both paths jit cleanly.
     """
 
     def train_step(state: TrainState, batch: dict[str, jax.Array]):
         step_rng = jax.random.fold_in(state.rng, state.step)
+        has_bn = bool(getattr(state, "batch_stats", None))
 
         def compute_loss(params):
-            logits = state.apply_fn(
-                {"params": params}, batch["image"], train=True, rngs={"dropout": step_rng}
-            )
-            if loss_fn is not None:
-                return loss_fn(logits, batch["label"]), logits
-            return cross_entropy_loss(logits, batch["label"]), logits
+            variables = {"params": params}
+            if has_bn:
+                variables["batch_stats"] = state.batch_stats
+                logits, updates = state.apply_fn(
+                    variables,
+                    batch["image"],
+                    train=True,
+                    rngs={"dropout": step_rng},
+                    mutable=["batch_stats"],
+                )
+            else:
+                logits = state.apply_fn(
+                    variables, batch["image"], train=True, rngs={"dropout": step_rng}
+                )
+                updates = None
+            fn = loss_fn if loss_fn is not None else cross_entropy_loss
+            return fn(logits, batch["label"]), (logits, updates)
 
-        (loss, logits), grads = jax.value_and_grad(compute_loss, has_aux=True)(state.params)
+        (loss, (logits, updates)), grads = jax.value_and_grad(compute_loss, has_aux=True)(
+            state.params
+        )
         # Replicated-params + sharded-batch shardings make XLA reduce
         # `grads` across the data axis here (AllReduce over ICI).
-        new_state = state.apply_gradients(grads=grads)
+        if has_bn:
+            new_state = state.apply_gradients(grads=grads, batch_stats=updates["batch_stats"])
+        else:
+            new_state = state.apply_gradients(grads=grads)
         return new_state, {"loss": loss, "accuracy": accuracy(logits, batch["label"])}
 
     return train_step
 
 
+class BNTrainState(train_state.TrainState):
+    """TrainState carrying BatchNorm running statistics."""
+
+    batch_stats: Any = None
+    rng: jax.Array = None
+
+
+def create_bn_train_state(
+    model: nn.Module,
+    rng: jax.Array,
+    input_shape: tuple[int, ...],
+    optimizer: optax.GradientTransformation | None = None,
+    learning_rate: float = 0.1,
+    input_dtype: Any = jnp.float32,
+) -> BNTrainState:
+    """Like :func:`create_train_state` but for BatchNorm models; default
+    optimizer is SGD+momentum (the convnet convention)."""
+    params_rng, dropout_rng = jax.random.split(rng)
+    variables = model.init(
+        {"params": params_rng, "dropout": dropout_rng},
+        jnp.zeros(input_shape, input_dtype),
+        train=False,
+    )
+    tx = optimizer if optimizer is not None else optax.sgd(learning_rate, momentum=0.9)
+    return BNTrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats", {}),
+        tx=tx,
+        rng=dropout_rng,
+    )
+
+
+def make_bn_train_step(
+    loss_fn: Callable[..., Any] | None = None,
+) -> Callable[[BNTrainState, dict[str, jax.Array]], tuple[BNTrainState, dict[str, jax.Array]]]:
+    """Alias of :func:`make_train_step`, which handles BatchNorm states."""
+    return make_train_step(loss_fn)
+
+
 def make_eval_step() -> Callable[..., dict[str, jax.Array]]:
+    """Eval step for plain and BatchNorm models alike (running stats are
+    read from the state when present)."""
+
     def eval_step(state: TrainState, batch: dict[str, jax.Array]):
-        logits = state.apply_fn({"params": state.params}, batch["image"], train=False)
+        variables = {"params": state.params}
+        batch_stats = getattr(state, "batch_stats", None)
+        if batch_stats:
+            variables["batch_stats"] = batch_stats
+        logits = state.apply_fn(variables, batch["image"], train=False)
         return {
             "loss": cross_entropy_loss(logits, batch["label"]),
             "accuracy": accuracy(logits, batch["label"]),

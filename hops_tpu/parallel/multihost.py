@@ -28,12 +28,21 @@ def initialize(
     process_id: int | None = None,
 ) -> None:
     """Join the multi-host runtime. No-ops on single-process runs and on
-    TPU pods where the platform auto-discovers (GKE/GCE metadata)."""
-    if jax.process_count() > 1:
-        return  # already initialized
-    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
-        if num_processes in (None, 1):
-            return
+    TPU pods where the platform auto-discovers (GKE/GCE metadata).
+
+    Must run before anything initializes the local XLA backend — so this
+    function never touches ``jax.process_count()`` etc. until after the
+    distributed client is up.
+    """
+    if jax.distributed.is_initialized():
+        return  # already joined
+    want_multi = (
+        coordinator_address is not None
+        or "JAX_COORDINATOR_ADDRESS" in os.environ
+        or num_processes not in (None, 1)
+    )
+    if not want_multi:
+        return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

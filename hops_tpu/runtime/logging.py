@@ -92,6 +92,9 @@ class MetricLogger:
         )
         self._f.flush()
 
+    def flush(self) -> None:
+        self._f.flush()
+
     def close(self) -> None:
         self._f.close()
 

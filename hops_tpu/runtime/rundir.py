@@ -34,7 +34,6 @@ _active: contextvars.ContextVar[tuple["RunDir", ...]] = contextvars.ContextVar(
     "hops_tpu_active_runs", default=()
 )
 _state_lock = threading.Lock()
-_chdir_owner: "RunDir | None" = None
 _live_activations = 0
 
 
@@ -136,7 +135,7 @@ def activate(run: RunDir) -> Iterator[RunDir]:
     process-global, so under the parallel trial driver only ``logdir()``
     is a reliable base; concurrent trials keep the outer cwd.
     """
-    global _chdir_owner, _live_activations
+    global _live_activations
     token = _active.set(_active.get() + (run,))
     prev_cwd = os.getcwd()
     did_chdir = False
@@ -144,7 +143,6 @@ def activate(run: RunDir) -> Iterator[RunDir]:
         # Claim the cwd only when NO other activation is live — otherwise
         # a later trial would yank the cwd from under a running one.
         if _live_activations == 0:
-            _chdir_owner = run
             os.chdir(run.logdir)
             did_chdir = True
         _live_activations += 1
@@ -155,5 +153,4 @@ def activate(run: RunDir) -> Iterator[RunDir]:
         with _state_lock:
             _live_activations -= 1
             if did_chdir:
-                _chdir_owner = None
                 os.chdir(prev_cwd)

@@ -1,0 +1,28 @@
+"""Async parallel-trial search — the maggy equivalent (SURVEY.md §2.4).
+
+Surface mirrored from the reference:
+
+- :class:`Searchspace` with INTEGER/DOUBLE/DISCRETE/CATEGORICAL types
+  (maggy-fashion-mnist-example.ipynb:124-130)
+- trial functions take hyperparameters as kwargs plus ``reporter`` and
+  return a scalar metric (or dict)
+- :func:`~hops_tpu.search.drivers.lagom` async driver: optimizer loop +
+  heartbeat RPC + early stopping + LOCO ablation
+- ``grid_search`` / ``differential_evolution`` drivers backing
+  ``hops_tpu.experiment``'s entry points (SURVEY.md §2.3)
+
+TPU-native twist: trials are scheduled onto individual chips of the
+slice (``jax.default_device`` pinning per executor thread) instead of
+Spark executors — task parallelism over the mesh (SURVEY.md §2.9 row 4).
+"""
+
+from hops_tpu.search.ablation import AblationStudy  # noqa: F401
+from hops_tpu.search.drivers import (  # noqa: F401
+    differential_evolution,
+    grid_search,
+    lagom,
+)
+from hops_tpu.search.earlystop import MedianEarlyStopper  # noqa: F401
+from hops_tpu.search.optimizers import ASHA, DifferentialEvolution, GridSearch, RandomSearch  # noqa: F401
+from hops_tpu.search.reporter import Reporter, TrialStopped  # noqa: F401
+from hops_tpu.search.searchspace import Searchspace  # noqa: F401

@@ -1,0 +1,69 @@
+"""The driver contract: entry() compiles; dryrun_multichip(8) executes."""
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as graft  # noqa: E402
+from hops_tpu.parallel import mesh as mesh_lib, sharding as shard_lib  # noqa: E402
+
+
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+def test_entry_is_jittable_small():
+    # Full ResNet-50 compile is exercised by the driver; here we check the
+    # contract shape cheaply via lowering (no XLA compile).
+    fn, args = graft.entry()
+    lowered = jax.jit(fn).lower(*args)
+    assert "conv" in lowered.as_text().lower()
+
+
+class TestShardingRules:
+    def test_small_params_replicated(self):
+        spec = shard_lib.infer_param_spec({"b": np.zeros((128,))}, axis_size=2)
+        assert spec["b"] == jax.sharding.PartitionSpec()
+
+    def test_large_matrix_sharded_on_largest_divisible_dim(self):
+        spec = shard_lib.infer_param_spec(
+            {"w": np.zeros((4096, 6))}, axis_size=2, min_size=1024
+        )
+        assert spec["w"] == jax.sharding.PartitionSpec("model", None)
+
+    def test_indivisible_dims_replicated(self):
+        spec = shard_lib.infer_param_spec(
+            {"w": np.zeros((81, 81))}, axis_size=8, min_size=1024
+        )
+        assert spec["w"] == jax.sharding.PartitionSpec()
+
+    def test_shard_params_places(self):
+        mesh = mesh_lib.make_mesh((4, 2), ("data", "model"))
+        params = {"w": jnp.zeros((256, 64))}
+        sharded = shard_lib.shard_params(mesh, params, min_size=1024)
+        assert sharded["w"].sharding.spec == jax.sharding.PartitionSpec("model", None)
+
+
+def test_bn_train_step():
+    from hops_tpu.models import common
+    from hops_tpu.models.resnet import ResNet18ish
+
+    model = ResNet18ish(dtype=jnp.float32)
+    state = common.create_bn_train_state(model, jax.random.PRNGKey(0), (4, 32, 32, 3))
+    step = jax.jit(common.make_bn_train_step())
+    batch = {
+        "image": np.random.randn(4, 32, 32, 3).astype(np.float32),
+        "label": np.array([0, 1, 2, 3]),
+    }
+    before = jax.tree.leaves(state.batch_stats)[0].copy()
+    state, metrics = step(state, batch)
+    state, metrics = step(state, batch)
+    assert int(state.step) == 2
+    after = jax.tree.leaves(state.batch_stats)[0]
+    assert not np.allclose(before, after)  # running stats updated
+    assert np.isfinite(float(metrics["loss"]))

@@ -1,0 +1,228 @@
+"""Search-package tests: searchspace, optimizers, RPC, drivers, ablation."""
+
+import json
+import random
+import time
+from pathlib import Path
+
+import pytest
+
+from hops_tpu.experiment import registry
+from hops_tpu.messaging.rpc import RpcClient, RpcServer
+from hops_tpu.search import (
+    ASHA,
+    AblationStudy,
+    DifferentialEvolution,
+    MedianEarlyStopper,
+    Searchspace,
+    differential_evolution,
+    grid_search,
+    lagom,
+)
+from hops_tpu.search.ablation import LOCOAblator
+from hops_tpu.search.optimizers import TrialResult
+
+
+class TestSearchspace:
+    def test_types_case_insensitive(self):
+        sp = Searchspace(kernel=("integer", [2, 8]))
+        sp.add("dropout", ("DOUBLE", [0.01, 0.99]))
+        sp.add("act", ("CATEGORICAL", ["relu", "gelu"]))
+        s = sp.sample(random.Random(0))
+        assert 2 <= s["kernel"] <= 8 and isinstance(s["kernel"], int)
+        assert 0.01 <= s["dropout"] <= 0.99
+        assert s["act"] in ("relu", "gelu")
+
+    def test_bad_specs(self):
+        with pytest.raises(ValueError):
+            Searchspace(x=("WAT", [1, 2]))
+        with pytest.raises(ValueError):
+            Searchspace(x=("INTEGER", [5, 1]))
+
+    def test_grid_and_clip(self):
+        sp = Searchspace(a=("INTEGER", [1, 2]), b=("DISCRETE", [10, 20]))
+        combos = list(sp.grid())
+        assert len(combos) == 4
+        clipped = sp.clip({"a": 99.7, "b": 10})
+        assert clipped["a"] == 2
+
+
+class TestRpc:
+    def test_roundtrip_and_errors(self):
+        server = RpcServer()
+        server.register("add", lambda a, b: a + b)
+        server.start()
+        client = RpcClient(server.address)
+        assert client.call("add", a=2, b=3) == 5
+        with pytest.raises(RuntimeError, match="KeyError"):
+            client.call("missing")
+        client.close()
+        server.stop()
+
+    def test_concurrent_clients(self):
+        import threading
+
+        server = RpcServer()
+        server.register("echo", lambda x: x)
+        server.start()
+        results = []
+
+        def worker(i):
+            c = RpcClient(server.address)
+            results.append(c.call("echo", x=i))
+            c.close()
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(results) == list(range(8))
+        server.stop()
+
+
+class TestOptimizers:
+    def test_de_converges_on_quadratic(self):
+        sp = Searchspace(x=("DOUBLE", [-5, 5]), y=("DOUBLE", [-5, 5]))
+        opt = DifferentialEvolution(sp, generations=10, population=8, direction="min")
+        i = 0
+        while not opt.finished():
+            params = opt.ask()
+            if params is None:
+                break
+            metric = params["x"] ** 2 + params["y"] ** 2
+            opt.tell(TrialResult(f"t{i}", params, metric, meta=params))
+            i += 1
+        best = min(p.get("_best", 1e9) for p in [{}])  # noqa: F841
+        fits = [f for f in opt._fitness if f is not None]
+        assert min(fits) < 1.0
+
+    def test_asha_promotes_top_fraction(self):
+        sp = Searchspace(lr=("DOUBLE", [0.0, 1.0]))
+        opt = ASHA(sp, num_trials=9, min_budget=1, eta=3, direction="max")
+        budgets_seen = []
+        i = 0
+        while not opt.finished() and i < 100:
+            params = opt.ask()
+            if params is None:
+                break
+            budgets_seen.append(params["budget"])
+            # metric == lr so promotion is deterministic-ish
+            opt.tell(TrialResult(f"t{i}", params, params["lr"], meta=params))
+            i += 1
+        assert budgets_seen.count(1) == 9
+        assert budgets_seen.count(3) == 3  # top third promoted
+        assert budgets_seen.count(9) == 1
+
+    def test_median_early_stopper(self):
+        es = MedianEarlyStopper("max", es_min=3)
+        assert not es.should_stop(0.1, [0.5, 0.6])  # below es_min
+        assert es.should_stop(0.1, [0.5, 0.6, 0.7])
+        assert not es.should_stop(0.9, [0.5, 0.6, 0.7])
+
+
+class TestDrivers:
+    def test_grid_search_finds_best(self):
+        def train_fn(lr, width):
+            return {"accuracy": lr * width}
+
+        path, summary = grid_search(
+            train_fn,
+            {"lr": [0.1, 0.2], "width": [1, 2, 3]},
+            optimization_key="accuracy",
+        )
+        assert summary["num_trials"] == 6
+        assert summary["best_config"] == {"lr": 0.2, "width": 3}
+        assert summary["best_metric"] == pytest.approx(0.6)
+        # per-trial artifacts exist
+        trial_files = list(Path(path).glob("trial_*/trial.json"))
+        assert len(trial_files) == 6
+        assert json.loads((Path(path) / "result.json").read_text())["num_trials"] == 6
+
+    def test_differential_evolution_driver(self):
+        def train_fn(x):
+            return {"loss": (x - 2.0) ** 2}
+
+        path, summary = differential_evolution(
+            train_fn,
+            {"x": [-10.0, 10.0]},
+            generations=6,
+            population=6,
+            direction="min",
+            optimization_key="loss",
+        )
+        assert summary["best_metric"] < 0.5
+        assert abs(summary["best_config"]["x"] - 2.0) < 1.0
+
+    def test_lagom_randomsearch_with_reporter(self):
+        def train_fn(lr, reporter):
+            for step in range(3):
+                reporter.broadcast(metric=lr * (step + 1), step=step)
+            return lr * 3
+
+        sp = Searchspace(lr=("DOUBLE", [0.0, 1.0]))
+        summary = lagom(
+            train_fn, searchspace=sp, optimizer="randomsearch", num_trials=6,
+            name="lagom-test", es_min=100,
+        )
+        assert summary["num_trials"] == 6
+        assert summary["best_metric"] > 0
+        runs = registry.list_runs("lagom-test")
+        assert runs and runs[-1]["status"] == "FINISHED"
+
+    def test_lagom_early_stops_slow_trials(self):
+        """Poor trials must die cooperatively at a broadcast boundary."""
+
+        def train_fn(q, reporter):
+            for step in range(50):
+                reporter.broadcast(metric=q, step=step)
+                time.sleep(0.01)
+            return q
+
+        sp = Searchspace(q=("DOUBLE", [0.0, 1.0]))
+        summary = lagom(
+            train_fn, searchspace=sp, num_trials=10, name="es-test",
+            es_min=2, es_interval=0.05, hb_interval=0.0, max_parallel=2,
+        )
+        assert summary["early_stopped"] > 0
+        # early-stopped trials still report their last metric
+        assert summary["num_trials"] == 10
+
+    def test_lagom_asha(self):
+        def train_fn(lr, budget):
+            return lr * budget
+
+        sp = Searchspace(lr=("DOUBLE", [0.0, 1.0]))
+        summary = lagom(
+            train_fn, searchspace=sp, optimizer="asha", num_trials=9, name="asha-test",
+        )
+        assert summary["num_trials"] == 13  # 9 + 3 + 1 promotions
+
+    def test_ablation_loco(self):
+        study = AblationStudy("titanic", 1, label_name="survived")
+        study.features.include("age", "fare")
+        study.model.layers.include("dense_1")
+        trials = LOCOAblator(study).trials()
+        assert len(trials) == 4  # base + 2 features + 1 layer
+
+        def train_fn(ablated_feature, ablated_layer):
+            # base model best; each ablation hurts
+            return 0.9 - 0.1 * (ablated_feature is not None) - 0.2 * (ablated_layer is not None)
+
+        summary = lagom(
+            train_fn, experiment_type="ablation", ablation_study=study, name="loco-test",
+        )
+        assert summary["num_trials"] == 4
+        assert summary["best_config"] == {"ablated_feature": None, "ablated_layer": None}
+        assert summary["best_metric"] == pytest.approx(0.9)
+
+
+class TestExperimentFacade:
+    def test_experiment_module_exports(self):
+        from hops_tpu import experiment
+
+        def fn(a):
+            return {"m": a}
+
+        path, summary = experiment.grid_search(fn, {"a": [1, 2]}, optimization_key="m")
+        assert summary["best_metric"] == 2
