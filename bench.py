@@ -110,7 +110,9 @@ def main() -> None:
         recorded = json.loads(BASELINE_FILE.read_text())
         if recorded.get("platform") == result["platform"]:
             baseline = recorded.get("samples_per_sec_per_chip")
-    if baseline is None and not args.smoke:
+    # Record a baseline only on the first-ever real run; never clobber a
+    # baseline recorded on a different platform.
+    if baseline is None and not args.smoke and not BASELINE_FILE.exists():
         BASELINE_FILE.write_text(
             json.dumps(
                 {

@@ -1,0 +1,123 @@
+"""Durable pubsub topics — the Kafka-surface equivalent.
+
+The reference used per-project SSL Kafka for inference logging and
+streaming ingest, with broker discovery and an Avro schema registry
+(``hops.kafka``: get_broker_endpoints / get_schema — KafkaPython.ipynb:
+134,155; SURVEY.md §2.2). Here a topic is an append-only JSONL log under
+the project's ``Topics`` dataset: producers append, consumers tail with
+durable per-group offsets — the same at-least-once, replayable contract,
+with no broker to operate. The storage backend rides the fs façade, so a
+shared filesystem gives cross-host pubsub; a real broker can slot in
+behind the same API later.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Any, Iterator
+
+from hops_tpu.runtime import fs
+
+_lock = threading.Lock()
+
+
+def _topics_root() -> Path:
+    p = Path(fs.project_path("Topics"))
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _topic_dir(name: str) -> Path:
+    return _topics_root() / name
+
+
+def create_topic(name: str, schema: dict[str, Any] | None = None) -> str:
+    d = _topic_dir(name)
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "log.jsonl").touch()
+    if schema is not None:
+        (d / "schema.json").write_text(json.dumps(schema, indent=2))
+    return name
+
+
+def topic_exists(name: str) -> bool:
+    return (_topic_dir(name) / "log.jsonl").exists()
+
+
+def list_topics() -> list[str]:
+    return sorted(d.name for d in _topics_root().iterdir() if d.is_dir())
+
+
+def get_schema(topic: str) -> dict[str, Any] | None:
+    """Schema-registry lookup (reference: ``kafka.get_schema(topic)``)."""
+    p = _topic_dir(topic) / "schema.json"
+    return json.loads(p.read_text()) if p.exists() else None
+
+
+def get_broker_endpoints() -> str:
+    """Reference-parity discovery (``kafka.get_broker_endpoints``): the
+    'broker' is the topics root on the shared filesystem."""
+    return str(_topics_root())
+
+
+def get_security_protocol() -> str:
+    return "FS"  # filesystem-backed; TLS applies at the mount, not here
+
+
+class Producer:
+    def __init__(self, topic: str):
+        if not topic_exists(topic):
+            create_topic(topic)
+        self._path = _topic_dir(topic) / "log.jsonl"
+
+    def send(self, value: Any, key: str | None = None) -> None:
+        rec = {"ts": time.time(), "key": key, "value": value}
+        with _lock:
+            with self._path.open("a") as f:
+                f.write(json.dumps(rec, default=str) + "\n")
+
+    def flush(self) -> None:
+        pass  # every send is durable
+
+
+class Consumer:
+    """Tailing consumer with a durable per-group offset."""
+
+    def __init__(self, topic: str, group: str = "default", from_beginning: bool = False):
+        if not topic_exists(topic):
+            create_topic(topic)
+        self._log = _topic_dir(topic) / "log.jsonl"
+        self._offset_file = _topic_dir(topic) / f"offset.{group}"
+        if from_beginning or not self._offset_file.exists():
+            self._offset = 0 if from_beginning else self._current_end()
+        else:
+            self._offset = int(self._offset_file.read_text() or 0)
+
+    def _current_end(self) -> int:
+        return self._log.stat().st_size
+
+    def poll(self, max_records: int | None = None) -> list[dict[str, Any]]:
+        with self._log.open("rb") as f:
+            f.seek(self._offset)
+            out = []
+            for line in f:
+                if not line.endswith(b"\n"):
+                    break  # partial write in flight; retry next poll
+                out.append(json.loads(line))
+                self._offset += len(line)
+                if max_records is not None and len(out) >= max_records:
+                    break
+        return out
+
+    def commit(self) -> None:
+        self._offset_file.write_text(str(self._offset))
+
+    def __iter__(self) -> Iterator[dict[str, Any]]:
+        while True:
+            batch = self.poll()
+            if not batch:
+                return
+            yield from batch

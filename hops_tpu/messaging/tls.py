@@ -1,0 +1,93 @@
+"""Per-project TLS material — the ``hops.tls`` surface.
+
+Reference functions (KafkaPython.ipynb:155-157, KafkaSparkPython.ipynb:
+165-169, SURVEY.md §2.2): locate the project CA chain, client cert/key
+and trust/key stores provisioned by the platform. Here the material
+lives under ``<project>/.tls`` and is generated on demand with the
+system ``openssl`` (self-signed project CA + client cert). Store
+passwords follow the reference's file-based delivery.
+"""
+
+from __future__ import annotations
+
+import secrets
+import subprocess
+from pathlib import Path
+
+from hops_tpu.runtime import fs
+
+
+def _tls_dir() -> Path:
+    d = Path(fs.project_path(".tls"))
+    d.mkdir(parents=True, exist_ok=True)
+    return d
+
+
+def _ensure_material() -> Path:
+    d = _tls_dir()
+    ca = d / "ca_chain.pem"
+    if ca.exists():
+        return d
+    project = fs.project_name()
+    try:
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(d / "ca_key.pem"), "-out", str(ca),
+             "-days", "365", "-subj", f"/CN={project}-ca"],
+            check=True, capture_output=True,
+        )
+        subprocess.run(
+            ["openssl", "req", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", str(d / "client_key.pem"), "-out", str(d / "client.csr"),
+             "-subj", f"/CN={fs.project_user()}"],
+            check=True, capture_output=True,
+        )
+        subprocess.run(
+            ["openssl", "x509", "-req", "-in", str(d / "client.csr"),
+             "-CA", str(ca), "-CAkey", str(d / "ca_key.pem"),
+             "-CAcreateserial", "-out", str(d / "client_cert.pem"), "-days", "365"],
+            check=True, capture_output=True,
+        )
+    except (OSError, subprocess.CalledProcessError):
+        # No openssl: write clearly-marked placeholder material so the
+        # path contract still holds for tooling/tests.
+        for name in ("ca_chain.pem", "client_cert.pem", "client_key.pem"):
+            (d / name).write_text(f"# placeholder {name}; openssl unavailable\n")
+    (d / "trust_store.jks").write_bytes(ca.read_bytes())
+    (d / "key_store.jks").write_bytes(
+        (d / "client_cert.pem").read_bytes() + (d / "client_key.pem").read_bytes()
+    )
+    (d / "material_passwd").write_text(secrets.token_hex(16))
+    return d
+
+
+def get_ca_chain_location() -> str:
+    return str(_ensure_material() / "ca_chain.pem")
+
+
+def get_client_certificate_location() -> str:
+    return str(_ensure_material() / "client_cert.pem")
+
+
+def get_client_key_location() -> str:
+    return str(_ensure_material() / "client_key.pem")
+
+
+def get_trust_store() -> str:
+    return str(_ensure_material() / "trust_store.jks")
+
+
+def get_key_store() -> str:
+    return str(_ensure_material() / "key_store.jks")
+
+
+def _get_password() -> str:
+    return (_ensure_material() / "material_passwd").read_text()
+
+
+def get_trust_store_pwd() -> str:
+    return _get_password()
+
+
+def get_key_store_pwd() -> str:
+    return _get_password()

@@ -1,0 +1,13 @@
+"""Model layer: versioned registry, serving, batch inference.
+
+Reference surface (SURVEY.md §2.5): ``hops.model`` (export /
+get_best_model with Metric.MAX/MIN) and ``hops.serving``
+(create_or_update / start / stop / get_status / make_inference_request /
+get_kafka_topic), plus Spark batch inference. TPU-native: models are
+flax param trees + reconstructable module specs; serving is an
+in-process XLA-backed HTTP server speaking the TF-Serving REST payload;
+inference logging rides the pubsub layer.
+"""
+
+from hops_tpu.modelrepo import batch, registry, serving  # noqa: F401
+from hops_tpu.modelrepo.registry import Metric, export, get_best_model, get_model  # noqa: F401

@@ -1,0 +1,78 @@
+"""Batch inference over sharded data.
+
+Reference (SURVEY.md §2.5, Batch_Inference_Imagenet_Spark.ipynb:283-325):
+Spark ``mapPartitions`` over an image DataFrame with the model broadcast
+per partition and ``repartition(num_executors*3)``. TPU-native: one
+jitted forward, inputs sharded over the mesh's data axis, host loop over
+chunks sized ``chips * per_chip_batch``; the ragged tail is padded to
+keep shapes static (no recompiles).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import jax
+import numpy as np
+
+from hops_tpu.parallel.strategy import Strategy
+
+
+def batch_predict(
+    apply_fn: Callable[[Any], Any],
+    inputs: np.ndarray,
+    per_chip_batch: int = 32,
+    strategy: Strategy | None = None,
+) -> np.ndarray:
+    """Run ``apply_fn`` over ``inputs`` data-parallel across the slice.
+
+    ``apply_fn`` maps a batch array to predictions (already closed over
+    params). Returns stacked predictions aligned with ``inputs``.
+    """
+    strategy = strategy or Strategy()
+    chunk = per_chip_batch * strategy.num_replicas_in_sync
+    jitted = jax.jit(apply_fn)
+
+    outs: list[np.ndarray] = []
+    n = len(inputs)
+    for start in range(0, n, chunk):
+        block = inputs[start : start + chunk]
+        valid = len(block)
+        if valid < chunk:  # pad tail to the static shape
+            pad = np.repeat(block[-1:], chunk - valid, axis=0)
+            block = np.concatenate([block, pad], axis=0)
+        placed = strategy.distribute_batch(block)
+        preds = np.asarray(jitted(placed))
+        outs.append(preds[:valid])
+    return np.concatenate(outs, axis=0) if outs else np.empty((0,))
+
+
+def batch_predict_stream(
+    apply_fn: Callable[[Any], Any],
+    batches: Iterator[np.ndarray],
+    strategy: Strategy | None = None,
+) -> Iterator[np.ndarray]:
+    """Streaming variant: caller controls batching; each yielded batch
+    must share one shape (pad upstream)."""
+    strategy = strategy or Strategy()
+    jitted = jax.jit(apply_fn)
+    for block in batches:
+        yield np.asarray(jitted(strategy.distribute_batch(block)))
+
+
+def predict_with_model(
+    name: str,
+    inputs: np.ndarray,
+    version: int | None = None,
+    per_chip_batch: int = 32,
+) -> np.ndarray:
+    """Batch inference straight from the model registry (the reference's
+    broadcast-model-per-partition pattern, minus Spark)."""
+    from hops_tpu.modelrepo import registry
+
+    bundle = registry.load_flax(name, version)
+    module = bundle["module"]
+    variables = {"params": bundle["params"], **bundle["extra_variables"]}
+    return batch_predict(
+        lambda x: module.apply(variables, x, train=False), inputs, per_chip_batch
+    )

@@ -1,0 +1,147 @@
+"""Versioned model registry.
+
+Reference: ``hops.model.export(path, name, metrics={})`` registering a
+SavedModel/artifact dir under ``Models/<name>/<version>``, and
+``model.get_best_model(name, metric, Metric.MAX)`` returning
+``{'name','version','metrics'}`` (model_repo_and_serving.ipynb:241,
+314-320; SURVEY.md §2.5).
+
+A model here is whatever the user exports: a flax module+params bundle
+(via :func:`save_flax`), a directory of artifacts, or any single file.
+Every version carries ``model.json`` metadata.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import shutil
+import time
+from pathlib import Path
+from typing import Any
+
+from hops_tpu.runtime import fs
+
+
+class Metric:
+    MAX = "max"
+    MIN = "min"
+
+
+def _models_root() -> Path:
+    p = Path(fs.project_path("Models"))
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+def _next_version(name: str) -> int:
+    d = _models_root() / name
+    if not d.exists():
+        return 1
+    versions = [int(v.name) for v in d.iterdir() if v.name.isdigit()]
+    return max(versions, default=0) + 1
+
+
+def export(
+    path: str | Path,
+    name: str,
+    metrics: dict[str, Any] | None = None,
+    description: str = "",
+) -> dict[str, Any]:
+    """Register a local artifact file/dir as a new model version
+    (reference: ``model.export``)."""
+    src = Path(path)
+    if not src.exists():
+        raise FileNotFoundError(f"model artifact {src} does not exist")
+    version = _next_version(name)
+    dst = _models_root() / name / str(version)
+    dst.mkdir(parents=True, exist_ok=True)
+    if src.is_dir():
+        shutil.copytree(src, dst, dirs_exist_ok=True)
+    else:
+        shutil.copy2(src, dst / src.name)
+    meta = {
+        "name": name,
+        "version": version,
+        "metrics": {k: _num(v) for k, v in (metrics or {}).items()},
+        "description": description,
+        "created": time.time(),
+        "path": str(dst),
+    }
+    (dst / "model.json").write_text(json.dumps(meta, indent=2, default=str))
+    return meta
+
+
+def save_flax(
+    model: Any,
+    params: Any,
+    name: str,
+    metrics: dict[str, Any] | None = None,
+    extra_variables: dict[str, Any] | None = None,
+    description: str = "",
+) -> dict[str, Any]:
+    """Export a flax module + trained variables as a servable bundle.
+
+    The module (a dataclass) and param pytree are pickled together with
+    any extra collections (e.g. ``batch_stats``); ``serving`` knows how
+    to load and apply the bundle.
+    """
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        bundle = {
+            "format": "flax-pickle-v1",
+            "module": model,
+            "params": params,
+            "extra_variables": extra_variables or {},
+        }
+        p = Path(tmp) / "flax_model.pkl"
+        p.write_bytes(pickle.dumps(bundle))
+        return export(Path(tmp), name, metrics=metrics, description=description)
+
+
+def load_flax(name: str, version: int | None = None) -> dict[str, Any]:
+    meta = get_model(name, version)
+    bundle_path = Path(meta["path"]) / "flax_model.pkl"
+    return pickle.loads(bundle_path.read_bytes())
+
+
+def list_models(name: str | None = None) -> list[dict[str, Any]]:
+    out = []
+    for model_dir in sorted(_models_root().iterdir() if name is None else [_models_root() / name]):
+        if not model_dir.is_dir():
+            continue
+        for vdir in sorted(model_dir.iterdir(), key=lambda v: int(v.name) if v.name.isdigit() else 0):
+            meta_file = vdir / "model.json"
+            if meta_file.exists():
+                out.append(json.loads(meta_file.read_text()))
+    return out
+
+
+def get_model(name: str, version: int | None = None) -> dict[str, Any]:
+    versions = list_models(name)
+    if not versions:
+        raise KeyError(f"model {name!r} not found")
+    if version is None:
+        return versions[-1]
+    for m in versions:
+        if m["version"] == version:
+            return m
+    raise KeyError(f"model {name!r} version {version} not found")
+
+
+def get_best_model(name: str, metric: str, direction: str = Metric.MAX) -> dict[str, Any]:
+    """Best version by a metric (reference: ``model.get_best_model(name,
+    'accuracy', Metric.MAX)``)."""
+    candidates = [m for m in list_models(name) if metric in m.get("metrics", {})]
+    if not candidates:
+        raise KeyError(f"no versions of {name!r} carry metric {metric!r}")
+    key = lambda m: m["metrics"][metric]  # noqa: E731
+    return max(candidates, key=key) if direction == Metric.MAX else min(candidates, key=key)
+
+
+def _num(v: Any) -> Any:
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return str(v)

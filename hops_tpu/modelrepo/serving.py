@@ -1,0 +1,284 @@
+"""Model serving with the TF-Serving REST contract.
+
+Reference (SURVEY.md §2.5, model_repo_and_serving.ipynb:370-375,523):
+``serving.create_or_update(name, model_path, model_server=..., ...)``,
+lifecycle ``start/stop/get_status/get_all``, inference via
+``make_inference_request(name, {"signature_name", "instances": [...]})``
+returning ``{"predictions": [...]}``, and every request/response tee'd
+onto a per-serving Kafka topic (``serving.get_kafka_topic``).
+
+TPU-native: each started serving is an HTTP server thread exposing
+``POST /v1/models/<name>:predict`` (the TF-Serving path) backed by a
+jitted flax apply — or by a user Python ``Predict`` class (the
+reference's sklearn escape hatch, iris_flower_classifier.py:1-27).
+Inference logging rides ``messaging.pubsub``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import pickle
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any
+
+from hops_tpu.messaging import pubsub
+from hops_tpu.modelrepo import registry
+from hops_tpu.runtime import fs
+from hops_tpu.runtime.logging import get_logger
+
+log = get_logger(__name__)
+
+FLAX = "FLAX"
+PYTHON = "PYTHON"
+# Accepted for reference parity; flax bundles are the native path.
+TENSORFLOW_SERVING = FLAX
+
+_servers: dict[str, "_RunningServing"] = {}
+_lock = threading.Lock()
+
+
+def _servings_file() -> Path:
+    p = Path(fs.project_path("Serving"))
+    p.mkdir(parents=True, exist_ok=True)
+    return p / "servings.json"
+
+
+def _load_registry() -> dict[str, dict[str, Any]]:
+    f = _servings_file()
+    return json.loads(f.read_text()) if f.exists() else {}
+
+
+def _save_registry(reg: dict[str, dict[str, Any]]) -> None:
+    _servings_file().write_text(json.dumps(reg, indent=2, default=str))
+
+
+# -- predictors ---------------------------------------------------------------
+
+
+class FlaxPredictor:
+    """Serves a ``save_flax`` bundle with a jitted apply."""
+
+    def __init__(self, artifact_dir: Path):
+        import jax
+        import numpy as np
+
+        bundle = pickle.loads((artifact_dir / "flax_model.pkl").read_bytes())
+        module = bundle["module"]
+        variables = {"params": bundle["params"], **bundle["extra_variables"]}
+        self._np = np
+        self._apply = jax.jit(lambda x: module.apply(variables, x, train=False))
+
+    def predict(self, instances: list[Any]) -> list[Any]:
+        x = self._np.asarray(instances, dtype=self._np.float32)
+        return self._np.asarray(self._apply(x)).tolist()
+
+
+class PythonPredictor:
+    """Loads a user script defining ``class Predict`` with
+    ``__init__/predict`` (and optionally ``classify``/``regress``) —
+    the reference's Python-model-server contract."""
+
+    def __init__(self, script_path: Path):
+        spec = importlib.util.spec_from_file_location("hops_tpu_predictor", script_path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        self._impl = mod.Predict()
+
+    def predict(self, instances: list[Any]) -> list[Any]:
+        return self._impl.predict(instances)
+
+
+def _build_predictor(cfg: dict[str, Any]) -> Any:
+    artifact_dir = Path(cfg["artifact_path"])
+    if cfg["model_server"] == PYTHON:
+        scripts = sorted(artifact_dir.rglob("*.py"))
+        if not scripts:
+            raise FileNotFoundError(f"no predictor script under {artifact_dir}")
+        return PythonPredictor(scripts[0])
+    return FlaxPredictor(artifact_dir)
+
+
+# -- the HTTP server ----------------------------------------------------------
+
+
+class _RunningServing:
+    def __init__(self, cfg: dict[str, Any]):
+        self.cfg = cfg
+        self.predictor = _build_predictor(cfg)
+        self.producer = pubsub.Producer(cfg["topic"])
+        name = cfg["name"]
+        predictor = self.predictor
+        producer = self.producer
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args: Any) -> None:  # silence stderr spam
+                pass
+
+            def do_POST(self) -> None:
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    if not self.path.endswith(f"/v1/models/{name}:predict"):
+                        self._reply(404, {"error": f"unknown path {self.path}"})
+                        return
+                    instances = payload.get("instances")
+                    if instances is None:
+                        self._reply(400, {"error": "payload must carry 'instances'"})
+                        return
+                    preds = predictor.predict(instances)
+                    response = {"predictions": preds}
+                    producer.send(
+                        {"request": payload, "response": response}, key=name
+                    )
+                    self._reply(200, response)
+                except Exception as e:  # noqa: BLE001 — server must stay up
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _reply(self, code: int, body: dict[str, Any]) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+
+    @property
+    def port(self) -> int:
+        return self.server.server_address[1]
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+
+# -- public API (reference surface) ------------------------------------------
+
+
+def create_or_update(
+    name: str,
+    model_path: str | None = None,
+    model_version: int | None = None,
+    model_name: str | None = None,
+    model_server: str = FLAX,
+    kfserving: bool = False,  # accepted for parity; single serving tool here
+    instances: int = 1,
+) -> dict[str, Any]:
+    """Create/update a serving endpoint definition (reference:
+    ``serving.create_or_update``). ``model_path`` may be a registry path
+    or omitted in favor of ``model_name``+``model_version``."""
+    reg = _load_registry()
+    if model_path is None:
+        meta = registry.get_model(model_name or name, model_version)
+        artifact_path = meta["path"]
+        model_version = meta["version"]
+    else:
+        p = Path(model_path)
+        artifact_path = str(p if p.is_absolute() else fs.project_path(model_path))
+        if model_version is None:
+            model_version = int(p.name) if p.name.isdigit() else 1
+    cfg = {
+        "name": name,
+        "artifact_path": artifact_path,
+        "model_version": model_version,
+        "model_server": model_server.upper(),
+        "kfserving": kfserving,
+        "instances": instances,
+        "status": reg.get(name, {}).get("status", "Stopped"),
+        "topic": f"serving-{name}-inference",
+    }
+    reg[name] = cfg
+    _save_registry(reg)
+    pubsub.create_topic(cfg["topic"])
+    return cfg
+
+
+def get_all() -> list[dict[str, Any]]:
+    return list(_load_registry().values())
+
+
+def exists(name: str) -> bool:
+    return name in _load_registry()
+
+
+def get_status(name: str) -> str:
+    """'Stopped' | 'Running' (reference statuses)."""
+    reg = _load_registry()
+    if name not in reg:
+        raise KeyError(f"serving {name!r} not found")
+    with _lock:
+        if name in _servers:
+            return "Running"
+    return "Stopped"
+
+
+def start(name: str) -> dict[str, Any]:
+    reg = _load_registry()
+    if name not in reg:
+        raise KeyError(f"serving {name!r} not found")
+    with _lock:
+        if name in _servers:
+            return reg[name]
+        running = _RunningServing(reg[name])
+        _servers[name] = running
+    reg[name]["status"] = "Running"
+    reg[name]["port"] = running.port
+    _save_registry(reg)
+    log.info("serving %s listening on 127.0.0.1:%d", name, running.port)
+    return reg[name]
+
+
+def stop(name: str) -> None:
+    with _lock:
+        running = _servers.pop(name, None)
+    if running is not None:
+        running.stop()
+    reg = _load_registry()
+    if name in reg:
+        reg[name]["status"] = "Stopped"
+        reg[name].pop("port", None)
+        _save_registry(reg)
+
+
+def delete(name: str) -> None:
+    stop(name)
+    reg = _load_registry()
+    reg.pop(name, None)
+    _save_registry(reg)
+
+
+def get_kafka_topic(name: str) -> str:
+    """Per-serving inference-log topic (reference:
+    ``serving.get_kafka_topic``)."""
+    reg = _load_registry()
+    if name not in reg:
+        raise KeyError(f"serving {name!r} not found")
+    return reg[name]["topic"]
+
+
+def make_inference_request(
+    name: str, data: dict[str, Any], verb: str = ":predict"
+) -> dict[str, Any]:
+    """POST the TF-Serving payload to the endpoint (reference:
+    ``serving.make_inference_request(name, {"signature_name",
+    "instances": [...]})``)."""
+    reg = _load_registry()
+    if name not in reg:
+        raise KeyError(f"serving {name!r} not found")
+    port = reg[name].get("port")
+    if port is None or get_status(name) != "Running":
+        raise RuntimeError(f"serving {name!r} is not running")
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/models/{name}{verb}",
+        data=json.dumps(data).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())

@@ -41,6 +41,7 @@ class _Layers:
     def __init__(self) -> None:
         self.included: list[str] = []
         self.groups: list[tuple[str, ...]] = []
+        self._prefixes: list[str] = []
 
     def include(self, *names: str | list[str]) -> None:
         for n in names:
@@ -50,12 +51,15 @@ class _Layers:
                 self.included.append(n)
 
     def include_groups(self, *groups: list[str], prefix: str | None = None) -> None:
-        """A group ablates together; ``prefix=`` groups all layers whose
-        name starts with it (reference: include_groups(prefix='conv'))."""
+        """A group ablates together; ``prefix=`` groups all *included*
+        layers whose name starts with it (reference:
+        include_groups(prefix='conv')). Prefixes are expanded against
+        the names registered via :meth:`include` when trials are
+        generated."""
         for g in groups:
             self.groups.append(tuple(g))
         if prefix is not None:
-            self.groups.append((f"prefix:{prefix}",))
+            self._prefixes.append(prefix)
 
 
 class _ModelSpec:
@@ -101,11 +105,20 @@ class LOCOAblator:
         self.study = study
 
     def trials(self) -> list[dict[str, Any]]:
+        layers = self.study.model.layers
         out: list[dict[str, Any]] = [{"ablated_feature": None, "ablated_layer": None}]
         for feat in self.study.features.included:
             out.append({"ablated_feature": feat, "ablated_layer": None})
-        for layer in self.study.model.layers.included:
+        for layer in layers.included:
             out.append({"ablated_feature": None, "ablated_layer": layer})
-        for group in self.study.model.layers.groups:
+        for group in layers.groups:
             out.append({"ablated_feature": None, "ablated_layer": list(group)})
+        for prefix in layers._prefixes:
+            matches = [n for n in layers.included if n.startswith(prefix)]
+            if not matches:
+                raise ValueError(
+                    f"include_groups(prefix={prefix!r}) matched no included layer; "
+                    "register layer names via model.layers.include(...) first"
+                )
+            out.append({"ablated_feature": None, "ablated_layer": matches})
         return out

@@ -110,6 +110,7 @@ class TrialDriver:
             kwargs["reporter"] = reporter
         trial_dir = _TrialDir(parent_dir / trial_id)
         stopped = False
+        error: str | None = None
         metric: float | None = None
         try:
             with jax.default_device(device), rundir.activate(trial_dir):
@@ -118,8 +119,14 @@ class TrialDriver:
         except TrialStopped:
             stopped = True
             metric = reporter.latest
+        except Exception as e:  # noqa: BLE001 — one bad trial must not kill the search
+            error = f"{type(e).__name__}: {e}"
+            log.warning("trial %s failed: %s", trial_id, error)
         finally:
             reporter.finalize(metric)
+            from hops_tpu.experiment import tensorboard as _tb
+
+            _tb.close(trial_dir.logdir)
         (Path(trial_dir.logdir) / "trial.json").write_text(
             json.dumps(
                 {
@@ -127,12 +134,15 @@ class TrialDriver:
                     "params": {k: scalarize(v) for k, v in visible.items()},
                     "metric": metric,
                     "stopped_early": stopped,
+                    "error": error,
                     "history": reporter.history,
                 },
                 default=str,
             )
         )
-        return TrialResult(trial_id, params, metric, stopped_early=stopped, meta=params)
+        return TrialResult(
+            trial_id, params, metric, stopped_early=stopped, meta={**params, "error": error}
+        )
 
     def _extract_metric(self, result: Any) -> float | None:
         if isinstance(result, dict):
@@ -165,7 +175,7 @@ class TrialDriver:
         results: list[TrialResult] = []
         trial_seq = 0
         pending: dict[cf.Future, str] = {}
-        last_es_check = 0.0
+        self._last_sweep = time.time()
         try:
             with cf.ThreadPoolExecutor(max_workers=self.max_parallel) as pool:
                 while True:
@@ -198,8 +208,7 @@ class TrialDriver:
                             if result.metric is not None and not result.stopped_early:
                                 self._finished_finals.append(result.metric)
                         self.optimizer.tell(result)
-                    self._early_stop_sweep(last_es_check)
-                    last_es_check = time.time()
+                    self._early_stop_sweep()
         finally:
             if server is not None:
                 server.stop()
@@ -238,9 +247,10 @@ class TrialDriver:
         )
         return final_path, summary
 
-    def _early_stop_sweep(self, last_check: float) -> None:
-        if time.time() - last_check < self.es_interval:
+    def _early_stop_sweep(self) -> None:
+        if time.time() - self._last_sweep < self.es_interval:
             return
+        self._last_sweep = time.time()
         with self._lock:
             finals = list(self._finished_finals)
             for rep in self._reporters.values():

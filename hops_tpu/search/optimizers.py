@@ -123,6 +123,8 @@ class DifferentialEvolution(Optimizer):
         crossover: float = 0.7,
         seed: int = 0,
     ):
+        if population < 4:
+            raise ValueError(f"DE/rand/1 needs population >= 4, got {population}")
         self.space = space
         self.generations = generations
         self.population = population

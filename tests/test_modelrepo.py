@@ -1,0 +1,190 @@
+"""Model layer tests: registry, serving (TF-Serving contract), batch."""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hops_tpu.messaging import pubsub
+from hops_tpu.modelrepo import Metric, batch, export, get_best_model, registry, serving
+from hops_tpu.models import common
+from hops_tpu.models.mnist import FFN
+
+
+@pytest.fixture
+def trained_ffn():
+    model = FFN(dtype=jnp.float32, hidden=16)
+    state = common.create_train_state(model, jax.random.PRNGKey(0), (4, 28, 28, 1))
+    return model, state.params
+
+
+class TestRegistry:
+    def test_export_versioning(self, tmp_path):
+        art = tmp_path / "model.txt"
+        art.write_text("v")
+        m1 = export(art, "m", metrics={"acc": 0.8})
+        m2 = export(art, "m", metrics={"acc": 0.9})
+        assert (m1["version"], m2["version"]) == (1, 2)
+        assert registry.get_model("m")["version"] == 2
+        assert registry.get_model("m", 1)["version"] == 1
+
+    def test_get_best_model(self, tmp_path):
+        art = tmp_path / "model.txt"
+        art.write_text("v")
+        export(art, "best", metrics={"acc": 0.7, "loss": 1.0})
+        export(art, "best", metrics={"acc": 0.9, "loss": 0.4})
+        export(art, "best", metrics={"acc": 0.8, "loss": 0.2})
+        assert get_best_model("best", "acc", Metric.MAX)["version"] == 2
+        assert get_best_model("best", "loss", Metric.MIN)["version"] == 3
+
+    def test_missing_model_raises(self):
+        with pytest.raises(KeyError):
+            registry.get_model("ghost")
+
+    def test_flax_roundtrip(self, trained_ffn):
+        model, params = trained_ffn
+        meta = registry.save_flax(model, params, "ffn", metrics={"acc": 0.5})
+        bundle = registry.load_flax("ffn")
+        x = np.zeros((2, 28, 28, 1), np.float32)
+        out = bundle["module"].apply({"params": bundle["params"]}, x)
+        assert out.shape == (2, 10)
+        assert meta["metrics"]["acc"] == 0.5
+
+
+class TestServing:
+    def test_flax_serving_lifecycle(self, trained_ffn):
+        model, params = trained_ffn
+        registry.save_flax(model, params, "mnist-ffn", metrics={"acc": 0.5})
+        cfg = serving.create_or_update("mnist-ffn", model_name="mnist-ffn")
+        assert serving.get_status("mnist-ffn") == "Stopped"
+        serving.start("mnist-ffn")
+        try:
+            assert serving.get_status("mnist-ffn") == "Running"
+            payload = {
+                "signature_name": "serving_default",
+                "instances": np.zeros((3, 28, 28, 1)).tolist(),
+            }
+            resp = serving.make_inference_request("mnist-ffn", payload)
+            assert len(resp["predictions"]) == 3
+            assert len(resp["predictions"][0]) == 10
+            # inference logged to the per-serving topic
+            topic = serving.get_kafka_topic("mnist-ffn")
+            consumer = pubsub.Consumer(topic, from_beginning=True)
+            records = consumer.poll()
+            assert len(records) == 1
+            assert records[0]["value"]["response"]["predictions"] == resp["predictions"]
+        finally:
+            serving.stop("mnist-ffn")
+        assert serving.get_status("mnist-ffn") == "Stopped"
+        with pytest.raises(RuntimeError):
+            serving.make_inference_request("mnist-ffn", {"instances": []})
+
+    def test_python_predictor(self, tmp_path):
+        script = tmp_path / "predictor.py"
+        script.write_text(
+            "class Predict:\n"
+            "    def predict(self, instances):\n"
+            "        return [sum(i) for i in instances]\n"
+        )
+        serving.create_or_update("py-model", model_path=str(tmp_path), model_server="PYTHON")
+        serving.start("py-model")
+        try:
+            resp = serving.make_inference_request(
+                "py-model", {"instances": [[1, 2], [3, 4]]}
+            )
+            assert resp["predictions"] == [3, 7]
+        finally:
+            serving.stop("py-model")
+
+    def test_bad_payload_is_400_and_server_survives(self, tmp_path):
+        script = tmp_path / "p.py"
+        script.write_text(
+            "class Predict:\n    def predict(self, instances):\n        return instances\n"
+        )
+        serving.create_or_update("robust", model_path=str(tmp_path), model_server="PYTHON")
+        serving.start("robust")
+        try:
+            import urllib.error, urllib.request
+
+            port = serving._load_registry()["robust"]["port"]
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/models/robust:predict",
+                data=b'{"wrong": 1}',
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 400
+            # still serves afterwards
+            ok = serving.make_inference_request("robust", {"instances": [[1]]})
+            assert ok["predictions"] == [[1]]
+        finally:
+            serving.stop("robust")
+
+    def test_get_all_and_delete(self, tmp_path):
+        script = tmp_path / "p.py"
+        script.write_text(
+            "class Predict:\n    def predict(self, instances):\n        return instances\n"
+        )
+        serving.create_or_update("temp", model_path=str(tmp_path), model_server="PYTHON")
+        assert any(s["name"] == "temp" for s in serving.get_all())
+        serving.delete("temp")
+        assert not serving.exists("temp")
+
+
+class TestBatchInference:
+    def test_batch_predict_pads_tail(self, trained_ffn):
+        model, params = trained_ffn
+        apply_fn = lambda x: model.apply({"params": params}, x)  # noqa: E731
+        inputs = np.random.randn(37, 28, 28, 1).astype(np.float32)  # ragged vs 8*4
+        preds = batch.batch_predict(apply_fn, inputs, per_chip_batch=2)
+        assert preds.shape == (37, 10)
+        # same results as direct apply
+        direct = np.asarray(apply_fn(jnp.asarray(inputs)))
+        np.testing.assert_allclose(preds, direct, rtol=2e-4, atol=2e-4)
+
+    def test_predict_with_model(self, trained_ffn):
+        model, params = trained_ffn
+        registry.save_flax(model, params, "batch-model")
+        preds = batch.predict_with_model("batch-model", np.zeros((5, 28, 28, 1), np.float32))
+        assert preds.shape == (5, 10)
+
+
+class TestPubsub:
+    def test_producer_consumer_offsets(self):
+        pubsub.create_topic("t1", schema={"type": "record"})
+        prod = pubsub.Producer("t1")
+        for i in range(5):
+            prod.send({"i": i})
+        c = pubsub.Consumer("t1", group="g", from_beginning=True)
+        got = c.poll(max_records=3)
+        assert [r["value"]["i"] for r in got] == [0, 1, 2]
+        c.commit()
+        # new consumer in same group resumes after commit
+        c2 = pubsub.Consumer("t1", group="g")
+        assert [r["value"]["i"] for r in c2.poll()] == [3, 4]
+        assert pubsub.get_schema("t1") == {"type": "record"}
+        assert "t1" in pubsub.list_topics()
+
+    def test_consumer_from_now_skips_history(self):
+        pubsub.create_topic("t2")
+        pubsub.Producer("t2").send("old")
+        c = pubsub.Consumer("t2")  # from current end
+        assert c.poll() == []
+        pubsub.Producer("t2").send("new")
+        assert [r["value"] for r in c.poll()] == ["new"]
+
+
+class TestTls:
+    def test_material_paths_exist(self):
+        from hops_tpu.messaging import tls
+
+        ca = tls.get_ca_chain_location()
+        assert Path(ca).exists()
+        assert Path(tls.get_client_certificate_location()).exists()
+        assert Path(tls.get_client_key_location()).exists()
+        assert Path(tls.get_trust_store()).exists()
+        assert tls.get_key_store_pwd() == tls.get_trust_store_pwd()

@@ -198,6 +198,37 @@ class TestDrivers:
         )
         assert summary["num_trials"] == 13  # 9 + 3 + 1 promotions
 
+    def test_failing_trial_does_not_kill_search(self):
+        def train_fn(a):
+            if a == 2:
+                raise RuntimeError("bad hparam")
+            return {"m": float(a)}
+
+        path, summary = grid_search(train_fn, {"a": [1, 2, 3]}, optimization_key="m")
+        assert summary["num_trials"] == 3
+        assert summary["best_metric"] == 3.0
+        trial_meta = [
+            json.loads(p.read_text()) for p in Path(path).glob("trial_*/trial.json")
+        ]
+        errors = [t["error"] for t in trial_meta if t.get("error")]
+        assert len(errors) == 1 and "bad hparam" in errors[0]
+
+    def test_de_population_validation(self):
+        sp = Searchspace(x=("DOUBLE", [0, 1]))
+        with pytest.raises(ValueError, match="population"):
+            DifferentialEvolution(sp, population=3)
+
+    def test_ablation_prefix_expansion(self):
+        study = AblationStudy("td")
+        study.model.layers.include("conv_1", "conv_2", "dense_1")
+        study.model.layers.include_groups(prefix="conv")
+        trials = LOCOAblator(study).trials()
+        assert {"ablated_feature": None, "ablated_layer": ["conv_1", "conv_2"]} in trials
+        bad = AblationStudy("td")
+        bad.model.layers.include_groups(prefix="ghost")
+        with pytest.raises(ValueError, match="matched no included layer"):
+            LOCOAblator(bad).trials()
+
     def test_ablation_loco(self):
         study = AblationStudy("titanic", 1, label_name="survived")
         study.features.include("age", "fare")
