@@ -105,25 +105,25 @@ def main() -> None:
     result = run_bench(per_chip_batch=args.batch, steps=args.steps, smoke=args.smoke)
     value = result["samples_per_sec_per_chip"]
 
+    # Baselines are recorded per platform: the first real run on a
+    # platform becomes that platform's baseline; later runs report
+    # against it. (The legacy single-record file form is migrated.)
     baseline = None
-    if BASELINE_FILE.exists() and not args.smoke:
-        recorded = json.loads(BASELINE_FILE.read_text())
-        if recorded.get("platform") == result["platform"]:
-            baseline = recorded.get("samples_per_sec_per_chip")
-    # Record a baseline only on the first-ever real run; never clobber a
-    # baseline recorded on a different platform.
-    if baseline is None and not args.smoke and not BASELINE_FILE.exists():
-        BASELINE_FILE.write_text(
-            json.dumps(
-                {
-                    "samples_per_sec_per_chip": value,
-                    "platform": result["platform"],
-                    "recorded": time.strftime("%Y-%m-%d"),
-                },
-                indent=2,
-            )
-        )
-        baseline = value
+    if not args.smoke:
+        recorded = json.loads(BASELINE_FILE.read_text()) if BASELINE_FILE.exists() else {}
+        if "platform" in recorded:  # legacy single-record form
+            recorded = {recorded["platform"]: recorded}
+        entry = recorded.get(result["platform"])
+        if entry is not None:
+            baseline = entry.get("samples_per_sec_per_chip")
+        else:
+            recorded[result["platform"]] = {
+                "samples_per_sec_per_chip": value,
+                "platform": result["platform"],
+                "recorded": time.strftime("%Y-%m-%d"),
+            }
+            BASELINE_FILE.write_text(json.dumps(recorded, indent=2))
+            baseline = value
 
     print(
         json.dumps(

@@ -1,0 +1,48 @@
+"""Feature-store layer — the TPU build's `hsfs` equivalent.
+
+Re-creates the capability surface of the Hopsworks Feature Store client
+(reference: notebooks/featurestore/**, SURVEY.md §2.6) on a TPU-native
+substrate: feature groups are schema'd, versioned, partitioned Parquet
+datasets with a log-structured commit history (Hudi-style time travel);
+queries are a lazy select/join/filter/`as_of` algebra executed with
+pandas/pyarrow on the host (feature engineering is host-side prep work —
+the TPU's MXU only ever sees the materialized training batches); training
+datasets materialize query results into split files and feed JAX via
+NumPy/grain iterators (the `td.tf_data` twin); online serving vectors
+come from an embedded KV store instead of MySQL-NDB.
+
+Usage mirrors the reference (feature_engineering.ipynb:92):
+
+    import hops_tpu.featurestore as hsfs
+    conn = hsfs.connection()
+    fs = conn.get_feature_store()
+    fg = fs.create_feature_group("sales", version=1, primary_key=["id"])
+    fg.save(df)
+    q = fg.select(["f1", "f2"]).join(other.select_all()).filter(fg["f1"] > 0)
+    td = fs.create_training_dataset("dataset", version=1, splits={"train": 0.8, "test": 0.2})
+    td.save(q)
+"""
+
+from __future__ import annotations
+
+from hops_tpu.featurestore.connection import Connection, connection  # noqa: F401
+from hops_tpu.featurestore.feature import Feature, Filter, Logic  # noqa: F401
+from hops_tpu.featurestore.feature_group import FeatureGroup  # noqa: F401
+from hops_tpu.featurestore.query import Query  # noqa: F401
+from hops_tpu.featurestore.statistics import StatisticsConfig  # noqa: F401
+from hops_tpu.featurestore.training_dataset import TrainingDataset  # noqa: F401
+from hops_tpu.featurestore.validation import Expectation, Rule  # noqa: F401
+
+__all__ = [
+    "Connection",
+    "connection",
+    "Feature",
+    "Filter",
+    "Logic",
+    "FeatureGroup",
+    "Query",
+    "StatisticsConfig",
+    "TrainingDataset",
+    "Expectation",
+    "Rule",
+]

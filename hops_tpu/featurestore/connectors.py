@@ -1,0 +1,159 @@
+"""Storage connectors: named external data sources.
+
+Reference (SURVEY.md §2.6): ``fs.get_storage_connector(name[, "S3"])``
+for S3 training-dataset sinks and ingest
+(S3-Ingest-to-Feature-Store-basics.ipynb:100), Snowflake
+(``connector.snowflake_connector_options()``), Redshift/JDBC, and the
+default HopsFS connector. Here connectors are a persisted registry;
+path-based connectors (HOPSFS, S3-via-mounted-path) are fully
+functional, network-SQL warehouses are configuration carriers whose
+``read()`` is gated on their (absent) client libraries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+from typing import Any
+
+import pandas as pd
+
+from hops_tpu.featurestore import storage
+
+
+def _registry_path() -> Path:
+    return storage.feature_store_root() / "connectors.json"
+
+
+def _load_registry() -> dict:
+    p = _registry_path()
+    return json.loads(p.read_text()) if p.exists() else {}
+
+
+def _save_registry(reg: dict) -> None:
+    _registry_path().write_text(json.dumps(reg, indent=2))
+
+
+@dataclasses.dataclass
+class StorageConnector:
+    name: str
+    type: str = "HOPSFS"
+    options: dict = dataclasses.field(default_factory=dict)
+
+    def read(self, query: str | None = None, data_format: str | None = None,
+             path: str | None = None) -> pd.DataFrame:
+        raise NotImplementedError
+
+    def spark_options(self) -> dict:
+        return dict(self.options)
+
+
+class HopsFSConnector(StorageConnector):
+    """Default connector: paths inside the project workspace."""
+
+    def resolve(self, path: str | None = None) -> Path:
+        from hops_tpu.runtime import fs as hfs
+
+        base = self.options.get("path", "")
+        rel = str(Path(base) / path) if path else base
+        return Path(hfs.project_path(rel)) if not Path(rel).is_absolute() else Path(rel)
+
+    def read(self, query=None, data_format=None, path=None) -> pd.DataFrame:
+        target = self.resolve(path)
+        return _read_path(target, data_format)
+
+
+class S3Connector(StorageConnector):
+    """S3 bucket. Functional when the bucket is locally mounted (FUSE) via
+    ``options["mount_point"]``; otherwise read() is gated on boto3."""
+
+    def read(self, query=None, data_format=None, path=None) -> pd.DataFrame:
+        mount = self.options.get("mount_point")
+        if mount:
+            return _read_path(Path(mount) / (path or ""), data_format)
+        raise RuntimeError(
+            f"S3 connector {self.name!r}: no mount_point configured and no S3 "
+            "client library in this image; mount the bucket or copy locally")
+
+    @property
+    def bucket(self) -> str:
+        return self.options.get("bucket", "")
+
+
+class JDBCConnector(StorageConnector):
+    def read(self, query=None, data_format=None, path=None) -> pd.DataFrame:
+        raise RuntimeError(
+            f"JDBC connector {self.name!r} requires a database driver not in this image")
+
+    def connection_string(self) -> str:
+        return self.options.get("connection_string", "")
+
+
+class SnowflakeConnector(StorageConnector):
+    def snowflake_connector_options(self) -> dict:
+        """Reference: snowflake/getting-started.ipynb:115-124."""
+        o = self.options
+        return {
+            "sfURL": o.get("url", ""), "sfUser": o.get("user", ""),
+            "sfPassword": o.get("password", ""), "sfDatabase": o.get("database", ""),
+            "sfSchema": o.get("schema", ""), "sfWarehouse": o.get("warehouse", ""),
+            "sfRole": o.get("role", ""),
+        }
+
+    def read(self, query=None, data_format=None, path=None) -> pd.DataFrame:
+        raise RuntimeError(
+            f"Snowflake connector {self.name!r} requires the snowflake client, "
+            "not present in this image")
+
+
+class RedshiftConnector(JDBCConnector):
+    pass
+
+
+_TYPES = {
+    "HOPSFS": HopsFSConnector,
+    "S3": S3Connector,
+    "JDBC": JDBCConnector,
+    "SNOWFLAKE": SnowflakeConnector,
+    "REDSHIFT": RedshiftConnector,
+}
+
+
+def create(name: str, connector_type: str, **options: Any) -> StorageConnector:
+    ctype = connector_type.upper()
+    if ctype not in _TYPES:
+        raise ValueError(f"unknown connector type {connector_type!r}; have {sorted(_TYPES)}")
+    reg = _load_registry()
+    reg[name] = {"type": ctype, "options": options}
+    _save_registry(reg)
+    return _TYPES[ctype](name=name, type=ctype, options=options)
+
+
+def get(name: str, connector_type: str | None = None) -> StorageConnector:
+    reg = _load_registry()
+    if name not in reg:
+        if name.upper() == "HOPSFS" or connector_type == "HOPSFS":
+            return HopsFSConnector(name=name, type="HOPSFS", options={})
+        raise KeyError(f"no storage connector named {name!r}")
+    entry = reg[name]
+    if connector_type and entry["type"] != connector_type.upper():
+        raise KeyError(f"connector {name!r} is {entry['type']}, not {connector_type}")
+    return _TYPES[entry["type"]](name=name, type=entry["type"], options=entry["options"])
+
+
+def _read_path(target: Path, data_format: str | None) -> pd.DataFrame:
+    if target.is_dir():
+        frames = []
+        for p in sorted(target.iterdir()):
+            if p.suffix in (".parquet", ".csv"):
+                frames.append(_read_path(p, None))
+        if not frames:
+            raise FileNotFoundError(f"no readable files under {target}")
+        return pd.concat(frames, ignore_index=True)
+    fmt = data_format or target.suffix.lstrip(".")
+    if fmt == "parquet":
+        return pd.read_parquet(target)
+    if fmt == "csv":
+        return pd.read_csv(target)
+    raise ValueError(f"unsupported format {fmt!r} for {target}")

@@ -1,0 +1,182 @@
+"""Data feeder: training-dataset → device-ready batches.
+
+The reference fed training through ``td.tf_data(...).tf_record_dataset
+(process=True, batch_size, num_epochs)`` (training_datasets.ipynb:
+409-429). The TPU-native path is :meth:`DataFeeder.numpy_iterator`:
+host-side shuffled batch assembly (NumPy, optionally through the native
+record-IO engine) with :func:`prefetch_to_device` overlapping H2D copies
+with compute — static shapes, drop_remainder by default, so every batch
+jits to the same executable. ``tf_record_dataset``/``tf_csv_dataset``
+are provided for tf.data users.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterator
+
+import numpy as np
+
+
+class DataFeeder:
+    def __init__(self, td, target_name: str | None = None, split: str | None = None,
+                 feature_names: list[str] | None = None, is_training: bool = True):
+        self._td = td
+        self.target_name = target_name.lower() if target_name else None
+        self.split = split
+        self.is_training = is_training
+        names = [f.name for f in td.features]
+        if feature_names:
+            self.feature_names = [n.lower() for n in feature_names]
+        else:
+            self.feature_names = [n for n in names if n != self.target_name]
+
+    # -- JAX-native path ------------------------------------------------------
+
+    def numpy_arrays(self) -> tuple[np.ndarray, np.ndarray | None]:
+        """Whole split as (X, y) float arrays (small-data path).
+
+        Non-numeric features are integer-encoded against the sorted
+        vocabulary of the column — deterministic, so train/test splits
+        of the same TD agree on the encoding.
+        """
+        df = self._td.read(split=self.split)
+        cols = []
+        for name in self.feature_names:
+            s = df[name]
+            try:
+                col = s.to_numpy(dtype=np.float32)
+            except (ValueError, TypeError):
+                vocab = {v: i for i, v in enumerate(sorted(s.astype(str).unique()))}
+                col = s.astype(str).map(vocab).to_numpy(dtype=np.float32)
+            cols.append(col)
+        x = np.stack(cols, axis=1) if cols else np.zeros((len(df), 0), np.float32)
+        y = None
+        if self.target_name:
+            y = df[self.target_name].to_numpy()
+        return x, y
+
+    def numpy_iterator(
+        self,
+        batch_size: int,
+        num_epochs: int | None = 1,
+        shuffle: bool | None = None,
+        drop_remainder: bool = True,
+        seed: int = 0,
+        transform: Callable[[np.ndarray, Any], Any] | None = None,
+    ) -> Iterator:
+        """Yield ``(x, y)`` (or ``x`` when no target) NumPy batches.
+
+        ``num_epochs=None`` repeats forever (the tf.data contract).
+        Static batch shapes: with ``drop_remainder=True`` every yielded
+        batch triggers exactly one XLA compilation.
+        """
+        if shuffle is None:
+            shuffle = self.is_training
+        x, y = self.numpy_arrays()
+        n = len(x)
+        rng = np.random.RandomState(seed)
+        epoch = 0
+        while num_epochs is None or epoch < num_epochs:
+            order = rng.permutation(n) if shuffle else np.arange(n)
+            end = n - (n % batch_size) if drop_remainder else n
+            for start in range(0, end, batch_size):
+                idx = order[start:start + batch_size]
+                bx = x[idx]
+                by = y[idx] if y is not None else None
+                if transform is not None:
+                    yield transform(bx, by)
+                elif by is None:
+                    yield bx
+                else:
+                    yield bx, by
+            epoch += 1
+
+    # -- tf.data compatibility ------------------------------------------------
+
+    def tf_record_dataset(self, process: bool = False, batch_size: int | None = None,
+                          num_epochs: int | None = None):
+        """Reference: ``feeder.tf_record_dataset(process=True, batch_size,
+        num_epochs)`` — returns a ``tf.data.Dataset``; with ``process=True``
+        it is batched ``(features, label)`` ready for ``model.fit``."""
+        import tensorflow as tf
+
+        d = self._td.dir / (self.split or ("data" if not self._td.splits else next(iter(self._td.splits))))
+        files = sorted(str(p) for p in d.glob("*.tfrecord"))
+        if not files:
+            raise FileNotFoundError(f"no tfrecord files in {d}")
+        schema = self._tf_schema()
+        ds = tf.data.TFRecordDataset(files)
+        if not process:
+            return ds.map(lambda raw: tf.io.parse_single_example(raw, schema))
+        if self.target_name is None:
+            raise ValueError("process=True requires target_name on the feeder")
+
+        def to_xy(raw):
+            ex = tf.io.parse_single_example(raw, schema)
+            xs = [tf.cast(ex[n], tf.float32) for n in self.feature_names]
+            x = tf.stack([tf.reshape(v, []) for v in xs])
+            y = ex[self.target_name]
+            return x, y
+
+        ds = ds.map(to_xy, num_parallel_calls=tf.data.AUTOTUNE)
+        if self.is_training:
+            ds = ds.shuffle(10_000)
+        ds = ds.batch(batch_size or 32, drop_remainder=True)
+        ds = ds.repeat(num_epochs)
+        return ds.prefetch(tf.data.AUTOTUNE)
+
+    def tf_csv_dataset(self, process: bool = False, batch_size: int | None = None,
+                       num_epochs: int | None = None):
+        import tensorflow as tf
+
+        d = self._td.dir / (self.split or ("data" if not self._td.splits else next(iter(self._td.splits))))
+        files = sorted(str(p) for p in d.glob("*.csv"))
+        if not files:
+            raise FileNotFoundError(f"no csv files in {d}")
+        ds = tf.data.experimental.make_csv_dataset(
+            files, batch_size=batch_size or 32, label_name=self.target_name,
+            num_epochs=num_epochs, shuffle=self.is_training)
+        if process:
+            def to_xy(feats, label):
+                x = tf.stack([tf.cast(feats[n], tf.float32) for n in self.feature_names], axis=1)
+                return x, label
+            ds = ds.map(to_xy)
+        return ds
+
+    def _tf_schema(self):
+        import tensorflow as tf
+
+        schema = {}
+        for f in self._td.features:
+            if f.type in ("int", "bigint", "boolean"):
+                schema[f.name] = tf.io.FixedLenFeature([], tf.int64)
+            elif f.type in ("float", "double"):
+                schema[f.name] = tf.io.FixedLenFeature([], tf.float32)
+            elif f.type.startswith("array"):
+                schema[f.name] = tf.io.VarLenFeature(tf.float32)
+            else:
+                schema[f.name] = tf.io.FixedLenFeature([], tf.string)
+        return schema
+
+
+def prefetch_to_device(iterator: Iterator, size: int = 2, sharding=None) -> Iterator:
+    """Overlap H2D transfer with compute: keep ``size`` batches in flight
+    on device. With ``sharding`` (a ``jax.sharding.Sharding``) batches land
+    already sharded across the mesh — the multi-chip input path."""
+    import collections
+
+    import jax
+
+    queue: collections.deque = collections.deque()
+
+    def put(batch):
+        if sharding is not None:
+            return jax.device_put(batch, sharding)
+        return jax.device_put(batch)
+
+    for batch in iterator:
+        queue.append(put(batch))
+        if len(queue) >= size:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

@@ -1,0 +1,117 @@
+"""Online feature store — embedded KV store for serving vectors.
+
+The reference's online store was MySQL Cluster (NDB) reached over JDBC
+prepared statements (`td.get_serving_vector`,
+feature_vector_model_serving.ipynb:175-196 — SURVEY.md §2.6, "implied
+native"). The TPU build replaces it with an embedded key-value store:
+the native C++ engine in ``hops_tpu/native`` (open-addressing hash index
+over an append-only mmap'd log) when built, else a pure-sqlite fallback
+with identical semantics. Keys are the JSON-encoded primary-key values
+of a row; values are the JSON row.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from pathlib import Path
+from typing import Any, Iterator
+
+import pandas as pd
+
+from hops_tpu.featurestore import storage
+
+
+def _key_of(pk_values: list[Any]) -> str:
+    return json.dumps(pk_values, default=str, separators=(",", ":"))
+
+
+class OnlineStore:
+    """One KV namespace per (feature group, version)."""
+
+    def __init__(self, path: Path):
+        self.path = path
+        self._impl = _open_backend(path)
+        self._lock = threading.Lock()
+
+    # -- write path (fg.insert with online_enabled) --------------------------
+
+    def put_dataframe(self, df: pd.DataFrame, primary_key: list[str]) -> int:
+        rows = 0
+        with self._lock:
+            for rec in df.to_dict(orient="records"):
+                key = _key_of([rec[k] for k in primary_key])
+                self._impl.put(key, json.dumps(rec, default=str))
+                rows += 1
+            self._impl.flush()
+        return rows
+
+    def delete_keys(self, df: pd.DataFrame, primary_key: list[str]) -> None:
+        with self._lock:
+            for rec in df.to_dict(orient="records"):
+                self._impl.delete(_key_of([rec[k] for k in primary_key]))
+            self._impl.flush()
+
+    # -- read path (prepared-statement lookups) ------------------------------
+
+    def get(self, pk_values: list[Any]) -> dict | None:
+        raw = self._impl.get(_key_of(pk_values))
+        return json.loads(raw) if raw is not None else None
+
+    def scan(self) -> Iterator[dict]:
+        yield from (json.loads(v) for v in self._impl.scan())
+
+    def count(self) -> int:
+        return self._impl.count()
+
+    def close(self) -> None:
+        self._impl.close()
+
+
+def open_store(name: str, version: int) -> OnlineStore:
+    d = storage.feature_store_root() / "online"
+    d.mkdir(parents=True, exist_ok=True)
+    return OnlineStore(d / f"{name}_{version}")
+
+
+def _open_backend(path: Path):
+    from hops_tpu.native import kvstore
+
+    if kvstore.available():
+        return kvstore.NativeKV(str(path) + ".hkv")
+    return _SqliteKV(str(path) + ".db")
+
+
+class _SqliteKV:
+    """Fallback backend when the native engine isn't built."""
+
+    def __init__(self, path: str):
+        self._db = sqlite3.connect(path, check_same_thread=False)
+        self._db.execute("CREATE TABLE IF NOT EXISTS kv (k TEXT PRIMARY KEY, v TEXT)")
+        # Prepared-statement spirit of the reference: sqlite caches the
+        # compiled statement; WAL keeps point reads fast under writes.
+        self._db.execute("PRAGMA journal_mode=WAL")
+
+    def put(self, key: str, value: str) -> None:
+        self._db.execute("INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)", (key, value))
+
+    def get(self, key: str) -> str | None:
+        row = self._db.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def delete(self, key: str) -> None:
+        self._db.execute("DELETE FROM kv WHERE k = ?", (key,))
+
+    def scan(self):
+        yield from (v for (v,) in self._db.execute("SELECT v FROM kv"))
+
+    def count(self) -> int:
+        return self._db.execute("SELECT COUNT(*) FROM kv").fetchone()[0]
+
+    def flush(self) -> None:
+        self._db.commit()
+
+    def close(self) -> None:
+        self._db.commit()
+        self._db.close()

@@ -1,0 +1,214 @@
+"""Lazy query algebra over feature groups.
+
+Reference surface (SURVEY.md §2.6, feature_exploration.ipynb cells
+10-31): ``fg.select(...).join(other.select_all(), on=[...],
+join_type="left").filter(fg["f"] > 10).as_of(ts)`` → lazy until
+``read()``/``show(n)``. Execution here is pandas merges on the host —
+feature joins are metadata-scale work; the TPU only sees materialized
+training batches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import TYPE_CHECKING, Any
+
+import pandas as pd
+
+from hops_tpu.featurestore.feature import Feature, _Condition
+
+if TYPE_CHECKING:
+    from hops_tpu.featurestore.feature_group import FeatureGroup
+
+
+@dataclasses.dataclass
+class Join:
+    query: "Query"
+    on: list[str]
+    left_on: list[str]
+    right_on: list[str]
+    join_type: str = "inner"
+    prefix: str | None = None
+
+
+class Query:
+    """Immutable-ish query tree rooted at one feature group."""
+
+    def __init__(self, feature_group: "FeatureGroup", features: list[Feature]):
+        self._fg = feature_group
+        self._features = list(features)
+        self._joins: list[Join] = []
+        self._filters: list[_Condition] = []
+        self._as_of: Any = None
+
+    # -- algebra --------------------------------------------------------------
+
+    def join(
+        self,
+        other: "Query",
+        on: list[str] | None = None,
+        left_on: list[str] | None = None,
+        right_on: list[str] | None = None,
+        join_type: str = "inner",
+        prefix: str | None = None,
+    ) -> "Query":
+        """Reference: join on explicit keys or (default) the shared primary
+        key of the two root groups (feature_exploration.ipynb cell 27-29)."""
+        if on is None and left_on is None:
+            shared = [k for k in self._fg.primary_key if k in other._fg.primary_key]
+            on = shared or None
+            if on is None:
+                raise ValueError(
+                    "no shared primary key between "
+                    f"{self._fg.name} and {other._fg.name}; pass on=/left_on="
+                )
+        self._joins.append(
+            Join(
+                query=other,
+                on=[k.lower() for k in (on or [])],
+                left_on=[k.lower() for k in (left_on or [])],
+                right_on=[k.lower() for k in (right_on or [])],
+                join_type=join_type,
+                prefix=prefix,
+            )
+        )
+        return self
+
+    def filter(self, condition: _Condition) -> "Query":
+        self._filters.append(condition)
+        return self
+
+    def as_of(self, wallclock_time) -> "Query":
+        """Point-in-time read over every group in the tree (reference:
+        ``query.as_of``, time_travel_python.ipynb:1222-1272)."""
+        self._as_of = wallclock_time
+        return self
+
+    @property
+    def features(self) -> list[Feature]:
+        feats = list(self._features)
+        for j in self._joins:
+            feats.extend(j.query.features)
+        return feats
+
+    @property
+    def feature_groups(self) -> list["FeatureGroup"]:
+        fgs = [self._fg]
+        for j in self._joins:
+            fgs.extend(j.query.feature_groups)
+        return fgs
+
+    # -- execution ------------------------------------------------------------
+
+    def _base_frame(self) -> pd.DataFrame:
+        df = self._fg.read(wallclock_time=self._as_of)
+        if df.empty:
+            return pd.DataFrame(columns=[f.name for f in self._fg.features])
+        return df
+
+    def read(self, online: bool = False, dataframe_type: str = "pandas",
+             _extra_keep: tuple = ()) -> pd.DataFrame:
+        df = self._base_frame()
+        # Columns needed downstream: selected + join keys + filter columns
+        # (+ keys a parent join needs from this side).
+        keep = {f.name for f in self._features} | set(_extra_keep)
+        for j in self._joins:
+            keep.update(j.on or j.left_on)
+        for cond in self._filters:
+            keep.update(_condition_columns(cond))
+        df = df[[c for c in df.columns if c in keep]]
+
+        for j in self._joins:
+            right_keys = tuple(j.on or j.right_on)
+            if self._as_of:
+                j.query.as_of(self._as_of)
+            right = j.query.read(_extra_keep=right_keys)
+            if j.prefix:
+                key_cols = set(j.on or j.right_on)
+                right = right.rename(
+                    columns={c: f"{j.prefix}{c}" for c in right.columns if c not in key_cols}
+                )
+            kwargs: dict = {"how": j.join_type}
+            if j.on:
+                kwargs["on"] = j.on
+            else:
+                kwargs["left_on"], kwargs["right_on"] = j.left_on, j.right_on
+            df = df.merge(right, suffixes=("", "_right"), **kwargs)
+
+        for cond in self._filters:
+            df = df[cond.evaluate(df)]
+        return df.reset_index(drop=True)
+
+    def show(self, n: int = 5, online: bool = False) -> pd.DataFrame:
+        return self.read(online=online).head(n)
+
+    # -- introspection --------------------------------------------------------
+
+    def to_string(self) -> str:
+        """SQL-ish rendering for debugging (reference: query.to_string())."""
+        cols = ", ".join(f.name for f in self._features) or "*"
+        sql = f"SELECT {cols} FROM {self._fg.name}_{self._fg.version}"
+        for j in self._joins:
+            keys = j.on or list(zip(j.left_on, j.right_on))
+            sql += f" {j.join_type.upper()} JOIN {j.query._fg.name}_{j.query._fg.version} ON {keys}"
+        if self._filters:
+            sql += " WHERE " + " AND ".join(repr(f) for f in self._filters)
+        if self._as_of is not None:
+            sql += f" AS OF {self._as_of}"
+        return sql
+
+    def to_dict(self) -> dict:
+        """Replayable description persisted with training datasets
+        (reference: ``td.query`` replay, training_datasets.ipynb cell 14)."""
+        return {
+            "feature_group": {"name": self._fg.name, "version": self._fg.version},
+            "features": [f.name for f in self._features],
+            "joins": [
+                {
+                    "query": j.query.to_dict(),
+                    "on": j.on,
+                    "left_on": j.left_on,
+                    "right_on": j.right_on,
+                    "join_type": j.join_type,
+                    "prefix": j.prefix,
+                }
+                for j in self._joins
+            ],
+            "as_of": (
+                self._as_of
+                if self._as_of is None or isinstance(self._as_of, (int, float, str))
+                else str(self._as_of)
+            ),
+        }
+
+    @classmethod
+    def from_dict(cls, feature_store, d: dict) -> "Query":
+        fg = feature_store.get_feature_group(
+            d["feature_group"]["name"], d["feature_group"]["version"]
+        )
+        q = fg.select(d["features"]) if d.get("features") else fg.select_all()
+        for j in d.get("joins", []):
+            q.join(
+                cls.from_dict(feature_store, j["query"]),
+                on=j["on"] or None,
+                left_on=j["left_on"] or None,
+                right_on=j["right_on"] or None,
+                join_type=j["join_type"],
+                prefix=j.get("prefix"),
+            )
+        if d.get("as_of") is not None:
+            q.as_of(d["as_of"])
+        return q
+
+    def __repr__(self) -> str:
+        return f"Query({self.to_string()})"
+
+
+def _condition_columns(cond) -> set[str]:
+    from hops_tpu.featurestore.feature import Filter, Logic
+
+    if isinstance(cond, Filter):
+        return {cond.feature.name}
+    if isinstance(cond, Logic):
+        return _condition_columns(cond.left) | _condition_columns(cond.right)
+    return set()

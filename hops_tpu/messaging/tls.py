@@ -10,7 +10,9 @@ passwords follow the reference's file-based delivery.
 
 from __future__ import annotations
 
+import os
 import secrets
+import shutil
 import subprocess
 from pathlib import Path
 
@@ -24,10 +26,32 @@ def _tls_dir() -> Path:
 
 
 def _ensure_material() -> Path:
-    d = _tls_dir()
+    """Generate-or-return the material directory.
+
+    Generation happens in a private temp dir that is atomically renamed
+    into place once complete (marker file written last), so concurrent
+    callers never observe partially-written material and a crash mid-
+    generation leaves no poisoned sentinel.
+    """
+    base = _tls_dir()
+    final = base / "material"
+    if (final / ".complete").exists():
+        return final
+    tmp = base / f".material-tmp-{os.getpid()}-{secrets.token_hex(4)}"
+    tmp.mkdir(parents=True, exist_ok=True)
+    _generate_into(tmp)
+    (tmp / ".complete").write_text("")
+    if final.exists() and not (final / ".complete").exists():
+        shutil.rmtree(final, ignore_errors=True)  # stale partial from a crash
+    try:
+        os.rename(tmp, final)
+    except OSError:
+        shutil.rmtree(tmp, ignore_errors=True)  # another caller won the race
+    return final
+
+
+def _generate_into(d: Path) -> None:
     ca = d / "ca_chain.pem"
-    if ca.exists():
-        return d
     project = fs.project_name()
     try:
         subprocess.run(
@@ -58,7 +82,6 @@ def _ensure_material() -> Path:
         (d / "client_cert.pem").read_bytes() + (d / "client_key.pem").read_bytes()
     )
     (d / "material_passwd").write_text(secrets.token_hex(16))
-    return d
 
 
 def get_ca_chain_location() -> str:

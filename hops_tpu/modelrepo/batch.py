@@ -44,7 +44,13 @@ def batch_predict(
         placed = strategy.distribute_batch(block)
         preds = np.asarray(jitted(placed))
         outs.append(preds[:valid])
-    return np.concatenate(outs, axis=0) if outs else np.empty((0,))
+    if outs:
+        return np.concatenate(outs, axis=0)
+    # Empty input: derive the output shape without running the model.
+    import jax.numpy as jnp
+
+    probe = jax.eval_shape(apply_fn, jnp.zeros((1,) + inputs.shape[1:], inputs.dtype))
+    return np.empty((0,) + probe.shape[1:], probe.dtype)
 
 
 def batch_predict_stream(

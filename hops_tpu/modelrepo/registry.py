@@ -133,9 +133,12 @@ def get_model(name: str, version: int | None = None) -> dict[str, Any]:
 def get_best_model(name: str, metric: str, direction: str = Metric.MAX) -> dict[str, Any]:
     """Best version by a metric (reference: ``model.get_best_model(name,
     'accuracy', Metric.MAX)``)."""
-    candidates = [m for m in list_models(name) if metric in m.get("metrics", {})]
+    candidates = [
+        m for m in list_models(name)
+        if isinstance(m.get("metrics", {}).get(metric), (int, float))
+    ]
     if not candidates:
-        raise KeyError(f"no versions of {name!r} carry metric {metric!r}")
+        raise KeyError(f"no versions of {name!r} carry numeric metric {metric!r}")
     key = lambda m: m["metrics"][metric]  # noqa: E731
     return max(candidates, key=key) if direction == Metric.MAX else min(candidates, key=key)
 

@@ -98,7 +98,14 @@ def _build_predictor(cfg: dict[str, Any]) -> Any:
         scripts = sorted(artifact_dir.rglob("*.py"))
         if not scripts:
             raise FileNotFoundError(f"no predictor script under {artifact_dir}")
-        return PythonPredictor(scripts[0])
+        # The predictor is the script defining `class Predict` (the
+        # reference's contract) — helper modules may sit alongside it.
+        with_predict = [s for s in scripts if "class Predict" in s.read_text()]
+        if not with_predict:
+            raise FileNotFoundError(
+                f"no script under {artifact_dir} defines `class Predict`"
+            )
+        return PythonPredictor(with_predict[0])
     return FlaxPredictor(artifact_dir)
 
 
@@ -194,6 +201,11 @@ def create_or_update(
         "status": reg.get(name, {}).get("status", "Stopped"),
         "topic": f"serving-{name}-inference",
     }
+    # Preserve runtime keys (e.g. "port") across updates of a running
+    # serving; the new artifact is picked up on the next start().
+    for key in ("port",):
+        if key in reg.get(name, {}):
+            cfg[key] = reg[name][key]
     reg[name] = cfg
     _save_registry(reg)
     pubsub.create_topic(cfg["topic"])

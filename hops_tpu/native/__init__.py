@@ -1,0 +1,47 @@
+"""Native (C++) runtime components.
+
+The reference's native substrate (libhdfs storage driver, MySQL-NDB
+online store, JVM/Spark runtime) lived outside the repo (SURVEY.md §2,
+"implied native"). The TPU build ships its own: C++ engines compiled to
+a shared library (``libhops_native.so``) reached via ``ctypes`` — no
+pybind11 dependency. Each binding degrades to a pure-Python fallback
+when the library hasn't been built, so the framework works everywhere
+and goes fast where it matters.
+
+Build: ``make -C hops_tpu/native`` (or ``python -m hops_tpu.native.build``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+
+_LIB_NAME = "libhops_native.so"
+_lib: ctypes.CDLL | None = None
+_load_attempted = False
+
+
+def lib_path() -> Path:
+    return Path(__file__).parent / _LIB_NAME
+
+
+def load() -> ctypes.CDLL | None:
+    """Load the native library once; None if not built/loadable."""
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("HOPS_TPU_DISABLE_NATIVE"):
+        return None
+    p = lib_path()
+    if p.exists():
+        try:
+            _lib = ctypes.CDLL(str(p))
+        except OSError:
+            _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None

@@ -1,0 +1,120 @@
+"""ctypes binding for the native KV engine (kvstore.cc).
+
+Exposes the same backend protocol as ``online._SqliteKV`` so
+``OnlineStore`` can swap engines transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Iterator
+
+from hops_tpu import native
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    c = ctypes.c_char_p
+    u32, u64 = ctypes.c_uint32, ctypes.c_uint64
+    lib.kv_open.restype = ctypes.c_void_p
+    lib.kv_open.argtypes = [c]
+    lib.kv_put.restype = ctypes.c_int
+    lib.kv_put.argtypes = [ctypes.c_void_p, c, u32, c, u32]
+    lib.kv_get.restype = ctypes.c_int
+    lib.kv_get.argtypes = [
+        ctypes.c_void_p, c, u32,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char)), ctypes.POINTER(u32),
+    ]
+    lib.kv_delete.restype = ctypes.c_int
+    lib.kv_delete.argtypes = [ctypes.c_void_p, c, u32]
+    lib.kv_count.restype = u64
+    lib.kv_count.argtypes = [ctypes.c_void_p]
+    lib.kv_flush.argtypes = [ctypes.c_void_p]
+    lib.kv_compact.restype = ctypes.c_int64
+    lib.kv_compact.argtypes = [ctypes.c_void_p]
+    lib.kv_scan.restype = ctypes.c_void_p
+    lib.kv_scan.argtypes = [ctypes.c_void_p]
+    lib.kv_scan_next.restype = ctypes.c_int
+    lib.kv_scan_next.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char)), ctypes.POINTER(u32),
+    ]
+    lib.kv_scan_close.argtypes = [ctypes.c_void_p]
+    lib.kv_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.kv_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_bound: ctypes.CDLL | None = None
+
+
+def _lib() -> ctypes.CDLL:
+    global _bound
+    if _bound is None:
+        raw = native.load()
+        if raw is None:
+            raise RuntimeError(
+                "native library not built; run `make -C hops_tpu/native`"
+            )
+        _bound = _bind(raw)
+    return _bound
+
+
+def available() -> bool:
+    return native.available()
+
+
+class NativeKV:
+    def __init__(self, path: str):
+        self._lib = _lib()
+        self._h = self._lib.kv_open(path.encode())
+        if not self._h:
+            raise OSError(f"kv_open failed for {path}")
+
+    def put(self, key: str, value: str) -> None:
+        k, v = key.encode(), value.encode()
+        rc = self._lib.kv_put(self._h, k, len(k), v, len(v))
+        if rc != 0:
+            raise OSError(f"kv_put failed (rc={rc})")
+
+    def get(self, key: str) -> str | None:
+        k = key.encode()
+        out = ctypes.POINTER(ctypes.c_char)()
+        out_len = ctypes.c_uint32()
+        rc = self._lib.kv_get(self._h, k, len(k), ctypes.byref(out), ctypes.byref(out_len))
+        if rc != 0:
+            return None
+        try:
+            return ctypes.string_at(out, out_len.value).decode()
+        finally:
+            self._lib.kv_free(out)
+
+    def delete(self, key: str) -> None:
+        k = key.encode()
+        self._lib.kv_delete(self._h, k, len(k))
+
+    def scan(self) -> Iterator[str]:
+        it = self._lib.kv_scan(self._h)
+        try:
+            out = ctypes.POINTER(ctypes.c_char)()
+            out_len = ctypes.c_uint32()
+            while self._lib.kv_scan_next(it, ctypes.byref(out), ctypes.byref(out_len)) == 0:
+                try:
+                    yield ctypes.string_at(out, out_len.value).decode()
+                finally:
+                    self._lib.kv_free(out)
+        finally:
+            self._lib.kv_scan_close(it)
+
+    def count(self) -> int:
+        return int(self._lib.kv_count(self._h))
+
+    def compact(self) -> int:
+        return int(self._lib.kv_compact(self._h))
+
+    def flush(self) -> None:
+        self._lib.kv_flush(self._h)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kv_close(self._h)
+            self._h = None

@@ -1,0 +1,158 @@
+"""ctypes binding for the native record-IO engine (recordio.cc), with a
+pure-Python fallback implementing the same on-disk format."""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from pathlib import Path
+from typing import Iterator
+
+from hops_tpu import native
+
+_HDR = struct.Struct("<I")
+_IDX = struct.Struct("<Q")
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u32, u64 = ctypes.c_uint32, ctypes.c_uint64
+    lib.rio_writer_open.restype = ctypes.c_void_p
+    lib.rio_writer_open.argtypes = [ctypes.c_char_p]
+    lib.rio_write.restype = ctypes.c_int
+    lib.rio_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p, u32]
+    lib.rio_writer_close.restype = u64
+    lib.rio_writer_close.argtypes = [ctypes.c_void_p]
+    lib.rio_reader_open.restype = ctypes.c_void_p
+    lib.rio_reader_open.argtypes = [ctypes.c_char_p]
+    lib.rio_num_records.restype = u64
+    lib.rio_num_records.argtypes = [ctypes.c_void_p]
+    lib.rio_read.restype = ctypes.c_int
+    lib.rio_read.argtypes = [
+        ctypes.c_void_p, u64,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char)), ctypes.POINTER(u32),
+    ]
+    lib.rio_free.argtypes = [ctypes.POINTER(ctypes.c_char)]
+    lib.rio_reader_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_bound: ctypes.CDLL | None = None
+
+
+def _lib() -> ctypes.CDLL | None:
+    global _bound
+    if _bound is None and native.available():
+        _bound = _bind(native.load())
+    return _bound
+
+
+class RecordWriter:
+    """Append records; index written on close."""
+
+    def __init__(self, path: str | Path):
+        self._path = str(path)
+        lib = _lib()
+        if lib is not None:
+            self._h, self._lib = lib.rio_writer_open(self._path.encode()), lib
+            if not self._h:
+                raise OSError(f"rio_writer_open failed for {path}")
+        else:
+            self._lib = None
+            self._f = open(self._path, "wb")
+            self._offsets: list[int] = []
+            self._pos = 0
+
+    def write(self, record: bytes) -> None:
+        if self._lib is not None:
+            if self._lib.rio_write(self._h, record, len(record)) != 0:
+                raise OSError("rio_write failed")
+        else:
+            self._f.write(_HDR.pack(len(record)))
+            self._f.write(record)
+            self._offsets.append(self._pos)
+            self._pos += _HDR.size + len(record)
+
+    def close(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.rio_writer_close(self._h))
+        self._f.close()
+        with open(self._path + ".idx", "wb") as idx:
+            for off in self._offsets:
+                idx.write(_IDX.pack(off))
+        return len(self._offsets)
+
+    def __enter__(self) -> "RecordWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class RecordReader:
+    """O(1) random access over a record file."""
+
+    def __init__(self, path: str | Path):
+        self._path = str(path)
+        lib = _lib()
+        if lib is not None:
+            self._h, self._lib = lib.rio_reader_open(self._path.encode()), lib
+            if not self._h:
+                raise OSError(f"rio_reader_open failed for {path}")
+            self._n = int(lib.rio_num_records(self._h))
+        else:
+            self._lib = None
+            self._f = open(self._path, "rb")
+            idx = Path(self._path + ".idx")
+            if idx.exists():
+                raw = idx.read_bytes()
+                self._offsets = [
+                    _IDX.unpack_from(raw, i * _IDX.size)[0]
+                    for i in range(len(raw) // _IDX.size)
+                ]
+            else:
+                self._offsets = []
+                pos = 0
+                while True:
+                    self._f.seek(pos)
+                    hdr = self._f.read(_HDR.size)
+                    if len(hdr) < _HDR.size:
+                        break
+                    self._offsets.append(pos)
+                    pos += _HDR.size + _HDR.unpack(hdr)[0]
+            self._n = len(self._offsets)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def read(self, i: int) -> bytes:
+        if self._lib is not None:
+            out = ctypes.POINTER(ctypes.c_char)()
+            out_len = ctypes.c_uint32()
+            rc = self._lib.rio_read(self._h, i, ctypes.byref(out), ctypes.byref(out_len))
+            if rc != 0:
+                raise IndexError(f"record {i} (rc={rc})")
+            try:
+                return ctypes.string_at(out, out_len.value)
+            finally:
+                self._lib.rio_free(out)
+        off = self._offsets[i]
+        self._f.seek(off)
+        (length,) = _HDR.unpack(self._f.read(_HDR.size))
+        return self._f.read(length)
+
+    def __iter__(self) -> Iterator[bytes]:
+        return (self.read(i) for i in range(self._n))
+
+    def close(self) -> None:
+        if self._lib is not None:
+            if self._h:
+                self._lib.rio_reader_close(self._h)
+                self._h = None
+        else:
+            self._f.close()
+
+    def __enter__(self) -> "RecordReader":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,415 @@
+"""Feature-store layer tests (reference capabilities: SURVEY.md §2.6).
+
+Golden behaviors mirrored from the reference notebooks:
+feature_engineering / feature_exploration / time_travel_python /
+training_datasets / feature_validation_python / feature_store_tags.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import hops_tpu.featurestore as hsfs
+from hops_tpu.featurestore.validation import DataValidationError, Rule
+
+
+@pytest.fixture
+def fs(workspace):
+    return hsfs.connection().get_feature_store()
+
+
+def sales_df():
+    return pd.DataFrame({
+        "store_id": [1, 2, 3, 4],
+        "sales": [10.0, 20.0, 30.0, 40.0],
+        "region": ["n", "s", "n", "w"],
+    })
+
+
+def make_fg(fs, name="sales", online=False, **kw):
+    fg = fs.create_feature_group(name, version=1, primary_key=["store_id"],
+                                 online_enabled=online, **kw)
+    fg.save(sales_df())
+    return fg
+
+
+class TestFeatureGroup:
+    def test_save_and_read(self, fs):
+        fg = make_fg(fs)
+        df = fg.read()
+        assert len(df) == 4
+        assert set(df.columns) == {"store_id", "sales", "region"}
+
+    def test_schema_inferred(self, fs):
+        fg = make_fg(fs)
+        types = {f.name: f.type for f in fg.features}
+        assert types["store_id"] == "bigint"
+        assert types["sales"] == "double"
+        assert types["region"] == "string"
+        assert fg.get_feature("store_id").primary
+
+    def test_get_feature_group_roundtrip(self, fs):
+        make_fg(fs)
+        fg = fs.get_feature_group("sales", 1)
+        assert fg.primary_key == ["store_id"]
+        assert len(fg.read()) == 4
+
+    def test_versioning(self, fs):
+        make_fg(fs)
+        fg2 = fs.create_feature_group("sales", primary_key=["store_id"])
+        assert fg2.version == 2
+        fg2.save(sales_df())
+        assert fs.get_feature_group("sales").version == 2
+
+    def test_upsert_semantics(self, fs):
+        """time_travel_python.ipynb:695 — insert() upserts by primary key."""
+        fg = make_fg(fs)
+        fg.insert(pd.DataFrame({"store_id": [1, 9], "sales": [99.0, 9.0],
+                                "region": ["n", "e"]}))
+        df = fg.read().set_index("store_id")
+        assert len(df) == 5
+        assert df.loc[1, "sales"] == 99.0
+
+    def test_delete_record(self, fs):
+        fg = make_fg(fs)
+        fg.commit_delete_record(pd.DataFrame({"store_id": [2]}))
+        assert sorted(fg.read()["store_id"]) == [1, 3, 4]
+
+    def test_insert_overwrite(self, fs):
+        fg = make_fg(fs)
+        fg.insert(pd.DataFrame({"store_id": [7], "sales": [1.0], "region": ["x"]}),
+                  overwrite=True)
+        assert list(fg.read()["store_id"]) == [7]
+
+    def test_commit_details_and_time_travel(self, fs):
+        """time_travel_python.ipynb:432,1222 — commit_details + as_of."""
+        fg = make_fg(fs)
+        details1 = fg.commit_details()
+        assert len(details1) == 1
+        first_commit = list(details1)[0]
+        assert details1[first_commit]["rowsInserted"] == 4
+        fg.insert(pd.DataFrame({"store_id": [1, 9], "sales": [99.0, 9.0],
+                                "region": ["n", "e"]}))
+        details2 = fg.commit_details()
+        assert len(details2) == 2
+        last = details2[list(details2)[-1]]
+        assert last["rowsUpdated"] == 1 and last["rowsInserted"] == 1
+        # read as of the first commit: pre-upsert state
+        old = fg.read(wallclock_time=first_commit).set_index("store_id")
+        assert len(old) == 4 and old.loc[1, "sales"] == 10.0
+
+    def test_read_changes_incremental(self, fs):
+        fg = make_fg(fs)
+        c1 = list(fg.commit_details())[0]
+        fg.insert(pd.DataFrame({"store_id": [9], "sales": [9.0], "region": ["e"]}))
+        c2 = list(fg.commit_details())[-1]
+        changes = fg.read_changes(c1, c2)
+        assert list(changes["store_id"]) == [9]
+
+    def test_statistics(self, fs):
+        fg = make_fg(fs, statistics_config={"enabled": True, "histograms": True,
+                                            "correlations": True})
+        stats = fg.get_statistics()
+        assert stats["row_count"] == 4
+        assert stats["features"]["sales"]["mean"] == 25.0
+        assert "histogram" in stats["features"]["sales"]
+        assert "correlations" in stats
+
+    def test_tags(self, fs):
+        """feature_store_tags.ipynb cells 16-28."""
+        fg = make_fg(fs)
+        fg.add_tag("owner", {"team": "ml", "pii": False})
+        assert fg.get_tag("owner")["team"] == "ml"
+        assert "owner" in fg.get_tags()
+        fg.delete_tag("owner")
+        assert fg.get_tag("owner") is None
+
+
+class TestQuery:
+    def test_select_filter(self, fs):
+        fg = make_fg(fs)
+        df = fg.select(["store_id", "sales"]).filter(fg["sales"] > 15).read()
+        assert list(df.columns) == ["store_id", "sales"]
+        assert sorted(df["store_id"]) == [2, 3, 4]
+
+    def test_compound_filter(self, fs):
+        fg = make_fg(fs)
+        df = fg.select_all().filter((fg["sales"] > 15) & (fg["region"] == "n")).read()
+        assert list(df["store_id"]) == [3]
+        df = fg.select_all().filter((fg["sales"] >= 40) | (fg["region"] == "n")).read()
+        assert sorted(df["store_id"]) == [1, 3, 4]
+
+    def test_join_on_shared_pk(self, fs):
+        """feature_exploration.ipynb cell 27: default join on shared PK."""
+        make_fg(fs)
+        fg1 = fs.get_feature_group("sales", 1)
+        fg2 = fs.create_feature_group("stores", version=1, primary_key=["store_id"])
+        fg2.save(pd.DataFrame({"store_id": [1, 2, 3], "size": [5, 6, 7]}))
+        df = fg1.select(["store_id", "sales"]).join(fg2.select(["size"])).read()
+        assert len(df) == 3  # inner join drops store 4
+        assert set(df.columns) >= {"store_id", "sales", "size"}
+
+    def test_join_types_and_keys(self, fs):
+        fg1 = make_fg(fs)
+        fg2 = fs.create_feature_group("alt", version=1, primary_key=["sid"])
+        fg2.save(pd.DataFrame({"sid": [1, 2], "bonus": [0.1, 0.2]}))
+        df = fg1.select_all().join(fg2.select_all(), left_on=["store_id"],
+                                   right_on=["sid"], join_type="left").read()
+        assert len(df) == 4
+        assert df["bonus"].isna().sum() == 2
+
+    def test_query_as_of(self, fs):
+        fg = make_fg(fs)
+        c1 = list(fg.commit_details())[0]
+        fg.insert(pd.DataFrame({"store_id": [1], "sales": [99.0], "region": ["n"]}))
+        df = fg.select_all().as_of(c1).read()
+        assert df.set_index("store_id").loc[1, "sales"] == 10.0
+
+    def test_query_serialization_roundtrip(self, fs):
+        fg = make_fg(fs)
+        q = fg.select(["store_id", "sales"]).filter(fg["sales"] > 15)
+        d = q.to_dict()
+        q2 = hsfs.Query.from_dict(fs, {"feature_group": d["feature_group"],
+                                       "features": d["features"], "joins": [],
+                                       "as_of": None})
+        assert len(q2.read()) == 4  # filters don't serialize; base query does
+
+    def test_to_string(self, fs):
+        fg = make_fg(fs)
+        s = fg.select(["sales"]).to_string()
+        assert "SELECT sales FROM sales_1" in s
+
+
+class TestOnline:
+    def test_online_write_and_serving_row(self, fs):
+        fg = make_fg(fs, online=True)
+        assert fg.get_serving_row({"store_id": 2})["sales"] == 20.0
+
+    def test_online_upsert_latest_wins(self, fs):
+        fg = make_fg(fs, online=True)
+        fg.insert(pd.DataFrame({"store_id": [2], "sales": [77.0], "region": ["s"]}))
+        assert fg.get_serving_row({"store_id": 2})["sales"] == 77.0
+
+    def test_online_read(self, fs):
+        fg = make_fg(fs, online=True)
+        assert len(fg.read(online=True)) == 4
+
+
+class TestValidation:
+    def test_rules_catalog(self, fs):
+        conn = hsfs.connection()
+        names = {r["name"] for r in conn.get_rules()}
+        assert {"HAS_MIN", "HAS_MAX", "IS_CONTAINED_IN"} <= names
+        assert conn.get_rule("HAS_MIN")["name"] == "HAS_MIN"
+
+    def test_expectation_warning(self, fs):
+        """feature_validation_python.ipynb:304-311,448."""
+        fg = make_fg(fs)
+        fs.create_expectation(
+            "sales_bounds", features=["sales"],
+            rules=[Rule(name="HAS_MIN", level="WARNING", min=15)]).save()
+        fg.attach_expectation("sales_bounds")
+        report = fg.validate()
+        assert report["status"] == "WARNING"  # min sales is 10 < 15
+        assert fg.get_validations()
+
+    def test_strict_insert_blocked(self, fs):
+        fg = fs.create_feature_group(
+            "gated", version=1, primary_key=["store_id"],
+            validation_type="STRICT", expectations=["nonneg"])
+        fs.create_expectation(
+            "nonneg", features=["sales"],
+            rules=[Rule(name="HAS_MIN", level="ERROR", min=0)]).save()
+        fg.save(sales_df())  # passes
+        with pytest.raises(DataValidationError):
+            fg.insert(pd.DataFrame({"store_id": [5], "sales": [-1.0], "region": ["x"]}))
+
+    def test_contained_in_and_size(self, fs):
+        fg = make_fg(fs)
+        fs.create_expectation("shape", features=["region"], rules=[
+            Rule(name="IS_CONTAINED_IN", level="ERROR", legal_values=["n", "s", "w"]),
+            Rule(name="HAS_SIZE", level="ERROR", min=1, max=100),
+        ]).save()
+        fg.attach_expectation("shape")
+        assert fg.validate()["status"] == "SUCCESS"
+
+
+class TestTrainingDataset:
+    def make_td(self, fs, fmt="parquet", **kw):
+        fg = make_fg(fs)
+        td = fs.create_training_dataset("tds", version=1, data_format=fmt,
+                                        label=["sales"], **kw)
+        td.save(fg.select(["store_id", "sales"]))
+        return td
+
+    def test_save_and_read(self, fs):
+        td = self.make_td(fs)
+        df = td.read()
+        assert len(df) == 4
+
+    def test_splits(self, fs):
+        """training_datasets.ipynb cell 10: fractional splits."""
+        fg = make_fg(fs)
+        big = pd.DataFrame({"store_id": range(100), "sales": np.arange(100.0),
+                            "region": ["n"] * 100})
+        fg.insert(big)
+        td = fs.create_training_dataset("split_td", version=1,
+                                        splits={"train": 0.7, "test": 0.3}, seed=42)
+        td.save(fg.select_all())
+        train, test = td.read("train"), td.read("test")
+        assert len(train) + len(test) >= 100  # 4 original + 96 new upserted
+        assert abs(len(train) / (len(train) + len(test)) - 0.7) < 0.05
+
+    def test_csv_and_recordio_formats(self, fs):
+        for fmt in ("csv", "recordio"):
+            fg = fs.get_feature_group("sales") if fmt != "csv" else make_fg(fs)
+            td = fs.create_training_dataset(f"td_{fmt}", version=1, data_format=fmt)
+            td.save(fg.select_all())
+            assert len(td.read()) == 4
+
+    def test_query_replay(self, fs):
+        td = self.make_td(fs)
+        td2 = fs.get_training_dataset("tds", 1)
+        q = td2.query
+        assert q is not None
+        assert len(q.read()) == 4
+
+    def test_numpy_feeder(self, fs):
+        td = self.make_td(fs)
+        feeder = td.tf_data(target_name="sales")
+        batches = list(feeder.numpy_iterator(batch_size=2, num_epochs=2, seed=1))
+        assert len(batches) == 4  # 4 rows / bs 2 * 2 epochs
+        x, y = batches[0]
+        assert x.shape == (2, 1) and y.shape == (2,)
+        assert x.dtype == np.float32
+
+    def test_feeder_infinite_and_transform(self, fs):
+        td = self.make_td(fs)
+        it = td.tf_data(target_name="sales").numpy_iterator(
+            batch_size=2, num_epochs=None,
+            transform=lambda x, y: {"image": x, "label": y})
+        b = next(it)
+        assert set(b) == {"image", "label"}
+
+    def test_tags(self, fs):
+        td = self.make_td(fs)
+        td.add_tag("purpose", "unit-test")
+        assert td.get_tag("purpose") == "unit-test"
+
+
+class TestServingVector:
+    def test_get_serving_vector(self, fs):
+        """feature_vector_model_serving.ipynb:175-196."""
+        fg = fs.create_feature_group("olfg", version=1, primary_key=["store_id"],
+                                     online_enabled=True)
+        fg.save(sales_df())
+        td = fs.create_training_dataset("serve_td", version=1, label=["sales"])
+        td.save(fg.select(["store_id", "sales", "region"]))
+        td.init_prepared_statement()
+        assert td.serving_keys == ["store_id"]
+        vec = td.get_serving_vector({"store_id": 3})
+        # feature order minus label: [store_id, region]
+        assert vec == [3, "n"]
+        vecs = td.get_serving_vectors([{"store_id": 1}, {"store_id": 2}])
+        assert len(vecs) == 2
+
+
+class TestOnDemandAndSQL:
+    def test_sql_over_feature_groups(self, fs):
+        make_fg(fs)
+        df = fs.sql("SELECT region, SUM(sales) AS total FROM sales GROUP BY region "
+                    "ORDER BY total DESC")
+        assert df.iloc[0]["region"] in ("n", "w")
+        assert df["total"].sum() == 100.0
+
+    def test_sql_version_pinned(self, fs):
+        make_fg(fs)
+        df = fs.sql("SELECT COUNT(*) AS n FROM sales_1")
+        assert df["n"][0] == 4
+
+    def test_on_demand_feature_group(self, fs):
+        make_fg(fs)
+        odfg = fs.create_on_demand_feature_group(
+            "sales_agg", version=1,
+            query="SELECT region, SUM(sales) AS total FROM sales GROUP BY region")
+        odfg.save()
+        assert len(odfg.read()) == 3
+        got = fs.get_feature_group("sales_agg", 1)
+        assert len(got.read()) == 3
+
+    def test_dbapi_cursor(self, fs):
+        make_fg(fs)
+        conn = __import__("hops_tpu.sql", fromlist=["connection"]).connection(fs)
+        cur = conn.cursor()
+        cur.execute("SELECT store_id FROM sales ORDER BY store_id")
+        assert [r[0] for r in cur.fetchall()] == [1, 2, 3, 4]
+
+
+class TestConnectors:
+    def test_hopsfs_connector(self, fs, workspace):
+        import pandas as pd
+        from hops_tpu.runtime import fs as hfs
+
+        p = hfs.project_path("Resources/ext.csv")
+        __import__("pathlib").Path(p).parent.mkdir(parents=True, exist_ok=True)
+        pd.DataFrame({"a": [1, 2]}).to_csv(p, index=False)
+        c = fs.create_storage_connector("local", "HOPSFS", path="Resources")
+        got = fs.get_storage_connector("local")
+        assert len(got.read(path="ext.csv")) == 2
+
+    def test_snowflake_options(self, fs):
+        fs.create_storage_connector("snow", "SNOWFLAKE", url="u", user="x",
+                                    database="db", schema="s", warehouse="w")
+        c = fs.get_storage_connector("snow")
+        opts = c.snowflake_connector_options()
+        assert opts["sfURL"] == "u" and opts["sfDatabase"] == "db"
+        with pytest.raises(RuntimeError):
+            c.read()
+
+    def test_unknown_connector(self, fs):
+        with pytest.raises(KeyError):
+            fs.get_storage_connector("nope")
+
+
+class TestReviewRegressions:
+    """Regressions for code-review findings on the featurestore layer."""
+
+    def test_overwrite_purges_online_store(self, fs):
+        fg = make_fg(fs, online=True)
+        fg.insert(pd.DataFrame({"store_id": [7], "sales": [1.0], "region": ["x"]}),
+                  overwrite=True)
+        assert fg.get_serving_row({"store_id": 2}) is None
+        assert fg.get_serving_row({"store_id": 7})["sales"] == 1.0
+
+    def test_split_never_drops_rows(self, fs):
+        fg = make_fg(fs)
+        fg.insert(pd.DataFrame({"store_id": range(100, 746),
+                                "sales": np.arange(646.0),
+                                "region": ["n"] * 646}))
+        td = fs.create_training_dataset(
+            "rounding_td", version=1,
+            splits={"train": 0.25164698, "test": 0.74835302}, seed=3)
+        td.save(fg.select_all())
+        total = len(td.read("train")) + len(td.read("test"))
+        assert total == len(fg.read())
+
+    def test_as_of_int_replay(self, fs):
+        fg = make_fg(fs)
+        c1 = list(fg.commit_details())[0]
+        fg.insert(pd.DataFrame({"store_id": [1], "sales": [99.0], "region": ["n"]}))
+        td = fs.create_training_dataset("asof_td", version=1)
+        td.save(fg.select_all().as_of(c1))
+        replay = fs.get_training_dataset("asof_td", 1).query
+        df = replay.read().set_index("store_id")
+        assert df.loc[1, "sales"] == 10.0
+
+    def test_strict_fg_can_delete(self, fs):
+        fg = fs.create_feature_group("strictdel", version=1,
+                                     primary_key=["store_id"],
+                                     validation_type="STRICT",
+                                     expectations=["del_amt"])
+        fs.create_expectation("del_amt", features=["sales"],
+                              rules=[Rule(name="HAS_MIN", level="ERROR", min=0)]).save()
+        fg.save(sales_df())
+        fg.commit_delete_record(pd.DataFrame({"store_id": [1]}))
+        assert sorted(fg.read()["store_id"]) == [2, 3, 4]
