@@ -62,8 +62,7 @@ class FeatureStore:
 
     def create_feature_group(self, name: str, version: int | None = None, **kwargs) -> FeatureGroup:
         if version is None:
-            existing = storage.list_versions("featuregroups", name)
-            version = (existing[-1] + 1) if existing else 1
+            version = storage.next_version("featuregroups", name)
         return FeatureGroup(self, name, version, **kwargs)
 
     def get_feature_group(self, name: str, version: int | None = None) -> FeatureGroup:
@@ -93,8 +92,7 @@ class FeatureStore:
         storage_connector=None, **kwargs
     ) -> OnDemandFeatureGroup:
         if version is None:
-            existing = storage.list_versions("featuregroups", name)
-            version = (existing[-1] + 1) if existing else 1
+            version = storage.next_version("featuregroups", name)
         return OnDemandFeatureGroup(
             self, name, version, query=query, storage_connector=storage_connector, **kwargs
         )
@@ -103,8 +101,7 @@ class FeatureStore:
 
     def create_training_dataset(self, name: str, version: int | None = None, **kwargs) -> TrainingDataset:
         if version is None:
-            existing = storage.list_versions("trainingdatasets", name)
-            version = (existing[-1] + 1) if existing else 1
+            version = storage.next_version("trainingdatasets", name)
         return TrainingDataset(self, name, version, **kwargs)
 
     def get_training_dataset(self, name: str, version: int | None = None) -> TrainingDataset:

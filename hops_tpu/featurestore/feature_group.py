@@ -164,7 +164,11 @@ class FeatureGroup:
         # Deletes carry only the primary key — expectations don't apply.
         if operation != "delete":
             self._validate_on_write(df)
-        before = storage.read_as_of(self.dir, self.primary_key) if self.primary_key else None
+        # ``before`` feeds both the upsert bookkeeping (needs a primary key)
+        # and post-commit statistics (needed even for keyless append FGs,
+        # where stats must describe the full table, not just this commit).
+        need_before = bool(self.primary_key) or self.statistics_config.enabled
+        before = storage.read_as_of(self.dir, self.primary_key) if need_before else None
         cid = storage.write_commit(self.dir, df, operation=operation)
         # Commit bookkeeping mirrors the reference's commit_details fields.
         if operation == "delete":

@@ -36,17 +36,23 @@ class DataFeeder:
         """Whole split as (X, y) float arrays (small-data path).
 
         Non-numeric features are integer-encoded against the sorted
-        vocabulary of the column — deterministic, so train/test splits
-        of the same TD agree on the encoding.
+        vocabulary of the column across the WHOLE training dataset (all
+        splits), so train/test splits of the same TD agree on the
+        encoding even when a split is missing some categories.
         """
         df = self._td.read(split=self.split)
+        full = None  # lazy: only read the unsplit TD if a column needs a vocab
         cols = []
         for name in self.feature_names:
             s = df[name]
             try:
                 col = s.to_numpy(dtype=np.float32)
             except (ValueError, TypeError):
-                vocab = {v: i for i, v in enumerate(sorted(s.astype(str).unique()))}
+                if full is None:
+                    full = df if self.split is None else self._td.read(split=None)
+                vocab = {
+                    v: i for i, v in enumerate(sorted(full[name].astype(str).unique()))
+                }
                 col = s.astype(str).map(vocab).to_numpy(dtype=np.float32)
             cols.append(col)
         x = np.stack(cols, axis=1) if cols else np.zeros((len(df), 0), np.float32)

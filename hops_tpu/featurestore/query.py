@@ -100,29 +100,52 @@ class Query:
 
     # -- execution ------------------------------------------------------------
 
-    def _base_frame(self) -> pd.DataFrame:
-        df = self._fg.read(wallclock_time=self._as_of)
+    def _base_frame(self, as_of) -> pd.DataFrame:
+        df = self._fg.read(wallclock_time=as_of)
         if df.empty:
             return pd.DataFrame(columns=[f.name for f in self._fg.features])
         return df
 
+    def _output_columns(self) -> list[str]:
+        """Merged-frame column names of the selected features, in order,
+        accounting for join-key dedup, prefixes, and pandas' collision
+        suffix ("_right")."""
+        cols = [f.name for f in self._features]
+        for j in self._joins:
+            key_cols = set(j.on or j.right_on)
+            for c in j.query._output_columns():
+                if j.on and c in key_cols:
+                    if c not in cols:
+                        cols.append(c)  # merge keeps one copy under the key name
+                    continue
+                if j.prefix and c not in key_cols:
+                    c = f"{j.prefix}{c}"
+                cols.append(c if c not in cols else f"{c}_right")
+        return cols
+
     def read(self, online: bool = False, dataframe_type: str = "pandas",
-             _extra_keep: tuple = ()) -> pd.DataFrame:
-        df = self._base_frame()
-        # Columns needed downstream: selected + join keys + filter columns
-        # (+ keys a parent join needs from this side).
-        keep = {f.name for f in self._features} | set(_extra_keep)
+             _extra_keep: tuple = (), _as_of=None, _project: bool = True) -> pd.DataFrame:
+        # as_of flows down from the root read without mutating children, so
+        # a shared sub-query is unaffected by a parent's point-in-time read.
+        as_of = self._as_of if self._as_of is not None else _as_of
+        df = self._base_frame(as_of)
+        # Columns needed for execution: selected + join keys + filter columns
+        # (+ anything a parent needs from this side: its join keys AND its
+        # filter columns, which may live in this group or deeper).
+        filter_cols: set[str] = set()
+        for cond in self._filters:
+            filter_cols.update(_condition_columns(cond))
+        keep = {f.name for f in self._features} | set(_extra_keep) | filter_cols
         for j in self._joins:
             keep.update(j.on or j.left_on)
-        for cond in self._filters:
-            keep.update(_condition_columns(cond))
         df = df[[c for c in df.columns if c in keep]]
 
+        pass_down = tuple(filter_cols) + tuple(_extra_keep)
         for j in self._joins:
             right_keys = tuple(j.on or j.right_on)
-            if self._as_of:
-                j.query.as_of(self._as_of)
-            right = j.query.read(_extra_keep=right_keys)
+            right = j.query.read(
+                _extra_keep=right_keys + pass_down, _as_of=as_of, _project=False
+            )
             if j.prefix:
                 key_cols = set(j.on or j.right_on)
                 right = right.rename(
@@ -137,6 +160,11 @@ class Query:
 
         for cond in self._filters:
             df = df[cond.evaluate(df)]
+        if _project:
+            # Drop execution-only columns (filter cols, join keys) so the
+            # result — and any TD schema derived from it — is exactly the
+            # selection.
+            df = df[[c for c in self._output_columns() if c in df.columns]]
         return df.reset_index(drop=True)
 
     def show(self, n: int = 5, online: bool = False) -> pd.DataFrame:

@@ -56,6 +56,11 @@ def list_versions(kind: str, name: str) -> list[int]:
     return sorted(out)
 
 
+def next_version(kind: str, name: str) -> int:
+    versions = list_versions(kind, name)
+    return (versions[-1] + 1) if versions else 1
+
+
 def read_metadata(d: Path) -> dict:
     return json.loads((d / "metadata.json").read_text())
 

@@ -19,7 +19,6 @@ from pathlib import Path
 
 _LIB_NAME = "libhops_native.so"
 _lib: ctypes.CDLL | None = None
-_load_attempted = False
 
 
 def lib_path() -> Path:
@@ -27,11 +26,15 @@ def lib_path() -> Path:
 
 
 def load() -> ctypes.CDLL | None:
-    """Load the native library once; None if not built/loadable."""
-    global _lib, _load_attempted
-    if _load_attempted:
+    """Load the native library; None if not built/loadable.
+
+    Only successful loads are cached: a missing library is re-checked on
+    the next call, so building ``libhops_native.so`` mid-process (as the
+    test suite does) takes effect without an interpreter restart.
+    """
+    global _lib
+    if _lib is not None:
         return _lib
-    _load_attempted = True
     if os.environ.get("HOPS_TPU_DISABLE_NATIVE"):
         return None
     p = lib_path()

@@ -14,6 +14,8 @@
 //
 // C ABI only (consumed via ctypes — no pybind11 in the image).
 
+#include <unistd.h>
+
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -74,6 +76,13 @@ bool rebuild_index(Store* s) {
     }
   }
   s->end = pos;
+  if (pos < file_size) {
+    // Torn tail: cut it off. Leaving the garbage in place would let a
+    // shorter subsequent append partially overwrite it, and the NEXT
+    // reopen could then parse the leftover bytes as phantom records.
+    std::fflush(s->f);
+    if (ftruncate(fileno(s->f), (off_t)pos) != 0) return false;
+  }
   return true;
 }
 

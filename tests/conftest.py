@@ -11,8 +11,19 @@ scope here.
 """
 
 import os
+import subprocess
 
+from hops_tpu import native as _native
 from hops_tpu.runtime import devices as _devices
+
+# Build the native engines up front: the .so is gitignored, so a fresh
+# checkout starts without it, and tests that import native-backed modules
+# (featurestore.online) run before test_native's own fixture would build it.
+if not _native.lib_path().exists():
+    subprocess.run(
+        ["make", "-C", str(_native.lib_path().parent)], check=False,
+        capture_output=True,
+    )
 
 os.environ.update(_devices.fake_mesh_env(8))
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")

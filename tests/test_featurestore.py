@@ -413,3 +413,68 @@ class TestReviewRegressions:
         fg.save(sales_df())
         fg.commit_delete_record(pd.DataFrame({"store_id": [1]}))
         assert sorted(fg.read()["store_id"]) == [2, 3, 4]
+
+    def test_filter_on_joined_unselected_column(self, fs):
+        """A parent filter referencing a joined group's column must work
+        even when that column is not in the joined query's selection."""
+        make_fg(fs)
+        stores = fs.create_feature_group("stores", version=1, primary_key=["store_id"])
+        stores.save(pd.DataFrame({"store_id": [1, 2, 3, 4],
+                                  "size": [5, 50, 500, 5000],
+                                  "city": ["a", "b", "c", "d"]}))
+        fg = fs.get_feature_group("sales")
+        q = fg.select_all().join(stores.select(["city"])).filter(stores["size"] > 100)
+        df = q.read()
+        assert sorted(df["store_id"]) == [3, 4]
+        # projection: the execution-only filter column is not in the result
+        assert "size" not in df.columns and "city" in df.columns
+
+    def test_result_projected_to_selection(self, fs):
+        fg = make_fg(fs)
+        df = fg.select(["store_id"]).filter(fg["sales"] > 15).read()
+        assert list(df.columns) == ["store_id"]
+        assert sorted(df["store_id"]) == [2, 3, 4]
+
+    def test_as_of_does_not_mutate_subquery(self, fs):
+        fg = make_fg(fs)
+        stores = fs.create_feature_group("stores2", version=1, primary_key=["store_id"])
+        stores.save(pd.DataFrame({"store_id": [1, 2, 3, 4], "size": [1, 2, 3, 4]}))
+        c1 = list(stores.commit_details())[0]
+        sub = stores.select_all()
+        fg.select_all().join(sub).as_of(c1).read()
+        stores.insert(pd.DataFrame({"store_id": [9], "size": [9]}))
+        # an independent read of the shared sub-query must see latest data
+        assert 9 in sub.read()["store_id"].values
+
+    def test_keyless_fg_statistics_cover_full_table(self, fs):
+        fg = fs.create_feature_group(
+            "events", version=1,
+            statistics_config={"enabled": True, "histograms": False,
+                               "correlations": False})
+        fg.save(pd.DataFrame({"v": [1.0, 2.0]}))
+        fg.insert(pd.DataFrame({"v": [3.0]}))
+        stats = fg.get_statistics()
+        assert stats["row_count"] == 3  # full table, not just the last commit
+
+    def test_split_categorical_encoding_consistent(self, fs):
+        """String features must encode to the same integers in every split."""
+        fg = fs.create_feature_group("cats", version=1, primary_key=["id"])
+        rng = np.random.RandomState(0)
+        n = 400
+        cat = np.array(["aa", "bb", "cc", "dd"])[rng.randint(0, 4, n)]
+        # value correlates with category so the mapping is observable
+        val = {"aa": 0.0, "bb": 1.0, "cc": 2.0, "dd": 3.0}
+        fg.save(pd.DataFrame({"id": range(n), "cat": cat,
+                              "y": [val[c] for c in cat]}))
+        td = fs.create_training_dataset("cats_td", version=1,
+                                        splits={"train": 0.9, "test": 0.1}, seed=1)
+        td.save(fg.select(["cat", "y"]))
+        xs, ys = {}, {}
+        for split in ("train", "test"):
+            x, y = td.tf_data(target_name="y", split=split).numpy_arrays()
+            xs[split], ys[split] = x, y
+        # same category -> same code across splits: code->y must agree
+        mapping = {}
+        for split in ("train", "test"):
+            for code, y in zip(xs[split][:, 0], ys[split]):
+                assert mapping.setdefault(code, y) == y

@@ -188,3 +188,24 @@ class TestTls:
         assert Path(tls.get_client_key_location()).exists()
         assert Path(tls.get_trust_store()).exists()
         assert tls.get_key_store_pwd() == tls.get_trust_store_pwd()
+
+
+class TestTLSLegacyLayout:
+    def test_legacy_root_material_adopted(self, workspace):
+        """Material generated by the old flat .tls/ layout must be reused,
+        not replaced with a freshly minted CA."""
+        from pathlib import Path
+
+        from hops_tpu.messaging import tls
+        from hops_tpu.runtime import fs as rfs
+
+        legacy = Path(rfs.project_path(".tls"))
+        legacy.mkdir(parents=True, exist_ok=True)
+        (legacy / "ca_chain.pem").write_text("LEGACY-CA\n")
+        (legacy / "client_cert.pem").write_text("LEGACY-CERT\n")
+        (legacy / "client_key.pem").write_text("LEGACY-KEY\n")
+        ca = Path(tls.get_ca_chain_location())
+        assert ca.read_text() == "LEGACY-CA\n"
+        assert Path(tls.get_client_certificate_location()).read_text() == "LEGACY-CERT\n"
+        assert Path(tls.get_trust_store()).read_bytes() == b"LEGACY-CA\n"
+        assert tls.get_key_store_pwd()  # reconstructed

@@ -149,3 +149,32 @@ class TestTornWrite:
         kv3 = kvstore.NativeKV(path)
         assert kv3.get("after") == "crash" and kv3.get("good") == "value1"
         kv3.close()
+
+
+class TestTornWritePhantom:
+    def test_torn_tail_truncated_no_phantom_records(self, tmp_path):
+        """The torn tail must be truncated on reopen: a SHORTER later
+        append must not leave stale bytes that a third open parses as
+        phantom records."""
+        import struct
+        path = str(tmp_path / "phantom.hkv")
+        kv = kvstore.NativeKV(path)
+        kv.put("good", "v1")
+        kv.flush()
+        kv.close()
+        # Torn record with a LONG value (bytes crafted so the leftover
+        # tail parses as a plausible header if not truncated).
+        with open(path, "ab") as f:
+            key = b"torn"
+            val = struct.pack("<II", 2, 2) + b"zzZZzzZZ" * 8
+            f.write(struct.pack("<II", len(key), len(val) + 100))
+            f.write(key)
+            f.write(val)
+        kv2 = kvstore.NativeKV(path)
+        assert kv2.count() == 1
+        kv2.put("x", "y")  # shorter than the torn garbage
+        kv2.close()
+        kv3 = kvstore.NativeKV(path)
+        assert sorted(kv3.scan()) == ["v1", "y"]
+        assert kv3.count() == 2
+        kv3.close()
