@@ -1,0 +1,19 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference has no custom kernels (its compute path is TF's, SURVEY.md
+§2) — but the TPU build's perf ceiling is set by how well the hot loop
+maps onto the MXU/VMEM, so the ops that XLA cannot fuse optimally are
+hand-written here with Pallas:
+
+- ``attention`` — blocked flash attention (fwd + bwd) with online
+  softmax: O(seq) memory, never materializes the (seq, seq) score
+  matrix in HBM.
+
+Every kernel ships with a pure-XLA reference twin used for (a) numeric
+tests, (b) non-TPU backends, (c) shapes the kernel doesn't support.
+"""
+
+from hops_tpu.ops.attention import (  # noqa: F401
+    attention_reference,
+    flash_attention,
+)

@@ -1,0 +1,345 @@
+"""Flash attention — blocked online-softmax attention as Pallas TPU kernels.
+
+The reference never shards or fuses attention (it has no transformer at
+all, SURVEY.md §5 "Long-context … Absent"), but long-context support is
+first-class in this framework, and the memory wall for attention is the
+(seq, seq) score matrix. This kernel keeps scores in VMEM one
+(block_q, block_k) tile at a time, carrying the online-softmax
+statistics (running max ``m``, running sum ``l``) in fp32, so HBM
+traffic is O(seq·d) instead of O(seq²).
+
+Layout: ``(batch, heads, seq, head_dim)``. Grid is
+``(batch·heads, seq/block)``; K/V for one (batch, head) live whole in
+VMEM (seq·d·2B — ~2 MB at seq=8192, d=128, bf16) and the kernel walks
+them in ``block_k`` tiles with ``pl.ds``. Causal runs prune the K loop
+to the lower triangle. The backward pass is two more kernels (dq and
+dk/dv) using the saved logsumexp, the standard flash-attention-2 split.
+
+For cross-device sequence parallelism see
+``hops_tpu.parallel.ringattention`` which rotates K/V chunks over the
+ICI ring and feeds each local chunk through this kernel's math.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = float("-inf")
+
+
+def attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Pure-XLA attention: numeric ground truth + fallback path."""
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if causal:
+        q_pos = jnp.arange(q.shape[2])[:, None]
+        k_pos = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k):
+    block_q, head_dim = q_ref.shape[1], q_ref.shape[2]
+    seq_k = k_ref.shape[1]
+    num_k = seq_k // block_k
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32) * sm_scale
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q.astype(k.dtype),
+            k,
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # Fully-masked rows keep m == -inf; subtracting would give nan.
+        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+        p = jnp.exp(s - m_safe[:, None])
+        alpha = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - m_safe))
+        l = l * alpha + jnp.sum(p, axis=-1)
+        vblk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        pv = jax.lax.dot_general(
+            p.astype(vblk.dtype),
+            vblk,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * alpha[:, None] + pv
+        return m_new, l, acc
+
+    if causal:
+        # Only K blocks intersecting the lower triangle of this Q block.
+        bound = jnp.minimum(num_k, pl.cdiv((qi + 1) * block_q, block_k))
+    else:
+        bound = num_k
+    m, l, acc = jax.lax.fori_loop(0, bound, body, (m0, l0, acc0))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = jnp.where(m == NEG_INF, NEG_INF, m + jnp.log(l_safe))
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels (flash-attention-2 split: dq, then dk/dv)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, causal, block_k
+):
+    block_q = q_ref.shape[1]
+    seq_k = k_ref.shape[1]
+    num_k = seq_k // block_k
+    qi = pl.program_id(1)
+
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * sm_scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
+        p = jnp.where(lse[:, None] == NEG_INF, 0.0, jnp.exp(s - lse_safe[:, None]))
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * sm_scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    bound = jnp.minimum(num_k, pl.cdiv((qi + 1) * block_q, block_k)) if causal else num_k
+    dq = jax.lax.fori_loop(
+        0, bound, body, jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, sm_scale, causal, block_q,
+):
+    block_k, head_dim = k_ref.shape[1], k_ref.shape[2]
+    seq_q = q_ref.shape[1]
+    num_q = seq_q // block_q
+    kj = pl.program_id(1)
+
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * sm_scale
+        if causal:
+            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
+        p = jnp.where(lse[:, None] == NEG_INF, 0.0, jnp.exp(s - lse_safe[:, None]))
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return dk, dv
+
+    start = (kj * block_k) // block_q if causal else 0
+    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, num_q, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing + custom VJP
+# ---------------------------------------------------------------------------
+
+
+def _flat(x):
+    b, h, s, d = x.shape
+    return x.reshape(b * h, s, d)
+
+
+def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    grid = (bh, seq_q // block_q)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, _ = _fwd_call(_flat(q), _flat(k), _flat(v), causal, sm_scale, block_q, block_k, interpret)
+    return o.reshape(q.shape)
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _fwd_call(
+        _flat(q), _flat(k), _flat(v), causal, sm_scale, block_q, block_k, interpret
+    )
+    return o.reshape(q.shape), (q, k, v, o.reshape(q.shape), lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    shape = q.shape
+    qf, kf, vf, of, gf = _flat(q), _flat(k), _flat(v), _flat(o), _flat(g)
+    bh, seq_q, d = qf.shape
+    seq_k = kf.shape[1]
+    delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), axis=-1)
+
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k
+    )
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q
+    )
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf, gf, lse, delta)
+
+    return dq.reshape(shape), dk.reshape(k.shape), dv.reshape(v.shape)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Blocked flash attention over ``(batch, heads, seq, head_dim)``.
+
+    Falls back to the XLA reference when sequence lengths don't divide
+    the block sizes. ``interpret=None`` auto-selects the Pallas
+    interpreter off-TPU so tests exercise the same kernel code on the
+    fake CPU mesh (SURVEY.md §4).
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    seq_q, seq_k = q.shape[2], k.shape[2]
+    block_q = min(block_q, seq_q)
+    block_k = min(block_k, seq_k)
+    if seq_q % block_q or seq_k % block_k or (causal and seq_q != seq_k):
+        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)

@@ -1,0 +1,174 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+The reference has no long-context support at all (SURVEY.md §5
+"Long-context / sequence parallelism — Absent"); this framework makes it
+first-class. Two TPU-native schemes, both expressed as ``shard_map``
+programs over a ``seq`` mesh axis so XLA lowers the communication onto
+the ICI ring:
+
+- **Ring attention** (`ring_attention`): Q stays put; K/V chunks rotate
+  around the ring via ``lax.ppermute`` while each device folds the
+  incoming chunk into online-softmax accumulators (running max/sum).
+  Memory per device is O(seq/n · d); the (seq, seq) score matrix never
+  exists. Communication overlaps compute step-for-step — the pattern
+  the scaling book calls "ring attention on the ICI torus".
+
+- **Ulysses** (`ulysses_attention`): two ``all_to_all`` collectives
+  reshard (seq-sharded, all heads) → (head-sharded, full seq), run
+  ordinary (flash) attention locally, and reshard back. Cheaper at
+  moderate sequence lengths when heads ≥ ring size.
+
+Both give bitwise-identical math to full attention (up to fp summation
+order) and are verified against the XLA reference on the fake 8-device
+mesh (tests/test_ringattention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from hops_tpu.ops.attention import NEG_INF, flash_attention
+
+
+def _pvary(x, axis):
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, (axis,))
+    return jax.lax.pcast(x, (axis,), to="varying")
+
+
+def _local_scores(q, k, sm_scale, q_offset, k_offset, causal):
+    """(bh, sq, sk) masked scores for one ring step, fp32."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * sm_scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[2])[:, None]
+        k_pos = k_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return s
+
+
+def _fold(carry, s, v):
+    """Fold one chunk's scores/values into online-softmax accumulators."""
+    m, l, acc = carry
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+    p = jnp.exp(s - m_safe[..., None])
+    alpha = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - m_safe))
+    l = l * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+    acc = acc * alpha[..., None] + pv
+    return m_new, l, acc
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "seq",
+    causal: bool = False,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """Ring attention over globally-shaped ``(batch, heads, seq, d)``.
+
+    Inputs/outputs are sharded ``P(None, None, axis, None)`` on
+    ``mesh``; internally K/V rotate via ``ppermute`` so every device
+    sees every chunk with only neighbor-to-neighbor ICI traffic.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = mesh.shape[axis]
+    seq_local = q.shape[2] // n
+
+    def local_fn(q, k, v):
+        my_idx = jax.lax.axis_index(axis)
+        q32 = q.astype(jnp.float32)
+        bh_shape = q.shape[:2] + (q.shape[2],)
+        # The accumulators start as broadcast constants; mark them as
+        # device-varying on the ring axis so the fori_loop carry types
+        # match its (varying) outputs under shard_map.
+        m0 = _pvary(jnp.full(bh_shape, NEG_INF, jnp.float32), axis)
+        l0 = _pvary(jnp.zeros(bh_shape, jnp.float32), axis)
+        acc0 = _pvary(jnp.zeros(q.shape, jnp.float32), axis)
+        q_offset = my_idx * seq_local
+
+        def step(t, carry):
+            m, l, acc, k_cur, v_cur = carry
+            src_idx = (my_idx - t) % n
+            s = _local_scores(q32, k_cur, sm_scale, q_offset, src_idx * seq_local, causal)
+            m, l, acc = _fold((m, l, acc), s, v_cur)
+            # Rotate K/V one hop (device i sends to i+1) so that at
+            # step t every device holds the chunk that originated at
+            # (my_idx - t) mod n. The permute overlaps the next step's
+            # compute under XLA's async collectives.
+            perm = [(i, (i + 1) % n) for i in range(n)]
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return m, l, acc, k_nxt, v_nxt
+
+        m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        return (acc / l_safe[..., None]).astype(q.dtype)
+
+    spec = P(None, None, axis, None)
+    return shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "seq",
+    causal: bool = False,
+    sm_scale: float | None = None,
+    use_flash: bool = True,
+) -> jax.Array:
+    """DeepSpeed-Ulysses-style sequence parallelism via two all-to-alls.
+
+    Requires ``heads % mesh.shape[axis] == 0``. Locally each device runs
+    full-sequence attention over its head subset (flash kernel when
+    shapes allow), so quality-of-fusion matches the single-chip path.
+    """
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(f"heads {q.shape[1]} not divisible by {axis}={n}")
+
+    attn = functools.partial(
+        flash_attention if use_flash else _reference_local,
+        causal=causal,
+        sm_scale=sm_scale,
+    )
+
+    def local_fn(q, k, v):
+        # (b, H, s/n, d) → (b, H/n, s, d): gather seq, scatter heads.
+        def fwd(x):
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+        def rev(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+        return rev(attn(fwd(q), fwd(k), fwd(v)))
+
+    spec = P(None, None, axis, None)
+    return shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)
+
+
+def _reference_local(q, k, v, causal, sm_scale):
+    from hops_tpu.ops.attention import attention_reference
+
+    return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
