@@ -1,0 +1,28 @@
+"""Jobs / orchestration layer (SURVEY.md §2.7, L6).
+
+The reference's control plane is the Hopsworks Jobs REST API driven by
+thin clients (``jobs-client/spark/jobs_spark_client.py:28-54``,
+``jobs-client/flink/jobs_flink_client.py``) plus Airflow operators
+(``airflow/launch_jobs.py:79-130``). Here the "cluster" is the TPU
+slice itself, so the control plane is local-first: jobs are registered
+in the project's ``Jobs`` dataset, executed as supervised OS processes
+on the host (each owning the slice or a sub-slice via
+``JAX_PLATFORMS``/visible-device env), and polled through the same
+create/start/poll/stop verbs the REST clients used. The DAG module
+gives the Airflow-operator surface without an Airflow install.
+"""
+
+from hops_tpu.jobs import dag, dataset, streaming  # noqa: F401
+from hops_tpu.jobs.api import (  # noqa: F401
+    Execution,
+    Job,
+    JobConfig,
+    create_job,
+    delete_job,
+    get_executions,
+    get_job,
+    get_jobs,
+    start_job,
+    stop_job,
+    wait_for_completion,
+)

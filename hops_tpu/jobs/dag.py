@@ -1,0 +1,202 @@
+"""DAG operators + a minimal scheduler — the Airflow layer, standalone.
+
+The reference orchestrates with three Airflow pieces
+(``airflow/launch_jobs.py:79-130``, ``feature_group_validation.py:76-93``):
+``HopsworksLaunchOperator`` (submit a job, optionally wait),
+``HopsworksJobSuccessSensor`` (block until latest execution succeeds)
+and ``HopsworksFeatureValidationResult`` (fail the pipeline on bad
+data). The same three operators exist here over the local jobs API,
+plus a dependency-ordered runner so ``task0 >> [task1, task2] >> gate``
+pipelines execute without an Airflow install; the classes are plain
+objects, so they can equally be wrapped by a real scheduler.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from hops_tpu.jobs import api
+from hops_tpu.runtime.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class Operator:
+    """Base task node; ``a >> b`` makes ``b`` depend on ``a``."""
+
+    def __init__(self, task_id: str, dag: "DAG | None" = None):
+        self.task_id = task_id
+        self.upstream: list[Operator] = []
+        self.downstream: list[Operator] = []
+        self.state = "PENDING"  # PENDING | SUCCESS | FAILED | SKIPPED
+        self.dag = dag
+        if dag is not None:
+            dag.add(self)
+
+    def __rshift__(self, other):
+        others = other if isinstance(other, (list, tuple)) else [other]
+        for o in others:
+            o.upstream.append(self)
+            self.downstream.append(o)
+        return other
+
+    def __rrshift__(self, others):
+        for o in others:
+            o.__rshift__(self)
+        return self
+
+    def __lshift__(self, other):
+        others = other if isinstance(other, (list, tuple)) else [other]
+        for o in others:
+            o.__rshift__(self)
+        return other
+
+    def execute(self, context: dict[str, Any]) -> None:
+        raise NotImplementedError
+
+
+class PythonOperator(Operator):
+    def __init__(self, task_id: str, python_callable, dag=None, op_kwargs=None):
+        super().__init__(task_id, dag)
+        self.python_callable = python_callable
+        self.op_kwargs = op_kwargs or {}
+
+    def execute(self, context):
+        context[self.task_id] = self.python_callable(**self.op_kwargs)
+
+
+class JobLaunchOperator(Operator):
+    """Submit a registered job (reference: ``HopsworksLaunchOperator``,
+    launch_jobs.py:98-107 — job must already exist in the project)."""
+
+    def __init__(
+        self,
+        task_id: str,
+        job_name: str,
+        job_arguments: list[str] | None = None,
+        wait_for_completion: bool = True,
+        timeout_s: float = 600.0,
+        dag=None,
+    ):
+        super().__init__(task_id, dag)
+        self.job_name = job_name
+        self.job_arguments = job_arguments
+        self.wait = wait_for_completion
+        self.timeout_s = timeout_s
+
+    def execute(self, context):
+        ex = api.start_job(self.job_name, self.job_arguments)
+        context[self.task_id] = ex.execution_id
+        if self.wait:
+            done = api.wait_for_completion(self.job_name, ex.execution_id, self.timeout_s)
+            if done.state != "FINISHED":
+                raise RuntimeError(
+                    f"job {self.job_name} execution {ex.execution_id} ended {done.state}"
+                )
+
+
+class JobSuccessSensor(Operator):
+    """Block until the job's newest execution finishes successfully
+    (reference: ``HopsworksJobSuccessSensor``, launch_jobs.py:120-123)."""
+
+    def __init__(self, task_id: str, job_name: str, timeout_s: float = 600.0, poke_s: float = 0.2, dag=None):
+        super().__init__(task_id, dag)
+        self.job_name = job_name
+        self.timeout_s = timeout_s
+        self.poke_s = poke_s
+
+    def execute(self, context):
+        deadline = time.time() + self.timeout_s
+        while time.time() < deadline:
+            exs = api.get_executions(self.job_name)
+            if exs and exs[0].final:
+                if exs[0].state == "FINISHED":
+                    return
+                raise RuntimeError(
+                    f"job {self.job_name} latest execution ended {exs[0].state}"
+                )
+            time.sleep(self.poke_s)
+        raise TimeoutError(f"sensor {self.task_id} timed out on job {self.job_name}")
+
+
+class FeatureValidationResult(Operator):
+    """Fail the pipeline when a feature group's latest validation is not
+    SUCCESS (reference: ``HopsworksFeatureValidationResult``,
+    feature_group_validation.py:88-93 — "unit test for data")."""
+
+    def __init__(self, task_id: str, feature_group_name: str, version: int = 1, dag=None):
+        super().__init__(task_id, dag)
+        self.feature_group_name = feature_group_name
+        self.version = version
+
+    def execute(self, context):
+        import hops_tpu.featurestore as hsfs
+
+        fs = hsfs.connection().get_feature_store()
+        fg = fs.get_feature_group(self.feature_group_name, self.version)
+        validations = fg.get_validations()
+        if not validations:
+            raise RuntimeError(f"feature group {self.feature_group_name} never validated")
+        latest = validations[-1]
+        if latest.get("status") not in ("SUCCESS", "WARNING"):
+            raise RuntimeError(
+                f"feature group {self.feature_group_name} validation {latest.get('status')}"
+            )
+        context[self.task_id] = latest
+
+
+class DAG:
+    """Dependency-ordered executor with fail-fast downstream skipping."""
+
+    def __init__(self, dag_id: str):
+        self.dag_id = dag_id
+        self.tasks: list[Operator] = []
+
+    def add(self, op: Operator) -> None:
+        self.tasks.append(op)
+        op.dag = self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def run(self) -> dict[str, Any]:
+        """Execute topologically; returns the shared context. Raises the
+        first task failure after marking downstreams SKIPPED."""
+        context: dict[str, Any] = {}
+        done: set[str] = set()
+        failure: Exception | None = None
+        pending = list(self.tasks)
+        while pending:
+            ready = [
+                t
+                for t in pending
+                if t.state == "PENDING" and all(u.task_id in done for u in t.upstream)
+            ]
+            if not ready:
+                stuck = [t.task_id for t in pending if t.state == "PENDING"]
+                raise RuntimeError(
+                    f"dag {self.dag_id}: unsatisfiable dependencies (cycle or "
+                    f"upstream task not in this DAG) for tasks {stuck}"
+                )
+            for task in ready:
+                if any(u.state != "SUCCESS" for u in task.upstream):
+                    task.state = "SKIPPED"
+                    done.add(task.task_id)
+                    pending.remove(task)
+                    continue
+                try:
+                    log.info("dag %s: running %s", self.dag_id, task.task_id)
+                    task.execute(context)
+                    task.state = "SUCCESS"
+                except Exception as e:  # noqa: BLE001 — recorded, re-raised below
+                    task.state = "FAILED"
+                    failure = failure or e
+                done.add(task.task_id)
+                pending.remove(task)
+        if failure is not None:
+            raise failure
+        return context

@@ -1,0 +1,56 @@
+"""Dataset staging — the reference's ``hops.dataset.upload`` hop.
+
+The Spark jobs client zipped a local workspace and uploaded it before
+job submission (jobs_spark_client.py:44-50, README workflow steps 1-3).
+Staging here is a copy into the project tree, plus the same
+zip-a-workspace convenience for shipping a code directory with its
+dependencies.
+"""
+
+from __future__ import annotations
+
+import shutil
+import zipfile
+from pathlib import Path
+
+from hops_tpu.runtime import fs
+
+
+def upload(local_path: str | Path, remote_dir: str) -> str:
+    """Copy a local file/dir into ``<project>/<remote_dir>/``; returns
+    the project-tree destination path."""
+    src = Path(local_path)
+    dst_dir = Path(fs.project_path(remote_dir))
+    dst_dir.mkdir(parents=True, exist_ok=True)
+    dst = dst_dir / src.name
+    if src.is_dir():
+        shutil.copytree(src, dst, dirs_exist_ok=True)
+    else:
+        shutil.copy2(src, dst)
+    return str(dst)
+
+
+def download(remote_path: str, local_dir: str | Path = ".") -> str:
+    return fs.copy_to_local(remote_path, local_dir)
+
+
+def upload_workspace(workspace_dir: str | Path, remote_dir: str, name: str | None = None) -> str:
+    """Zip a code workspace and stage it (the client's zip+upload step)."""
+    src = Path(workspace_dir)
+    name = name or f"{src.name}.zip"
+    dst_dir = Path(fs.project_path(remote_dir))
+    dst_dir.mkdir(parents=True, exist_ok=True)
+    dst = dst_dir / name
+    with zipfile.ZipFile(dst, "w", zipfile.ZIP_DEFLATED) as zf:
+        for p in sorted(src.rglob("*")):
+            if p.is_file():
+                zf.write(p, p.relative_to(src))
+    return str(dst)
+
+
+def extract(archive_path: str | Path, dest_dir: str | Path) -> str:
+    dest = Path(dest_dir)
+    dest.mkdir(parents=True, exist_ok=True)
+    with zipfile.ZipFile(archive_path) as zf:
+        zf.extractall(dest)
+    return str(dest)

@@ -99,6 +99,16 @@ class Consumer:
     def _current_end(self) -> int:
         return self._log.stat().st_size
 
+    @property
+    def offset(self) -> int:
+        """Byte offset into the topic log; settable for external
+        checkpointing (the streaming runner's checkpointLocation)."""
+        return self._offset
+
+    @offset.setter
+    def offset(self, value: int) -> None:
+        self._offset = int(value)
+
     def poll(self, max_records: int | None = None) -> list[dict[str, Any]]:
         with self._log.open("rb") as f:
             f.seek(self._offset)

@@ -1,0 +1,198 @@
+"""Decoder-only transformer LM — the long-context flagship family.
+
+The reference's model zoo tops out at ResNet-50 (SURVEY.md §6); this
+family exists because long-context training is first-class here. Design
+is TPU-first:
+
+- attention goes through ``hops_tpu.ops.flash_attention`` (Pallas,
+  O(seq) memory) on a single chip, or
+  ``hops_tpu.parallel.ringattention`` when a ``seq`` mesh axis is
+  present (context parallelism over the ICI ring);
+- all matmuls run in bfloat16 on the MXU with fp32 accumulation;
+- rotary position embeddings (no learned position table to shard);
+- optional ``nn.remat`` per block trades FLOPs for HBM
+  (the jax.checkpoint knob from the build brief).
+
+Sharding contract (used by the launchers and __graft_entry__):
+embed/unembed and MLP kernels are Megatron-split on the ``model`` axis
+by ``parallel.sharding.infer_param_spec``; activations shard
+``("data", None | "seq")``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from hops_tpu.ops.attention import attention_reference, flash_attention
+
+
+def rotary_embedding(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
+    """Apply RoPE over ``(batch, heads, seq, head_dim)``."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[:, None].astype(jnp.float32) * inv_freq[None, :]  # (seq, d/2)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    return jnp.stack([out1, out2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],))
+        x32 = x.astype(jnp.float32)
+        norm = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (norm * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "flash"  # flash | reference | ring | ulysses
+    mesh: Any = None
+    seq_axis: str = "seq"
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, dm = x.shape
+        head_dim = dm // self.num_heads
+        qkv = nn.DenseGeneral(
+            (3, self.num_heads, head_dim), dtype=self.dtype, name="qkv", use_bias=False
+        )(x)
+        q, k, v = [jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3)]  # (b, h, s, d)
+        pos = jnp.arange(s)
+        q, k = rotary_embedding(q, pos), rotary_embedding(k, pos)
+
+        if self.attention_impl == "flash":
+            o = flash_attention(q, k, v, causal=True)
+        elif self.attention_impl == "reference":
+            o = attention_reference(q, k, v, causal=True)
+        elif self.attention_impl in ("ring", "ulysses"):
+            from hops_tpu.parallel import ringattention
+
+            fn = (
+                ringattention.ring_attention
+                if self.attention_impl == "ring"
+                else ringattention.ulysses_attention
+            )
+            o = fn(q, k, v, self.mesh, axis=self.seq_axis, causal=True)
+        else:
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+
+        o = jnp.moveaxis(o, 1, 2).reshape(b, s, dm)
+        return nn.DenseGeneral(dm, dtype=self.dtype, name="out", use_bias=False)(o)
+
+
+class MLP(nn.Module):
+    """SwiGLU: two fused up-projections + gated down-projection."""
+
+    hidden_mult: int = 4
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        dm = x.shape[-1]
+        hidden = int(dm * self.hidden_mult * 2 / 3)
+        hidden = max(128, (hidden // 128) * 128)  # MXU-aligned
+        gate = nn.Dense(hidden, dtype=self.dtype, use_bias=False, name="gate")(x)
+        up = nn.Dense(hidden, dtype=self.dtype, use_bias=False, name="up")(x)
+        return nn.Dense(dm, dtype=self.dtype, use_bias=False, name="down")(
+            nn.silu(gate) * up
+        )
+
+
+class Block(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "flash"
+    mesh: Any = None
+    seq_axis: str = "seq"
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = Attention(
+            self.num_heads,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            mesh=self.mesh,
+            seq_axis=self.seq_axis,
+            name="attn",
+        )(RMSNorm(dtype=self.dtype)(x))
+        if self.dropout_rate:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        x = x + h
+        h = MLP(dtype=self.dtype, name="mlp")(RMSNorm(dtype=self.dtype)(x))
+        if self.dropout_rate:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    """GPT-style causal LM over token ids ``(batch, seq)`` → logits."""
+
+    vocab_size: int = 32000
+    d_model: int = 512
+    num_heads: int = 8
+    num_layers: int = 6
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "flash"
+    mesh: Any = None
+    seq_axis: str = "seq"
+    dropout_rate: float = 0.0
+    remat: bool = False
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")(tokens)
+        block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
+        for i in range(self.num_layers):
+            x = block_cls(
+                self.num_heads,
+                dtype=self.dtype,
+                attention_impl=self.attention_impl,
+                mesh=self.mesh,
+                seq_axis=self.seq_axis,
+                dropout_rate=self.dropout_rate,
+                name=f"block_{i}",
+            )(x, train)
+        x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
+        logits = nn.Dense(self.vocab_size, dtype=self.dtype, use_bias=False, name="unembed")(x)
+        return logits.astype(jnp.float32)
+
+
+def make_lm_train_step():
+    """Next-token-prediction step: ``(state, {"tokens"}) -> (state, metrics)``.
+
+    Same ``step(state, batch)`` contract as ``common.make_train_step``
+    so every launcher (launch/mirrored/collective_all_reduce) accepts it
+    unchanged.
+    """
+    import optax
+
+    def train_step(state, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        step_rng = jax.random.fold_in(state.rng, state.step)
+
+        def compute_loss(params):
+            logits = state.apply_fn(
+                {"params": params}, inputs, train=True, rngs={"dropout": step_rng}
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+            return loss.mean()
+
+        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss, "perplexity": jnp.exp(loss)}
+
+    return train_step

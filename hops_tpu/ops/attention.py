@@ -111,7 +111,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = jnp.where(m == NEG_INF, NEG_INF, m + jnp.log(l_safe))
+    # lse rides as a full (1, 1, seq_q) row per (batch·head) — TPU block
+    # shapes must tile (8, 128) or span their dims, so each q-block
+    # program dynamic-stores its slice of the shared row.
+    lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = jnp.where(
+        m == NEG_INF, NEG_INF, m + jnp.log(l_safe)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -129,8 +134,8 @@ def _bwd_dq_kernel(
 
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
 
     def body(j, dq):
         k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
@@ -176,8 +181,8 @@ def _bwd_dkv_kernel(
         dk, dv = carry
         q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q)]
+        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -234,11 +239,11 @@ def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_q), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, seq_q), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -263,7 +268,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     qf, kf, vf, of, gf = _flat(q), _flat(k), _flat(v), _flat(o), _flat(g)
     bh, seq_q, d = qf.shape
     seq_k = kf.shape[1]
-    delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), axis=-1)
+    delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), axis=-1)[:, None, :]
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k
@@ -276,8 +281,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
@@ -295,8 +300,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, seq_q), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),

@@ -37,9 +37,9 @@ from hops_tpu.ops.attention import NEG_INF, flash_attention
 
 
 def _pvary(x, axis):
-    if hasattr(jax.lax, "pvary"):
-        return jax.lax.pvary(x, (axis,))
-    return jax.lax.pcast(x, (axis,), to="varying")
+    if hasattr(jax.lax, "pcast"):  # current API; pvary is its deprecated alias
+        return jax.lax.pcast(x, (axis,), to="varying")
+    return jax.lax.pvary(x, (axis,))
 
 
 def _local_scores(q, k, sm_scale, q_offset, k_offset, causal):

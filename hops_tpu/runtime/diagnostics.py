@@ -1,0 +1,128 @@
+"""Tracing, hang detection, and determinism.
+
+SURVEY.md §5 found the reference's story thin: TensorBoard profiling
+only (``profile_batch='5,10'`` in Keras callbacks), **no race/deadlock
+tooling**, and no deterministic mode. The TPU equivalents:
+
+- :func:`trace` — ``jax.profiler`` trace into the active run's logdir,
+  viewable in TensorBoard/XProf exactly where the reference's profiler
+  window landed (reference: notebooks/ml/Experiment/Tensorflow/
+  mnist.ipynb:172-173).
+- :class:`Watchdog` — collective-deadlock detector. SPMD programs hang,
+  not crash, when one host misses a collective; the watchdog fires when
+  the step loop stops heartbeating, dumps every Python thread's stack,
+  and optionally kills the process so the job scheduler can retry.
+- :func:`deterministic_mode` — one switch for bitwise-reproducible runs
+  (XLA deterministic ops + seeded ``jax.random`` keys), the stand-in
+  for race detection on a platform where the compiler owns scheduling.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import faulthandler
+import os
+import sys
+import threading
+import time
+from typing import Iterator
+
+import jax
+
+from hops_tpu.runtime import rundir
+from hops_tpu.runtime.logging import get_logger
+
+log = get_logger(__name__)
+
+
+@contextlib.contextmanager
+def trace(logdir: str | None = None) -> Iterator[str]:
+    """Capture a profiler trace for the with-block into ``logdir``
+    (default: ``<active run>/trace``)."""
+    target = logdir or os.path.join(rundir.logdir(), "trace")
+    os.makedirs(target, exist_ok=True)
+    jax.profiler.start_trace(target)
+    try:
+        yield target
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Watchdog:
+    """Detects a stalled step loop (the usual face of a collective deadlock).
+
+    The training loop calls :meth:`heartbeat` once per step; a daemon
+    thread fires after ``timeout_s`` without one, logs every thread's
+    stack (so the hung collective is visible in the trace), and calls
+    ``on_hang`` — default: dump + ``os._exit(42)`` when ``fatal`` else
+    just log, letting an external supervisor restart the host. This is
+    the framework-level replacement for the failure detection the
+    reference outsourced to YARN container restarts (SURVEY.md §5).
+    """
+
+    def __init__(self, timeout_s: float = 300.0, fatal: bool = False, on_hang=None):
+        self.timeout_s = timeout_s
+        self.fatal = fatal
+        self.on_hang = on_hang
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread: threading.Thread | None = None
+
+    def heartbeat(self) -> None:
+        self._last = time.monotonic()
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    def _watch(self) -> None:
+        while not self._stop.wait(min(self.timeout_s / 4, 5.0)):
+            if time.monotonic() - self._last > self.timeout_s:
+                self._fired = True
+                log.error(
+                    "watchdog: no heartbeat for %.0fs — possible collective "
+                    "deadlock; dumping thread stacks",
+                    self.timeout_s,
+                )
+                faulthandler.dump_traceback(file=sys.stderr)
+                if self.on_hang is not None:
+                    self.on_hang()
+                elif self.fatal:
+                    os._exit(42)
+                return
+
+    def start(self) -> "Watchdog":
+        self._last = time.monotonic()
+        self._thread = threading.Thread(target=self._watch, daemon=True, name="hops-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+@contextlib.contextmanager
+def deterministic_mode(seed: int = 0) -> Iterator[jax.Array]:
+    """Bitwise-reproducible execution for the with-block.
+
+    Yields a seeded root PRNG key. XLA scheduling on TPU is already
+    deterministic for a fixed program; the remaining nondeterminism
+    (autotuned reductions on other backends, Python hash order) is
+    pinned here.
+    """
+    prev = jax.config.jax_default_prng_impl
+    os.environ.setdefault("TF_DETERMINISTIC_OPS", "1")
+    jax.config.update("jax_default_prng_impl", "threefry2x32")
+    try:
+        yield jax.random.PRNGKey(seed)
+    finally:
+        jax.config.update("jax_default_prng_impl", prev)

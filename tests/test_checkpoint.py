@@ -1,0 +1,103 @@
+"""Checkpoint/resume + diagnostics subsystems."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hops_tpu.models import common
+from hops_tpu.models.mnist import FFN
+from hops_tpu.parallel import mesh as mesh_lib
+from hops_tpu.runtime import checkpoint, diagnostics
+
+
+def _state():
+    return common.create_train_state(
+        FFN(dtype=jnp.float32), jax.random.PRNGKey(0), (2, 28, 28, 1)
+    )
+
+
+def test_save_restore_roundtrip(tmp_path):
+    state = _state()
+    with checkpoint.CheckpointManager(tmp_path / "ckpt", async_save=False) as mgr:
+        assert mgr.save(0, state)
+        restored = mgr.restore(state)
+    jax.tree.map(np.testing.assert_allclose, restored.params, state.params)
+    assert int(restored.step) == int(state.step)
+
+
+def test_max_to_keep_and_latest(tmp_path):
+    state = _state()
+    with checkpoint.CheckpointManager(tmp_path / "c", max_to_keep=2, async_save=False) as m:
+        for s in (0, 1, 2, 3):
+            m.save(s, state)
+        assert m.latest_step() == 3
+        assert m.all_steps() == [2, 3]
+
+
+def test_restore_or_init_fresh_and_resume(tmp_path):
+    state = _state()
+    out, start = checkpoint.restore_or_init(state, tmp_path / "r")
+    assert start == 0 and out is state
+    with checkpoint.CheckpointManager(tmp_path / "r", async_save=False) as m:
+        m.save(7, state)
+    _, start = checkpoint.restore_or_init(state, tmp_path / "r")
+    assert start == 8
+
+
+def test_async_save_visible_after_wait(tmp_path):
+    state = _state()
+    with checkpoint.CheckpointManager(tmp_path / "a", async_save=True) as m:
+        m.save(0, state)
+        m.wait()
+        assert m.latest_step() == 0
+
+
+def test_restore_onto_sharded_template(tmp_path):
+    mesh = mesh_lib.make_mesh({"data": 4}, devices=jax.devices()[:4])
+    state = _state()
+    with checkpoint.CheckpointManager(tmp_path / "s", async_save=False) as m:
+        m.save(0, state)
+        sharded = jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh, P())), state
+        )
+        restored = m.restore(sharded)
+    leaf = restored.params["Dense_0"]["kernel"]
+    assert leaf.sharding == NamedSharding(mesh, P())
+
+
+def test_watchdog_fires_on_stall():
+    fired = threading.Event()
+    wd = diagnostics.Watchdog(timeout_s=0.3, on_hang=fired.set)
+    with wd:
+        time.sleep(1.0)
+    assert wd.fired and fired.is_set()
+
+
+def test_watchdog_quiet_with_heartbeats():
+    wd = diagnostics.Watchdog(timeout_s=0.6)
+    with wd:
+        for _ in range(5):
+            time.sleep(0.1)
+            wd.heartbeat()
+    assert not wd.fired
+
+
+def test_deterministic_mode_reproduces():
+    with diagnostics.deterministic_mode(42) as key1:
+        a = jax.random.normal(key1, (8,))
+    with diagnostics.deterministic_mode(42) as key2:
+        b = jax.random.normal(key2, (8,))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_trace_writes_into_rundir(tmp_path):
+    with diagnostics.trace(str(tmp_path / "tr")) as target:
+        jnp.ones((4, 4)).sum().block_until_ready()
+    import os
+
+    assert os.listdir(target)
