@@ -39,7 +39,7 @@ def _sync(tree) -> float:
 
 
 def run_bench(
-    per_chip_batch: int = 64,
+    per_chip_batch: int = 128,  # measured sweet spot on v5e (64→1898, 128→2053, 256→1982 samples/s/chip)
     image_size: int = 224,
     steps: int = 30,
     warmup: int = 5,
@@ -98,7 +98,7 @@ def run_bench(
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
-    parser.add_argument("--batch", type=int, default=64, help="per-chip batch size")
+    parser.add_argument("--batch", type=int, default=128, help="per-chip batch size")
     parser.add_argument("--steps", type=int, default=30)
     args = parser.parse_args()
 

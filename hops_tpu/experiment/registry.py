@@ -28,6 +28,11 @@ def register(record: dict[str, Any]) -> None:
     record.setdefault("time", time.time())
     with _index_path().open("a") as f:
         f.write(json.dumps(record, default=str) + "\n")
+    # Make the run findable (the platform indexed runs into ES for the
+    # Experiments UI search; SURVEY.md §2.2 elasticsearch row).
+    from hops_tpu.messaging import searchindex
+
+    searchindex.index_run(json.loads(json.dumps(record, default=str)))
 
 
 def list_runs(name: str | None = None) -> list[dict[str, Any]]:

@@ -1,0 +1,149 @@
+"""Mixture-of-Experts layer with expert parallelism over an ``expert`` axis.
+
+Beyond-reference capability (the reference shards nothing, SURVEY.md
+§2.9 row 5) rounding out the parallelism families: dp (data), tp
+(model), sp (seq — ring attention), fsdp, and here **ep**. Design is
+the TPU-standard dense-dispatch MoE:
+
+- router: softmax top-k over expert logits, tokens weighted by router
+  probability;
+- dispatch/combine as einsums against a one-hot dispatch mask — dense
+  compute, static shapes, no sorting/gather, exactly what the MXU and
+  XLA's GSPMD partitioner want;
+- capacity factor bounds per-expert work; overflow tokens drop (their
+  residual path still carries them);
+- with a mesh, expert weights shard ``P("expert")`` on the leading
+  (num_experts) dim and the per-expert matmuls partition across the
+  axis — XLA inserts the all-to-alls.
+
+``MoEBlock`` slots into ``TransformerLM`` as a drop-in MLP replacement.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed expert FFN over ``(batch, seq, d_model)``."""
+
+    num_experts: int = 8
+    top_k: int = 2
+    hidden_mult: int = 4
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, s, dm = x.shape
+        hidden = max(128, (dm * self.hidden_mult // 128) * 128)
+        n_tok = b * s
+        capacity = max(1, int(self.capacity_factor * n_tok * self.top_k / self.num_experts))
+
+        tokens = x.reshape(n_tok, dm)
+        router_logits = nn.Dense(
+            self.num_experts, dtype=jnp.float32, use_bias=False, name="router"
+        )(tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(router_logits, axis=-1)  # (T, E)
+
+        # Top-k gating: zero all but the k largest per token, renormalize.
+        top_vals, _ = jax.lax.top_k(probs, self.top_k)
+        kth = top_vals[:, -1:]
+        gates = jnp.where(probs >= kth, probs, 0.0)
+        gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+
+        # Position of each token in each expert's buffer; tokens past
+        # capacity drop (residual connection still carries them).
+        assigned = gates > 0.0  # (T, E)
+        position = jnp.cumsum(assigned, axis=0) - 1
+        keep = assigned & (position < capacity)
+        # dispatch: (T, E, C) one-hot over buffer slots.
+        dispatch = keep[..., None] & (
+            position[..., None] == jnp.arange(capacity)[None, None, :]
+        )
+        dispatch = dispatch.astype(self.dtype)
+        combine = dispatch * gates[..., None].astype(self.dtype)
+
+        # Expert buffers: (E, C, dm).
+        expert_in = jnp.einsum("td,tec->ecd", tokens.astype(self.dtype), dispatch)
+
+        # Plain (unboxed) params; expert parallelism comes from placing
+        # them P("expert", None, None) — see expert_specs() below.
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(), (self.num_experts, dm, hidden)
+        ).astype(self.dtype)
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(), (self.num_experts, hidden, dm)
+        ).astype(self.dtype)
+
+        h = jnp.einsum("ecd,edh->ech", expert_in, w_in)
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ech,ehd->ecd", h, w_out)
+
+        out = jnp.einsum("ecd,tec->td", expert_out, combine)
+
+        # Load-balancing auxiliary loss (Switch-style): mean gate prob ×
+        # fraction of tokens routed, per expert. Stored for the train
+        # step via sow.
+        density = assigned.astype(jnp.float32).mean(0)
+        mean_prob = probs.mean(0)
+        aux = self.num_experts * jnp.sum(density * mean_prob)
+        self.sow("losses", "moe_aux", aux)
+
+        return out.reshape(b, s, dm)
+
+
+def expert_specs(params: Any, axis: str = "expert") -> Any:
+    """PartitionSpec tree sharding every expert-stacked weight (leading
+    dim == num_experts, named ``w_in``/``w_out``) on ``axis``; the rest
+    replicated. Feed to ``jax.device_put`` with a mesh carrying an
+    ``expert`` axis for expert parallelism."""
+    from jax.sharding import PartitionSpec as P
+
+    def walk(tree, name=""):
+        if isinstance(tree, dict):
+            return {k: walk(v, k) for k, v in tree.items()}
+        if name in ("w_in", "w_out"):
+            return P(axis, None, None)
+        return P()
+
+    return walk(params)
+
+
+class MoEBlock(nn.Module):
+    """Transformer block with the MLP swapped for routed experts."""
+
+    num_heads: int
+    num_experts: int = 8
+    top_k: int = 2
+    dtype: Any = jnp.bfloat16
+    attention_impl: str = "flash"
+    mesh: Any = None
+    seq_axis: str = "seq"
+    batch_axis: Any = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        from hops_tpu.models.transformer import Attention, RMSNorm
+
+        h = Attention(
+            self.num_heads,
+            dtype=self.dtype,
+            attention_impl=self.attention_impl,
+            mesh=self.mesh,
+            seq_axis=self.seq_axis,
+            batch_axis=self.batch_axis,
+            name="attn",
+        )(RMSNorm(dtype=self.dtype)(x))
+        x = x + h
+        h = MoEMLP(
+            num_experts=self.num_experts,
+            top_k=self.top_k,
+            dtype=self.dtype,
+            name="moe",
+        )(RMSNorm(dtype=self.dtype)(x))
+        return x + h

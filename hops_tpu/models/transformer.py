@@ -60,6 +60,7 @@ class Attention(nn.Module):
     attention_impl: str = "flash"  # flash | reference | ring | ulysses
     mesh: Any = None
     seq_axis: str = "seq"
+    batch_axis: Any = None  # data axis name when dp combines with sp
 
     @nn.compact
     def __call__(self, x):
@@ -84,7 +85,10 @@ class Attention(nn.Module):
                 if self.attention_impl == "ring"
                 else ringattention.ulysses_attention
             )
-            o = fn(q, k, v, self.mesh, axis=self.seq_axis, causal=True)
+            o = fn(
+                q, k, v, self.mesh,
+                axis=self.seq_axis, batch_axis=self.batch_axis, causal=True,
+            )
         else:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
 
@@ -116,6 +120,7 @@ class Block(nn.Module):
     attention_impl: str = "flash"
     mesh: Any = None
     seq_axis: str = "seq"
+    batch_axis: Any = None
     dropout_rate: float = 0.0
 
     @nn.compact
@@ -126,6 +131,7 @@ class Block(nn.Module):
             attention_impl=self.attention_impl,
             mesh=self.mesh,
             seq_axis=self.seq_axis,
+            batch_axis=self.batch_axis,
             name="attn",
         )(RMSNorm(dtype=self.dtype)(x))
         if self.dropout_rate:
@@ -148,6 +154,7 @@ class TransformerLM(nn.Module):
     attention_impl: str = "flash"
     mesh: Any = None
     seq_axis: str = "seq"
+    batch_axis: Any = None
     dropout_rate: float = 0.0
     remat: bool = False
 
@@ -162,6 +169,7 @@ class TransformerLM(nn.Module):
                 attention_impl=self.attention_impl,
                 mesh=self.mesh,
                 seq_axis=self.seq_axis,
+                batch_axis=self.batch_axis,
                 dropout_rate=self.dropout_rate,
                 name=f"block_{i}",
             )(x, train)

@@ -12,6 +12,7 @@ from hops_tpu.parallel.strategy import (  # noqa: F401
     CollectiveAllReduceStrategy,
     MirroredStrategy,
     ParameterServerStrategy,
+    ShardedStrategy,
     Strategy,
     current_strategy,
     get_strategy,

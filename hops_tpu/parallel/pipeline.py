@@ -1,0 +1,101 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over a ``stage``
+mesh axis.
+
+Rounds out the parallelism families (dp/tp/fsdp/sp/ep elsewhere; the
+reference itself shards nothing — SURVEY.md §2.9 row 5). TPU-idiomatic
+formulation: identical-shaped stages hold their params sharded
+``P("stage")`` on the leading stack dim; inside one ``shard_map`` the
+schedule is a single ``fori_loop`` where every device applies its stage
+to the activation it currently holds and passes the result one hop down
+the ring (``ppermute`` — neighbor traffic on ICI). With M microbatches
+and S stages the loop runs M+S-1 ticks (the classic GPipe bubble);
+gradients flow through ``ppermute``/``psum`` so ``jax.grad`` works
+unchanged.
+
+Best for models whose blocks repeat (TransformerLM's ``Block`` stack);
+for a handful of chips prefer dp+tp — pp pays off when the param tree
+exceeds per-chip HBM across many hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stage_params(per_stage_params: list[Any]) -> Any:
+    """Stack S same-structure param trees along a new leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stacked_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "stage",
+    num_microbatches: int | None = None,
+) -> jax.Array:
+    """Run ``x`` through S pipelined stages; returns the final activations.
+
+    ``stage_fn(params_s, h) -> h`` must preserve ``h``'s shape (a
+    residual-block stack). ``stacked_params`` leaves have leading dim S
+    and are consumed sharded ``P(axis)``; ``x`` is ``(batch, ...)``,
+    replicated over the stage axis, split into ``num_microbatches``
+    (default S) equal microbatches.
+    """
+    n_stages = mesh.shape[axis]
+    m = num_microbatches or n_stages
+    batch = x.shape[0]
+    if batch % m:
+        raise ValueError(f"batch {batch} not divisible by {m} microbatches")
+
+    def local_fn(params, x):
+        # params leaves arrive as (1, ...) slices of the stage stack.
+        from hops_tpu.parallel.ringattention import _pvary
+
+        params = jax.tree.map(lambda p: p[0], params)
+        s = jax.lax.axis_index(axis)
+        micro = x.reshape(m, batch // m, *x.shape[1:])
+        # Carries start as broadcast constants; mark them device-varying
+        # on the stage axis so the fori_loop carry types stay stable.
+        buf = _pvary(jnp.zeros_like(micro[0]), (axis,))
+        outputs = _pvary(jnp.zeros_like(micro), (axis,))
+
+        def tick(t, carry):
+            buf, outputs = carry
+            # Stage 0 ingests microbatch t (while t < m); later stages
+            # consume what the previous tick's ppermute delivered.
+            feed = micro[jnp.clip(t, 0, m - 1)]
+            h_in = jnp.where(s == 0, feed, buf)
+            h_out = stage_fn(params, h_in)
+            # The last stage emits microbatch t-(S-1) once the pipe fills.
+            out_idx = t - (n_stages - 1)
+            emit = (s == n_stages - 1) & (out_idx >= 0)
+            written = outputs.at[jnp.clip(out_idx, 0, m - 1)].set(h_out)
+            outputs = jnp.where(emit, written, outputs)
+            # Hand activations one stage down the ring.
+            buf = jax.lax.ppermute(
+                h_out, axis, [(i, i + 1) for i in range(n_stages - 1)]
+            )
+            return buf, outputs
+
+        _, outputs = jax.lax.fori_loop(0, m + n_stages - 1, tick, (buf, outputs))
+        # Only the last stage holds real outputs; broadcast to all so the
+        # caller sees a replicated result (loss runs everywhere, SPMD).
+        outputs = jax.lax.psum(
+            jnp.where(s == n_stages - 1, outputs, jnp.zeros_like(outputs)), axis
+        )
+        return outputs.reshape(batch, *x.shape[1:])
+
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+    )(stacked_params, x)

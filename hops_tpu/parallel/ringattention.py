@@ -36,10 +36,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from hops_tpu.ops.attention import NEG_INF, flash_attention
 
 
-def _pvary(x, axis):
+def _pvary(x, axes):
+    axes = tuple(a for a in axes if a is not None)
     if hasattr(jax.lax, "pcast"):  # current API; pvary is its deprecated alias
-        return jax.lax.pcast(x, (axis,), to="varying")
-    return jax.lax.pvary(x, (axis,))
+        return jax.lax.pcast(x, axes, to="varying")
+    return jax.lax.pvary(x, axes)
 
 
 def _local_scores(q, k, sm_scale, q_offset, k_offset, causal):
@@ -75,14 +76,16 @@ def ring_attention(
     mesh: Mesh,
     *,
     axis: str = "seq",
+    batch_axis: str | None = None,
     causal: bool = False,
     sm_scale: float | None = None,
 ) -> jax.Array:
     """Ring attention over globally-shaped ``(batch, heads, seq, d)``.
 
-    Inputs/outputs are sharded ``P(None, None, axis, None)`` on
-    ``mesh``; internally K/V rotate via ``ppermute`` so every device
-    sees every chunk with only neighbor-to-neighbor ICI traffic.
+    Inputs/outputs are sharded ``P(batch_axis, None, axis, None)`` on
+    ``mesh`` (``batch_axis`` combines data parallelism with the ring);
+    internally K/V rotate via ``ppermute`` so every device sees every
+    chunk with only neighbor-to-neighbor ICI traffic.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
@@ -94,11 +97,13 @@ def ring_attention(
         q32 = q.astype(jnp.float32)
         bh_shape = q.shape[:2] + (q.shape[2],)
         # The accumulators start as broadcast constants; mark them as
-        # device-varying on the ring axis so the fori_loop carry types
-        # match its (varying) outputs under shard_map.
-        m0 = _pvary(jnp.full(bh_shape, NEG_INF, jnp.float32), axis)
-        l0 = _pvary(jnp.zeros(bh_shape, jnp.float32), axis)
-        acc0 = _pvary(jnp.zeros(q.shape, jnp.float32), axis)
+        # device-varying on the ring (and data, if combined) axes so the
+        # fori_loop carry types match its (varying) outputs under
+        # shard_map.
+        vary = (axis, batch_axis)
+        m0 = _pvary(jnp.full(bh_shape, NEG_INF, jnp.float32), vary)
+        l0 = _pvary(jnp.zeros(bh_shape, jnp.float32), vary)
+        acc0 = _pvary(jnp.zeros(q.shape, jnp.float32), vary)
         q_offset = my_idx * seq_local
 
         def step(t, carry):
@@ -119,7 +124,7 @@ def ring_attention(
         l_safe = jnp.where(l == 0.0, 1.0, l)
         return (acc / l_safe[..., None]).astype(q.dtype)
 
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, None, axis, None)
     return shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
@@ -132,6 +137,7 @@ def ulysses_attention(
     mesh: Mesh,
     *,
     axis: str = "seq",
+    batch_axis: str | None = None,
     causal: bool = False,
     sm_scale: float | None = None,
     use_flash: bool = True,
@@ -162,7 +168,7 @@ def ulysses_attention(
 
         return rev(attn(fwd(q), fwd(k), fwd(v)))
 
-    spec = P(None, None, axis, None)
+    spec = P(batch_axis, None, axis, None)
     return shard_map(
         local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)

@@ -23,6 +23,7 @@ Typical wrapper-function use::
 from __future__ import annotations
 
 import contextlib
+import math
 from typing import Any, Callable, Iterator
 
 import jax
@@ -116,6 +117,63 @@ class CollectiveAllReduceStrategy(Strategy):
 # never call it (SURVEY.md §2.3 last row); parameter servers have no
 # TPU-native analog, so it is a documented alias of collective allreduce.
 ParameterServerStrategy = CollectiveAllReduceStrategy
+
+
+class ShardedStrategy(Strategy):
+    """Data + FSDP + tensor parallelism over one (data, fsdp, model) mesh.
+
+    Beyond-reference capability (SURVEY.md §2.9 row 5 notes the
+    reference shards nothing): large params are Megatron-split on
+    ``model`` and ZeRO-style split on ``fsdp`` via GSPMD annotations —
+    XLA inserts the gather/reduce-scatter collectives. The wrapper-fn
+    contract is unchanged; call :meth:`shard_state` once after creating
+    the train state.
+    """
+
+    def __init__(
+        self,
+        data: int = -1,
+        fsdp: int = 1,
+        model: int = 1,
+        min_shard_size: int = 4096,
+    ):
+        mesh = mesh_lib.make_mesh({"data": data, "fsdp": fsdp, "model": model})
+        super().__init__(mesh, "data")
+        self.min_shard_size = min_shard_size
+
+    def _spec_for(self, leaf: Any) -> P:
+        from hops_tpu.parallel import sharding as shard_lib
+
+        sp = shard_lib.infer_param_spec(
+            leaf, "model", self.mesh.shape["model"], self.min_shard_size
+        )
+        fsdp = self.mesh.shape["fsdp"]
+        shape = jax.numpy.shape(leaf)
+        if fsdp == 1 or len(shape) < 2 or math.prod(shape) < self.min_shard_size:
+            return sp
+        taken = {d for d, ax in enumerate(sp) if ax is not None}
+        free = [d for d in range(len(shape)) if d not in taken and shape[d] % fsdp == 0]
+        if not free:
+            return sp
+        dim = max(free, key=lambda d: shape[d])
+        parts = list(sp) + [None] * (len(shape) - len(sp))
+        parts[dim] = "fsdp"
+        return P(*parts)
+
+    def shard_state(self, state: Any) -> Any:
+        """Place a train-state pytree: large >=2-D leaves (params AND
+        their optimizer moments, which mirror param shapes) sharded on
+        model/fsdp, everything else replicated."""
+
+        def place(x):
+            return jax.device_put(x, NamedSharding(self.mesh, self._spec_for(x)))
+
+        return jax.tree.map(place, state)
+
+    # FSDP/TP state is heterogeneous, so jit infers shardings from the
+    # placed arguments instead of the base class's uniform in_shardings.
+    def step(self, fn: Callable[..., Any], donate_state: bool = True) -> Callable[..., Any]:
+        return jax.jit(fn, donate_argnums=(0,) if donate_state else ())
 
 
 def current_strategy() -> "Strategy | None":

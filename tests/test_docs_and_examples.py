@@ -40,3 +40,19 @@ def test_featurestore_tour_as_job():
     done = jobs.wait_for_completion("fs_tour", ex.execution_id, timeout_s=120)
     assert done.state == "FINISHED", done.stdout()
     assert "tour complete" in done.stdout()
+
+
+def test_taxi_pipeline_inprocess():
+    from examples import taxi_pipeline
+
+    result = taxi_pipeline.main()
+    assert result["metrics"]["accuracy"] > 0.5
+    assert result["best"]["version"] == 1
+
+
+def test_lagom_search_inprocess():
+    from examples import lagom_search
+
+    result = lagom_search.main()
+    assert result["best_metric"] > 0.5
+    assert result["best_config"].keys() == {"kernel", "pool", "dropout"}

@@ -1,0 +1,35 @@
+"""Embedded search index (the hops.elasticsearch twin)."""
+
+from hops_tpu import experiment
+from hops_tpu.messaging import searchindex
+
+
+def test_index_and_search_ranking():
+    idx = searchindex.SearchIndex("docs")
+    idx.index_document("a", {"title": "resnet training run", "status": "FINISHED"})
+    idx.index_document("b", {"title": "mnist training run", "status": "FAILED"})
+    idx.index_document("c", {"title": "data validation", "status": "FINISHED"})
+    hits = idx.search("training run finished")
+    assert hits[0]["_id"] == "a"  # matches all three terms
+    assert {h["_id"] for h in hits} == {"a", "b", "c"}
+    assert idx.count() == 3
+
+
+def test_last_write_wins():
+    idx = searchindex.SearchIndex("upserts")
+    idx.index_document("x", {"v": 1})
+    idx.index_document("x", {"v": 2})
+    assert idx.get("x") == {"v": 2}
+    assert idx.count() == 1
+
+
+def test_runs_indexed_by_experiment_launch():
+    experiment.launch(lambda: {"accuracy": 0.9}, name="searchable_run")
+    hits = searchindex.search_runs("searchable_run finished")
+    assert hits and hits[0]["_source"]["name"] == "searchable_run"
+
+
+def test_es_config_shape():
+    cfg = searchindex.get_elasticsearch_config("logs")
+    assert cfg["es.resource"].endswith("_logs/_doc")
+    assert "es.nodes" in cfg
