@@ -103,9 +103,10 @@ def train_wrapper():
 
     params = variables["params"]
     n = len(labels)
+    bs = min(256, n)  # full batches only (static shapes → one executable)
     for epoch in range(5):
-        for i in range(0, n - 256, 256):
-            sl = slice(i, i + 256)
+        for i in range(0, n - bs + 1, bs):
+            sl = slice(i, i + bs)
             batch = {k: v[sl] for k, v in feats.items()}
             params, opt_state, loss, logits = step(params, opt_state, batch, labels[sl])
 

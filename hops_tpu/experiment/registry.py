@@ -32,7 +32,7 @@ def register(record: dict[str, Any]) -> None:
     # Experiments UI search; SURVEY.md §2.2 elasticsearch row).
     from hops_tpu.messaging import searchindex
 
-    searchindex.index_run(json.loads(json.dumps(record, default=str)))
+    searchindex.index_run(record)
 
 
 def list_runs(name: str | None = None) -> list[dict[str, Any]]:

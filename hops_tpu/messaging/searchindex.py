@@ -19,7 +19,6 @@ from collections import defaultdict
 from pathlib import Path
 from typing import Any
 
-from hops_tpu.runtime import config as config_lib
 from hops_tpu.runtime import fs
 
 _TOKEN = re.compile(r"[a-z0-9_]+")
@@ -28,13 +27,14 @@ _lock = threading.Lock()
 
 def get_elasticsearch_config(index: str) -> dict[str, str]:
     """Connector config for an external ES cluster (reference shape:
-    host/port/auth keys consumed by the Spark connector). Values come
-    from the runtime config/env; the embedded index below needs none."""
-    rt = config_lib.runtime()
-    host = getattr(rt, "elasticsearch_host", None) or "localhost"
+    host/port/auth keys consumed by the Spark connector). Point at a
+    real cluster via ``HOPS_TPU_ES_HOST``/``HOPS_TPU_ES_PORT``; the
+    embedded index below needs none of this."""
+    import os
+
     return {
-        "es.nodes": host,
-        "es.port": "9200",
+        "es.nodes": os.environ.get("HOPS_TPU_ES_HOST", "localhost"),
+        "es.port": os.environ.get("HOPS_TPU_ES_PORT", "9200"),
         "es.resource": f"{fs.project_name()}_{index}/_doc",
         "es.net.http.auth.user": fs.project_user(),
         "es.index.auto.create": "true",
@@ -56,7 +56,7 @@ class SearchIndex:
 
     def index_document(self, doc_id: str, doc: dict[str, Any]) -> None:
         with _lock, self._docs_file.open("a") as f:
-            f.write(json.dumps({"_id": doc_id, "_source": doc}) + "\n")
+            f.write(json.dumps({"_id": doc_id, "_source": doc}, default=str) + "\n")
 
     def _scan(self) -> dict[str, dict[str, Any]]:
         docs: dict[str, dict[str, Any]] = {}

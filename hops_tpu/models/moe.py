@@ -125,6 +125,7 @@ class MoEBlock(nn.Module):
     mesh: Any = None
     seq_axis: str = "seq"
     batch_axis: Any = None
+    dropout_rate: float = 0.0
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -139,6 +140,8 @@ class MoEBlock(nn.Module):
             batch_axis=self.batch_axis,
             name="attn",
         )(RMSNorm(dtype=self.dtype)(x))
+        if self.dropout_rate:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         x = x + h
         h = MoEMLP(
             num_experts=self.num_experts,
@@ -146,4 +149,6 @@ class MoEBlock(nn.Module):
             dtype=self.dtype,
             name="moe",
         )(RMSNorm(dtype=self.dtype)(x))
+        if self.dropout_rate:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         return x + h

@@ -157,12 +157,32 @@ class TransformerLM(nn.Module):
     batch_axis: Any = None
     dropout_rate: float = 0.0
     remat: bool = False
+    moe_every: int = 0  # >0: every k-th block routes through experts
+    num_experts: int = 8
+    moe_top_k: int = 2
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
+        from hops_tpu.models.moe import MoEBlock
+
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")(tokens)
         block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
+        moe_cls = nn.remat(MoEBlock, static_argnums=(2,)) if self.remat else MoEBlock
         for i in range(self.num_layers):
+            if self.moe_every and (i + 1) % self.moe_every == 0:
+                x = moe_cls(
+                    self.num_heads,
+                    num_experts=self.num_experts,
+                    top_k=self.moe_top_k,
+                    dtype=self.dtype,
+                    attention_impl=self.attention_impl,
+                    mesh=self.mesh,
+                    seq_axis=self.seq_axis,
+                    batch_axis=self.batch_axis,
+                    dropout_rate=self.dropout_rate,
+                    name=f"block_{i}",
+                )(x, train)
+                continue
             x = block_cls(
                 self.num_heads,
                 dtype=self.dtype,
@@ -178,12 +198,13 @@ class TransformerLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def make_lm_train_step():
+def make_lm_train_step(aux_loss_weight: float = 0.01):
     """Next-token-prediction step: ``(state, {"tokens"}) -> (state, metrics)``.
 
     Same ``step(state, batch)`` contract as ``common.make_train_step``
     so every launcher (launch/mirrored/collective_all_reduce) accepts it
-    unchanged.
+    unchanged. MoE blocks' sown load-balancing losses are folded in at
+    ``aux_loss_weight``.
     """
     import optax
 
@@ -193,13 +214,22 @@ def make_lm_train_step():
         step_rng = jax.random.fold_in(state.rng, state.step)
 
         def compute_loss(params):
-            logits = state.apply_fn(
-                {"params": params}, inputs, train=True, rngs={"dropout": step_rng}
+            logits, mods = state.apply_fn(
+                {"params": params},
+                inputs,
+                train=True,
+                rngs={"dropout": step_rng},
+                mutable=["losses"],
             )
-            loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
-            return loss.mean()
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+            aux = sum(
+                jnp.sum(jnp.stack(v)) for v in jax.tree.leaves(
+                    mods.get("losses", {}), is_leaf=lambda x: isinstance(x, tuple)
+                )
+            ) if mods.get("losses") else 0.0
+            return loss + aux_loss_weight * aux, loss
 
-        loss, grads = jax.value_and_grad(compute_loss)(state.params)
+        (_, loss), grads = jax.value_and_grad(compute_loss, has_aux=True)(state.params)
         state = state.apply_gradients(grads=grads)
         return state, {"loss": loss, "perplexity": jnp.exp(loss)}
 

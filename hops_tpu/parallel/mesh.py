@@ -71,12 +71,23 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def batch_sharding(mesh: Mesh, axis: str = "data") -> NamedSharding:
-    """Leading-dim sharding for batches along the data axis."""
+def pvary(x: Any, axes: Sequence[str | None]) -> Any:
+    """Mark a broadcast constant as device-varying on ``axes`` (shard_map
+    loop-carry typing); shared by the ring-attention and pipeline
+    collectives."""
+    axes = tuple(a for a in axes if a is not None)
+    if hasattr(jax.lax, "pcast"):  # current API; pvary is its deprecated alias
+        return jax.lax.pcast(x, axes, to="varying")
+    return jax.lax.pvary(x, axes)
+
+
+def batch_sharding(mesh: Mesh, axis: str | tuple[str, ...] = "data") -> NamedSharding:
+    """Leading-dim sharding for batches along the data axis (or several
+    combined axes, e.g. ``("data", "fsdp")`` for ZeRO semantics)."""
     return NamedSharding(mesh, P(axis))
 
 
-def shard_batch(mesh: Mesh, batch: Any, axis: str = "data") -> Any:
+def shard_batch(mesh: Mesh, batch: Any, axis: str | tuple[str, ...] = "data") -> Any:
     """Place a host-local batch tree onto the mesh, sharded on ``axis``.
 
     Multi-host: each process contributes its local shard and the result

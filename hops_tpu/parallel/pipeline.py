@@ -57,7 +57,7 @@ def pipeline_apply(
 
     def local_fn(params, x):
         # params leaves arrive as (1, ...) slices of the stage stack.
-        from hops_tpu.parallel.ringattention import _pvary
+        from hops_tpu.parallel.mesh import pvary as _pvary
 
         params = jax.tree.map(lambda p: p[0], params)
         s = jax.lax.axis_index(axis)

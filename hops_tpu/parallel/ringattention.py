@@ -36,11 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from hops_tpu.ops.attention import NEG_INF, flash_attention
 
 
-def _pvary(x, axes):
-    axes = tuple(a for a in axes if a is not None)
-    if hasattr(jax.lax, "pcast"):  # current API; pvary is its deprecated alias
-        return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)
+from hops_tpu.parallel.mesh import pvary as _pvary
 
 
 def _local_scores(q, k, sm_scale, q_offset, k_offset, causal):

@@ -141,6 +141,16 @@ class ShardedStrategy(Strategy):
         super().__init__(mesh, "data")
         self.min_shard_size = min_shard_size
 
+    # ZeRO semantics: the batch shards over data AND fsdp — each fsdp
+    # group works on different samples (params are what fsdp shards);
+    # only the model axis replicates the batch.
+    @property
+    def num_replicas_in_sync(self) -> int:
+        return self.mesh.shape["data"] * self.mesh.shape["fsdp"]
+
+    def distribute_batch(self, batch: Any) -> Any:
+        return mesh_lib.shard_batch(self.mesh, batch, ("data", "fsdp"))
+
     def _spec_for(self, leaf: Any) -> P:
         from hops_tpu.parallel import sharding as shard_lib
 

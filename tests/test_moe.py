@@ -88,3 +88,24 @@ def test_moe_block_in_transformer_shape():
     variables = block.init(jax.random.PRNGKey(0), x)
     out = block.apply(variables, x)
     assert out.shape == x.shape
+
+
+def test_moe_transformer_lm_trains():
+    from hops_tpu.models import common
+    from hops_tpu.models.transformer import TransformerLM, make_lm_train_step
+
+    model = TransformerLM(
+        vocab_size=64, d_model=32, num_heads=4, num_layers=2,
+        dtype=jnp.float32, attention_impl="reference",
+        moe_every=2, num_experts=4, moe_top_k=2,
+    )
+    state = common.create_train_state(
+        model, jax.random.PRNGKey(0), (2, 16), input_dtype=jnp.int32, learning_rate=1e-2
+    )
+    assert "block_1" in state.params and "moe" in state.params["block_1"]
+    step = jax.jit(make_lm_train_step())
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 17), 0, 64)
+    _, first = step(state, {"tokens": tokens})
+    for _ in range(15):
+        state, metrics = step(state, {"tokens": tokens})
+    assert float(metrics["loss"]) < float(first["loss"])

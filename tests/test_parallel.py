@@ -95,3 +95,13 @@ class TestMultihost:
         assert multihost.all_hosts_agree(3.0)
         multihost.barrier("t")
         assert multihost.broadcast_from_chief(np.float32(5.0)) == 5.0
+
+
+def test_launch_cli_single_host(tmp_path, capsys):
+    """python -m hops_tpu.launch script.py — single host needs no flags."""
+    from hops_tpu import launch
+
+    script = tmp_path / "train.py"
+    script.write_text("import sys; print('launched', sys.argv[1:])")
+    launch.main([str(script), "--epochs", "3"])
+    assert "launched ['--epochs', '3']" in capsys.readouterr().out

@@ -94,3 +94,13 @@ def test_dp_plus_sp_transformer_step():
     np.testing.assert_allclose(
         float(metrics["loss"]), float(ref_metrics["loss"]), rtol=2e-4
     )
+
+
+def test_fsdp_shards_batch_zero_style():
+    """ZeRO semantics: batch shards over data AND fsdp; replica count
+    reflects both axes."""
+    st = ShardedStrategy(data=2, fsdp=4, model=1, min_shard_size=1024)
+    assert st.num_replicas_in_sync == 8
+    batch = st.distribute_batch(_batch(16))
+    spec = batch["image"].sharding.spec
+    assert spec[0] == ("data", "fsdp")
