@@ -29,6 +29,11 @@ if TYPE_CHECKING:
 
 _KIND = "trainingdatasets"
 _FORMATS = ("parquet", "csv", "tfrecord", "recordio")
+# Parquet-based formats the reference materialized through Spark
+# libraries (petastorm/PetastormHelloWorld.ipynb, delta/DeltaOnHops.ipynb,
+# SURVEY.md §2.6 "Formats on disk") store as parquet here; time travel
+# (the Delta/Hudi capability) lives on feature groups' commit log.
+_FORMAT_ALIASES = {"petastorm": "parquet", "delta": "parquet", "hudi": "parquet"}
 
 
 class TrainingDataset:
@@ -47,8 +52,12 @@ class TrainingDataset:
         statistics_config: Any = None,
         train_split: str | None = None,
     ):
+        data_format = _FORMAT_ALIASES.get(data_format.lower(), data_format.lower())
         if data_format not in _FORMATS:
-            raise ValueError(f"data_format must be one of {_FORMATS}, got {data_format!r}")
+            raise ValueError(
+                f"data_format must be one of {_FORMATS} (or aliases "
+                f"{tuple(_FORMAT_ALIASES)}), got {data_format!r}"
+            )
         self._fs = feature_store
         self.name = name
         self.version = version

@@ -56,3 +56,23 @@ def test_lagom_search_inprocess():
     result = lagom_search.main()
     assert result["best_metric"] > 0.5
     assert result["best_config"].keys() == {"kernel", "pool", "dropout"}
+
+
+def test_iris_sklearn_python_predictor():
+    from examples import iris_sklearn
+
+    result = iris_sklearn.main()
+    assert result["accuracy"] > 0.9
+    assert len(result["predictions"]) == 3
+
+
+def test_td_format_aliases():
+    import pandas as pd
+
+    import hops_tpu.featurestore as hsfs
+
+    fs = hsfs.connection().get_feature_store()
+    td = fs.create_training_dataset("aliased", version=1, data_format="petastorm")
+    assert td.data_format == "parquet"
+    td.save(pd.DataFrame({"a": [1, 2, 3]}))
+    assert len(td.read()) == 3
