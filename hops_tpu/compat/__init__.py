@@ -1,0 +1,36 @@
+"""`hops`-compatible API shims — run reference notebook code unchanged.
+
+A user of the reference writes ``from hops import experiment, hdfs,
+model, serving, kafka, tls, devices, util, hive, elasticsearch`` and
+``from maggy import experiment as maggy_experiment`` (SURVEY.md §2.2-2.4).
+These shims expose the same module/function names over the TPU-native
+implementations, so that code moves with one import change:
+
+    from hops_tpu.compat import experiment, hdfs, model, serving
+    experiment.launch(train_fn, name="mnist", metric_key="accuracy")
+    hdfs.copy_to_local(hdfs.project_path("Resources/data.csv"))
+
+Semantics notes: "GPUs" become TPU chips (``devices.get_num_gpus``),
+"executors" become hosts (``util.num_executors``), HDFS paths are
+project-workspace paths, Kafka is the embedded pubsub layer. Each shim
+is a thin re-export — the native APIs under ``hops_tpu.*`` remain the
+first-class surface.
+"""
+
+from hops_tpu.compat import (  # noqa: F401
+    dataset,
+    devices,
+    elasticsearch,
+    experiment,
+    hdfs,
+    hive,
+    jobs,
+    kafka,
+    maggy,
+    model,
+    project,
+    serving,
+    tensorboard,
+    tls,
+    util,
+)

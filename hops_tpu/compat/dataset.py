@@ -1,0 +1,3 @@
+"""`hops.dataset` shim — dataset staging (jobs_spark_client.py:49-50)."""
+
+from hops_tpu.jobs.dataset import download, extract, upload, upload_workspace  # noqa: F401

@@ -1,0 +1,11 @@
+"""`hops.devices` shim — accelerator discovery (SURVEY.md §2.2).
+
+"GPUs per container" becomes "TPU chips visible to this host".
+"""
+
+from hops_tpu.runtime.devices import get_num_chips, get_num_local_chips, topology  # noqa: F401
+
+
+def get_num_gpus() -> int:
+    """Reference name; counts this host's TPU chips."""
+    return get_num_local_chips()

@@ -1,0 +1,3 @@
+"""`hops.elasticsearch` shim (reference: Elasticsearch-python.ipynb:72)."""
+
+from hops_tpu.messaging.searchindex import get_elasticsearch_config  # noqa: F401

@@ -1,0 +1,11 @@
+"""`hops.experiment` shim (SURVEY.md §2.3) — identical call surface."""
+
+from hops_tpu.experiment import (  # noqa: F401
+    collective_all_reduce,
+    differential_evolution,
+    grid_search,
+    lagom,
+    launch,
+    mirrored,
+    parameter_server,
+)

@@ -1,0 +1,26 @@
+"""`hops.hdfs` shim (reference surface: SURVEY.md §2.2 hdfs row).
+
+Project-scoped filesystem verbs over the workspace tree; ``hdfs://``
+URI arguments are accepted and mapped into the project path.
+"""
+
+from hops_tpu.runtime.fs import (  # noqa: F401
+    chmod,
+    cp,
+    copy_to_local,
+    dump,
+    exists,
+    glob,
+    load,
+    ls,
+    lsl,
+    mkdir,
+    move,
+    project_name,
+    project_path,
+    project_user,
+    rename,
+    rmr,
+    stat,
+)
+from hops_tpu.runtime.fs import copy_to_workspace as copy_to_hdfs  # noqa: F401

@@ -1,0 +1,9 @@
+"""`hops.hive` shim — SQL gateway (reference: PyHive.ipynb:46)."""
+
+from hops_tpu.sql import gateway as _gateway
+
+
+def setup_hive_connection(feature_store=None):
+    """Reference name; returns a DB-API-style connection over the
+    feature store's tables."""
+    return _gateway.connection(feature_store)

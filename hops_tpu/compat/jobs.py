@@ -1,0 +1,12 @@
+"""`hops.jobs` shim — jobs REST verbs (SURVEY.md §2.7)."""
+
+from hops_tpu.jobs.api import (  # noqa: F401
+    create_job,
+    delete_job,
+    get_executions,
+    get_job,
+    get_jobs,
+    start_job,
+    stop_job,
+    wait_for_completion,
+)

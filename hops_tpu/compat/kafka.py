@@ -1,0 +1,10 @@
+"""`hops.kafka` shim (reference: KafkaPython.ipynb usage, SURVEY.md §2.2)."""
+
+from hops_tpu.messaging.pubsub import (  # noqa: F401
+    Consumer,
+    Producer,
+    create_topic,
+    get_broker_endpoints,
+    get_schema,
+    get_security_protocol,
+)

@@ -1,0 +1,19 @@
+"""`maggy` shim (SURVEY.md §2.4): Searchspace + lagom + ablation.
+
+Reference usage::
+
+    from maggy import Searchspace, experiment
+    sp = Searchspace(kernel=('INTEGER', [2, 8]))
+    experiment.lagom(train_fn=..., searchspace=sp, optimizer='randomsearch', ...)
+
+maps to ``from hops_tpu.compat import maggy`` then
+``maggy.Searchspace(...)`` / ``maggy.experiment.lagom(...)``.
+"""
+
+import types
+
+from hops_tpu.search import AblationStudy, Searchspace  # noqa: F401
+from hops_tpu.search.drivers import lagom as _lagom
+from hops_tpu.experiment import tensorboard  # noqa: F401
+
+experiment = types.SimpleNamespace(lagom=_lagom)

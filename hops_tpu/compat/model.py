@@ -1,0 +1,9 @@
+"""`hops.model` shim — model repository (SURVEY.md §2.5)."""
+
+from hops_tpu.modelrepo.registry import (  # noqa: F401
+    Metric,
+    export,
+    get_best_model,
+    get_model,
+    list_models,
+)

@@ -1,0 +1,13 @@
+"""`hops.project` shim — control-plane connection (SURVEY.md §2.7)."""
+
+from hops_tpu.runtime import config as _config
+from hops_tpu.runtime import fs as _fs
+
+
+def connect(project: str | None = None, host: str | None = None,
+            api_key: str | None = None, **_ignored):
+    """Reference: REST handshake; here, select/initialize the local
+    project workspace."""
+    if project:
+        _config.configure(project=project)
+    return _fs.project_name()

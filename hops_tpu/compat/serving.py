@@ -1,0 +1,13 @@
+"""`hops.serving` shim — serving lifecycle + inference (SURVEY.md §2.5)."""
+
+from hops_tpu.modelrepo.serving import (  # noqa: F401
+    create_or_update,
+    delete,
+    exists,
+    get_all,
+    get_kafka_topic,
+    get_status,
+    make_inference_request,
+    start,
+    stop,
+)

@@ -1,0 +1,3 @@
+"""`hops.tensorboard` shim — per-run logdir contract (SURVEY.md §2.3)."""
+
+from hops_tpu.experiment.tensorboard import flush, logdir, profile, scalar  # noqa: F401

@@ -1,0 +1,11 @@
+"""`hops.tls` shim — per-project security material (SURVEY.md §2.2)."""
+
+from hops_tpu.messaging.tls import (  # noqa: F401
+    get_ca_chain_location,
+    get_client_certificate_location,
+    get_client_key_location,
+    get_key_store,
+    get_key_store_pwd,
+    get_trust_store,
+    get_trust_store_pwd,
+)

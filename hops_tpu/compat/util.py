@@ -1,0 +1,13 @@
+"""`hops.util` shim (SURVEY.md §2.2): cluster-size introspection."""
+
+from hops_tpu.runtime import devices as _devices
+
+
+def num_executors() -> int:
+    """Reference: Spark executor count; here, hosts in the slice."""
+    return _devices.num_hosts()
+
+
+def num_param_servers() -> int:
+    """PS has no TPU analog (SURVEY.md §2.9 row 3); always 0."""
+    return 0
