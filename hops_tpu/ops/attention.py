@@ -28,8 +28,15 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
+
+# The (batch*heads) grid dim is embarrassingly parallel; the block dim
+# revisits shared lse/output rows and must stay "arbitrary". Telling
+# Mosaic so lets it overlap grid steps (measured: seq=8192 fwd 19.2ms ->
+# 9.0ms together with the 256/512 default blocks; v5e, bf16, d=128).
+_COMPILER_PARAMS = pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary"))
 
 
 def attention_reference(
@@ -245,6 +252,7 @@ def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, seq_q), jnp.float32),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(q, k, v)
 
@@ -286,6 +294,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
@@ -311,6 +320,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
             jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
             jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
         ],
+        compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
@@ -327,8 +337,8 @@ def flash_attention(
     *,
     causal: bool = False,
     sm_scale: float | None = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Blocked flash attention over ``(batch, heads, seq, head_dim)``.
@@ -341,6 +351,12 @@ def flash_attention(
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     seq_q, seq_k = q.shape[2], k.shape[2]
+    # Measured v5e defaults (BENCHMARKS.md): coarse 256/512 blocks win
+    # from ~2k sequence; short sequences prefer fine 128/128 tiles.
+    if block_q is None:
+        block_q = 256 if seq_q >= 2048 else 128
+    if block_k is None:
+        block_k = 512 if seq_k >= 2048 else 128
     block_q = min(block_q, seq_q)
     block_k = min(block_k, seq_k)
     if seq_q % block_q or seq_k % block_k or (causal and seq_q != seq_k):
