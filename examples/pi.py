@@ -1,0 +1,37 @@
+"""Monte-Carlo pi — the jobs-client toy payload, with a cross-module import.
+
+Twin of jobs-client/user_program/code/pi.py + resources/util.py
+(SURVEY.md §2.7): the fixture for remote job submission. The reference
+zips a workspace whose main file imports a sibling module
+(``pi_util.py`` here) — staging must carry both files. Estimation
+itself is a jitted JAX kernel — even the toy payload computes on the
+accelerator.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+import pi_util  # noqa: E402  (the reference's cross-module import demo)
+
+
+def estimate_pi(samples: int = 1_000_000, seed: int = 0) -> float:
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(key):
+        kx, ky = jax.random.split(key)
+        x = jax.random.uniform(kx, (samples,))
+        y = jax.random.uniform(ky, (samples,))
+        return jnp.mean(pi_util.inside(x, y)) * 4.0
+
+    return float(run(jax.random.PRNGKey(seed)))
+
+
+if __name__ == "__main__":
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    print(f"pi is roughly {estimate_pi(n):.6f}")

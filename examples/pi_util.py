@@ -1,0 +1,6 @@
+"""Sibling module for the pi job — the reference's cross-module-import
+demo (jobs-client/user_program/resources/util.py:1-3)."""
+
+
+def inside(x, y):
+    return x * x + y * y <= 1.0

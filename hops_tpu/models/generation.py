@@ -1,0 +1,81 @@
+"""Autoregressive sampling for TransformerLM — KV-cached decode.
+
+Beyond-reference capability (its serving is one-shot classifier REST
+calls, SURVEY.md §2.5): text-generation inference with the TPU decode
+pattern — a prefill pass writes the prompt into each layer's KV cache
+(one ``dynamic_update_slice``), then ``lax.scan`` single-token steps
+reuse the cache, so per-token cost is O(seq·d) instead of re-running
+full attention. Static shapes throughout: the cache is allocated at
+``max_decode_len`` and masked, so jit compiles exactly two programs
+(prefill + step).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "max_new_tokens", "top_k", "temperature")
+)
+def generate(
+    model: Any,
+    params: Any,
+    prompt: jax.Array,
+    rng: jax.Array,
+    max_new_tokens: int = 32,
+    temperature: float = 1.0,
+    top_k: int | None = None,
+) -> jax.Array:
+    """Sample ``max_new_tokens`` continuations of ``prompt`` (b, L).
+
+    ``temperature=0`` (or ``top_k=1``) is greedy decoding. Returns
+    ``(b, L + max_new_tokens)`` token ids. ``model.max_decode_len`` must
+    cover the full final length.
+    """
+    b, prompt_len = prompt.shape
+    if prompt_len + max_new_tokens > model.max_decode_len:
+        raise ValueError(
+            f"prompt {prompt_len} + {max_new_tokens} new tokens exceeds "
+            f"max_decode_len {model.max_decode_len}"
+        )
+
+    # Prefill: write the whole prompt into the caches in one pass.
+    logits, variables = model.apply(
+        {"params": params}, prompt, decode=True, mutable=["cache"]
+    )
+    cache = variables["cache"]
+
+    def sample(logits_row, key):
+        if temperature == 0.0 or top_k == 1:
+            return jnp.argmax(logits_row, axis=-1)
+        logits_row = logits_row / max(temperature, 1e-6)
+        if top_k is not None:
+            kth = jnp.sort(logits_row, axis=-1)[:, -top_k][:, None]
+            logits_row = jnp.where(logits_row < kth, -jnp.inf, logits_row)
+        return jax.random.categorical(key, logits_row, axis=-1)
+
+    rng, key = jax.random.split(rng)
+    first = sample(logits[:, -1], key)
+
+    def step(carry, _):
+        cache, tok, rng = carry
+        rng, key = jax.random.split(rng)
+        logits, variables = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            decode=True,
+            mutable=["cache"],
+        )
+        nxt = sample(logits[:, -1], key)
+        return (variables["cache"], nxt, rng), nxt
+
+    (_, _, _), rest = jax.lax.scan(
+        step, (cache, first, rng), None, length=max_new_tokens - 1
+    )
+    new_tokens = jnp.concatenate([first[None], rest], axis=0).T  # (b, new)
+    return jnp.concatenate([prompt, new_tokens], axis=1)

@@ -126,9 +126,10 @@ class MoEBlock(nn.Module):
     seq_axis: str = "seq"
     batch_axis: Any = None
     dropout_rate: float = 0.0
+    max_decode_len: int = 2048
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False):
         from hops_tpu.models.transformer import Attention, RMSNorm
 
         h = Attention(
@@ -138,8 +139,9 @@ class MoEBlock(nn.Module):
             mesh=self.mesh,
             seq_axis=self.seq_axis,
             batch_axis=self.batch_axis,
+            max_decode_len=self.max_decode_len,
             name="attn",
-        )(RMSNorm(dtype=self.dtype)(x))
+        )(RMSNorm(dtype=self.dtype)(x), decode=decode)
         if self.dropout_rate:
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         x = x + h

@@ -21,6 +21,7 @@ by ``parallel.sharding.infer_param_spec``; activations shard
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import jax
@@ -61,15 +62,20 @@ class Attention(nn.Module):
     mesh: Any = None
     seq_axis: str = "seq"
     batch_axis: Any = None  # data axis name when dp combines with sp
+    max_decode_len: int = 2048  # KV-cache capacity in decode mode
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, decode: bool = False):
         b, s, dm = x.shape
         head_dim = dm // self.num_heads
         qkv = nn.DenseGeneral(
             (3, self.num_heads, head_dim), dtype=self.dtype, name="qkv", use_bias=False
         )(x)
         q, k, v = [jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3)]  # (b, h, s, d)
+
+        if decode:
+            return self._decode_attend(q, k, v, b, s, dm, head_dim)
+
         pos = jnp.arange(s)
         q, k = rotary_embedding(q, pos), rotary_embedding(k, pos)
 
@@ -92,6 +98,38 @@ class Attention(nn.Module):
         else:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
 
+        o = jnp.moveaxis(o, 1, 2).reshape(b, s, dm)
+        return nn.DenseGeneral(dm, dtype=self.dtype, name="out", use_bias=False)(o)
+
+    def _decode_attend(self, q, k, v, b, s, dm, head_dim):
+        """Autoregressive attention against a fixed-capacity KV cache.
+
+        The cache holds ``max_decode_len`` positions; prefill writes the
+        whole prompt at offset 0, each later call appends its tokens.
+        Scores run over the full (static-shape) cache with future/empty
+        slots masked — jit sees one shape for every decode step.
+        """
+        cache_shape = (b, self.num_heads, self.max_decode_len, head_dim)
+        ck = self.variable("cache", "k", jnp.zeros, cache_shape, self.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, cache_shape, self.dtype)
+        idx = self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+        offset = idx.value
+
+        pos = offset + jnp.arange(s)
+        q = rotary_embedding(q, pos)
+        k = rotary_embedding(k, pos)
+        ck.value = jax.lax.dynamic_update_slice(ck.value, k.astype(self.dtype), (0, 0, offset, 0))
+        cv.value = jax.lax.dynamic_update_slice(cv.value, v.astype(self.dtype), (0, 0, offset, 0))
+        idx.value = offset + s
+
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", q, ck.value, preferred_element_type=jnp.float32
+        ) / math.sqrt(head_dim)
+        k_pos = jnp.arange(self.max_decode_len)[None, :]
+        visible = k_pos <= pos[:, None]  # causal + excludes unwritten slots
+        scores = jnp.where(visible[None, None], scores, float("-inf"))
+        probs = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(cv.value.dtype), cv.value)
         o = jnp.moveaxis(o, 1, 2).reshape(b, s, dm)
         return nn.DenseGeneral(dm, dtype=self.dtype, name="out", use_bias=False)(o)
 
@@ -122,9 +160,10 @@ class Block(nn.Module):
     seq_axis: str = "seq"
     batch_axis: Any = None
     dropout_rate: float = 0.0
+    max_decode_len: int = 2048
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False):
         h = Attention(
             self.num_heads,
             dtype=self.dtype,
@@ -132,8 +171,9 @@ class Block(nn.Module):
             mesh=self.mesh,
             seq_axis=self.seq_axis,
             batch_axis=self.batch_axis,
+            max_decode_len=self.max_decode_len,
             name="attn",
-        )(RMSNorm(dtype=self.dtype)(x))
+        )(RMSNorm(dtype=self.dtype)(x), decode=decode)
         if self.dropout_rate:
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         x = x + h
@@ -160,14 +200,15 @@ class TransformerLM(nn.Module):
     moe_every: int = 0  # >0: every k-th block routes through experts
     num_experts: int = 8
     moe_top_k: int = 2
+    max_decode_len: int = 2048
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False, decode: bool = False):
         from hops_tpu.models.moe import MoEBlock
 
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")(tokens)
-        block_cls = nn.remat(Block, static_argnums=(2,)) if self.remat else Block
-        moe_cls = nn.remat(MoEBlock, static_argnums=(2,)) if self.remat else MoEBlock
+        block_cls = nn.remat(Block, static_argnums=(2, 3)) if self.remat else Block
+        moe_cls = nn.remat(MoEBlock, static_argnums=(2, 3)) if self.remat else MoEBlock
         for i in range(self.num_layers):
             if self.moe_every and (i + 1) % self.moe_every == 0:
                 x = moe_cls(
@@ -181,7 +222,7 @@ class TransformerLM(nn.Module):
                     batch_axis=self.batch_axis,
                     dropout_rate=self.dropout_rate,
                     name=f"block_{i}",
-                )(x, train)
+                )(x, train, decode)
                 continue
             x = block_cls(
                 self.num_heads,
@@ -191,8 +232,9 @@ class TransformerLM(nn.Module):
                 seq_axis=self.seq_axis,
                 batch_axis=self.batch_axis,
                 dropout_rate=self.dropout_rate,
+                max_decode_len=self.max_decode_len,
                 name=f"block_{i}",
-            )(x, train)
+            )(x, train, decode)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
         logits = nn.Dense(self.vocab_size, dtype=self.dtype, use_bias=False, name="unembed")(x)
         return logits.astype(jnp.float32)

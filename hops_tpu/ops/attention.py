@@ -3,17 +3,19 @@
 The reference never shards or fuses attention (it has no transformer at
 all, SURVEY.md §5 "Long-context … Absent"), but long-context support is
 first-class in this framework, and the memory wall for attention is the
-(seq, seq) score matrix. This kernel keeps scores in VMEM one
-(block_q, block_k) tile at a time, carrying the online-softmax
-statistics (running max ``m``, running sum ``l``) in fp32, so HBM
-traffic is O(seq·d) instead of O(seq²).
+(seq, seq) score matrix. Scores live in VMEM one (block_q, block_k)
+tile at a time, with the online-softmax statistics (running max ``m``,
+running sum ``l``) carried in fp32 VMEM scratch, so HBM traffic is
+O(seq·d) instead of O(seq²).
 
 Layout: ``(batch, heads, seq, head_dim)``. Grid is
-``(batch·heads, seq/block)``; K/V for one (batch, head) live whole in
-VMEM (seq·d·2B — ~2 MB at seq=8192, d=128, bf16) and the kernel walks
-them in ``block_k`` tiles with ``pl.ds``. Causal runs prune the K loop
-to the lower triangle. The backward pass is two more kernels (dq and
-dk/dv) using the saved logsumexp, the standard flash-attention-2 split.
+``(batch·heads, seq_q/block_q, seq_k/block_k)`` — Pallas streams each
+K/V block from HBM per grid step (double-buffered by the pipeline), so
+VMEM holds only one q/k/v tile plus the accumulators and sequence
+length is unbounded (tested to 32k on one v5e chip; BENCHMARKS.md).
+Causal runs skip fully-masked K blocks. The backward pass is two more
+kernels (dq and dk/dv) using the saved logsumexp, the standard
+flash-attention-2 split.
 
 For cross-device sequence parallelism see
 ``hops_tpu.parallel.ringattention`` which rotates K/V chunks over the
@@ -31,12 +33,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = float("-inf")
+_LANES = 128  # VPU lane width: per-row stats are broadcast across lanes
 
-# The (batch*heads) grid dim is embarrassingly parallel; the block dim
-# revisits shared lse/output rows and must stay "arbitrary". Telling
-# Mosaic so lets it overlap grid steps (measured: seq=8192 fwd 19.2ms ->
-# 9.0ms together with the 256/512 default blocks; v5e, bf16, d=128).
-_COMPILER_PARAMS = pltpu.CompilerParams(dimension_semantics=("parallel", "arbitrary"))
+# The (batch·heads) grid dim is embarrassingly parallel; the q/k block
+# dims carry scratch state between steps and must stay "arbitrary".
+_COMPILER_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "arbitrary", "arbitrary")
+)
 
 
 def attention_reference(
@@ -59,71 +62,69 @@ def attention_reference(
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
+def _causal_mask(s, qi, kj, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
 # ---------------------------------------------------------------------------
-# Forward kernel
+# Forward kernel: grid (bh, nq, nk), K/V streamed per grid step
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_k):
-    block_q, head_dim = q_ref.shape[1], q_ref.shape[2]
-    seq_k = k_ref.shape[1]
-    num_k = seq_k // block_k
-    qi = pl.program_id(1)
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale, causal, block_q, block_k,
+):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    q = q_ref[0].astype(jnp.float32) * sm_scale
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+    # Causal: skip K blocks entirely above the diagonal.
+    run = True if not causal else kj * block_k < (qi + 1) * block_q
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]
+        kb = k_ref[0]
         s = jax.lax.dot_general(
-            q.astype(k.dtype),
-            k,
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
+        s = s * sm_scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = j * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        # Fully-masked rows keep m == -inf; subtracting would give nan.
+            s = _causal_mask(s, qi, kj, block_q, block_k)
+        m = m_scr[:, :1]  # (bq, 1), broadcast across lanes
+        l = l_scr[:, :1]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
-        p = jnp.exp(s - m_safe[:, None])
+        p = jnp.exp(s - m_safe)
         alpha = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - m_safe))
-        l = l * alpha + jnp.sum(p, axis=-1)
-        vblk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            p.astype(vblk.dtype),
-            vblk,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+            p.astype(v_ref.dtype), v_ref[0],
+            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
         )
-        acc = acc * alpha[:, None] + pv
-        return m_new, l, acc
+        acc_scr[...] = acc_scr[...] * alpha + pv
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    if causal:
-        # Only K blocks intersecting the lower triangle of this Q block.
-        bound = jnp.minimum(num_k, pl.cdiv((qi + 1) * block_q, block_k))
-    else:
-        bound = num_k
-    m, l, acc = jax.lax.fori_loop(0, bound, body, (m0, l0, acc0))
-
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse rides as a full (1, 1, seq_q) row per (batch·head) — TPU block
-    # shapes must tile (8, 128) or span their dims, so each q-block
-    # program dynamic-stores its slice of the shared row.
-    lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = jnp.where(
-        m == NEG_INF, NEG_INF, m + jnp.log(l_safe)
-    )
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        m = m_scr[:, :1]
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse = jnp.where(m == NEG_INF, NEG_INF, m + jnp.log(l_safe))
+        # lse rides as a full (1, 1, seq_q) row per (batch·head) — TPU
+        # block shapes must tile (8, 128) or span their dims, so each
+        # q-block program dynamic-stores its slice of the shared row.
+        lse_ref[0, 0, pl.ds(qi * block_q, block_q)] = lse[:, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -132,91 +133,92 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal, block_
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, sm_scale, causal, block_k
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
+    *, sm_scale, causal, block_q, block_k,
 ):
-    block_q = q_ref.shape[1]
-    seq_k = k_ref.shape[1]
-    num_k = seq_k // block_k
-    qi = pl.program_id(1)
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)]
-    delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)]
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    run = True if not causal else kj * block_k < (qi + 1) * block_q
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         s = s * sm_scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, qi, kj, block_q, block_k)
         lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
-        p = jnp.where(lse[:, None] == NEG_INF, 0.0, jnp.exp(s - lse_safe[:, None]))
+        p = jnp.where(lse == NEG_INF, 0.0, jnp.exp(s - lse_safe))
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[:, None]) * sm_scale
-        return dq + jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ds = p * (dp - delta) * sm_scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    bound = jnp.minimum(num_k, pl.cdiv((qi + 1) * block_q, block_k)) if causal else num_k
-    dq = jax.lax.fori_loop(
-        0, bound, body, jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
-    )
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, sm_scale, causal, block_q,
+    dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k,
 ):
-    block_k, head_dim = k_ref.shape[1], k_ref.shape[2]
-    seq_q = q_ref.shape[1]
-    num_q = seq_q // block_q
-    kj = pl.program_id(1)
+    kj, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
 
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, 0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, 0, pl.ds(i * block_q, block_q)]
+    run = True if not causal else kj * block_k < (qi + 1) * block_q
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q)][:, None]
         s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            q, kb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
         s = s * sm_scale
         if causal:
-            q_pos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, qi, kj, block_q, block_k)
         lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
-        p = jnp.where(lse[:, None] == NEG_INF, 0.0, jnp.exp(s - lse_safe[:, None]))
-        dv = dv + jax.lax.dot_general(
+        p = jnp.where(lse == NEG_INF, 0.0, jnp.exp(s - lse_safe))
+        dv_scr[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            do, vb, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta[:, None]) * sm_scale
-        dk = dk + jax.lax.dot_general(
+        ds = p * (dp - delta) * sm_scale
+        dk_scr[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
-        return dk, dv
 
-    start = (kj * block_k) // block_q if causal else 0
-    zeros = jnp.zeros((block_k, head_dim), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, num_q, body, (zeros, zeros))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -232,25 +234,30 @@ def _flat(x):
 def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
-    grid = (bh, seq_q // block_q)
+    grid = (bh, seq_q // block_q, seq_k // block_k)
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k
     )
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, i, j: (b, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, seq_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
@@ -279,46 +286,51 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), axis=-1)[:, None, :]
 
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_k=block_k
+        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k
     )
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(bh, seq_q // block_q),
+        grid=(bh, seq_q // block_q, seq_k // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_k, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, seq_q), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, i, j: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, i, j: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, seq_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
     )(qf, kf, vf, gf, lse, delta)
 
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q
+        _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, seq_k // block_k),
+        grid=(bh, seq_k // block_k, seq_q // block_q),
         in_specs=[
-            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, seq_q, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, seq_q), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, seq_q), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, j, i: (b, 0, 0)),
+            pl.BlockSpec((1, 1, seq_q), lambda b, j, i: (b, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_k, d), k.dtype),
             jax.ShapeDtypeStruct((bh, seq_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         compiler_params=_COMPILER_PARAMS,
         interpret=interpret,
@@ -328,6 +340,14 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _fit_block(seq: int, preferred: int) -> int | None:
+    """Largest block ≤ preferred that divides ``seq`` (128-granular)."""
+    for b in (preferred, 2048, 1024, 512, 384, 256, 128):
+        if b <= preferred and seq % b == 0:
+            return b
+    return None
 
 
 def flash_attention(
@@ -351,15 +371,34 @@ def flash_attention(
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     seq_q, seq_k = q.shape[2], k.shape[2]
-    # Measured v5e defaults (BENCHMARKS.md): coarse 256/512 blocks win
-    # from ~2k sequence; short sequences prefer fine 128/128 tiles.
+    # Measured v5e sweet spots per sequence length (BENCHMARKS.md):
+    # short sequences want fine tiles, long ones coarse tiles (fewer
+    # K/V refetches across q blocks). A preferred size that doesn't
+    # divide the sequence shrinks to the largest 128-multiple divisor
+    # rather than silently punting to the O(seq²) reference.
+    if seq_k <= 1024:
+        default_q, default_k = 128, 128
+    elif seq_k <= 2048:
+        default_q, default_k = 512, 1024
+    elif seq_k <= 4096:
+        default_q, default_k = 1024, 1024
+    else:
+        default_q, default_k = 1024, 2048
     if block_q is None:
-        block_q = 256 if seq_q >= 2048 else 128
+        block_q = _fit_block(seq_q, default_q)
     if block_k is None:
-        block_k = 512 if seq_k >= 2048 else 128
-    block_q = min(block_q, seq_q)
-    block_k = min(block_k, seq_k)
-    if seq_q % block_q or seq_k % block_k or (causal and seq_q != seq_k):
+        block_k = _fit_block(seq_k, default_k)
+    if block_q:
+        block_q = min(block_q, seq_q)
+    if block_k:
+        block_k = min(block_k, seq_k)
+    if (
+        not block_q
+        or not block_k
+        or seq_q % block_q
+        or seq_k % block_k
+        or (causal and seq_q != seq_k)
+    ):
         return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

@@ -11,7 +11,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).parent.parent))
 
 from hops_tpu import jobs
-from hops_tpu.jobs import api
+from hops_tpu.jobs import api, dataset
 
 
 def test_make_builds_site(tmp_path):
@@ -76,3 +76,19 @@ def test_td_format_aliases():
     assert td.data_format == "parquet"
     td.save(pd.DataFrame({"a": [1, 2, 3]}))
     assert len(td.read()) == 3
+
+
+def test_pi_job_with_staged_workspace(tmp_path):
+    """jobs-client workflow: zip workspace -> stage -> extract -> run as job."""
+    src = Path(__file__).parent.parent / "examples"
+    ws = tmp_path / "ws"
+    ws.mkdir()
+    (ws / "pi.py").write_text((src / "pi.py").read_text())
+    (ws / "pi_util.py").write_text((src / "pi_util.py").read_text())
+    staged = dataset.upload_workspace(ws, "Resources", name="pi_program.zip")
+    rundir = dataset.extract(staged, tmp_path / "run")
+    jobs.create_job("pi_job", api.JobConfig(app_file=str(Path(rundir) / "pi.py"), default_args=["200000"]))
+    ex = jobs.start_job("pi_job")
+    done = jobs.wait_for_completion("pi_job", ex.execution_id, timeout_s=120)
+    assert done.state == "FINISHED", done.stdout()
+    assert "pi is roughly 3.1" in done.stdout()

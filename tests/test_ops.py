@@ -59,3 +59,27 @@ def test_flash_under_jit_and_vmapped_batch():
     np.testing.assert_allclose(
         f(q, k, v), attention_reference(q, k, v, causal=True), atol=2e-5, rtol=2e-5
     )
+
+
+@pytest.mark.parametrize("seq", [1536, 2048, 2560])
+def test_default_blocks_keep_kernel_path(monkeypatch, seq):
+    """Non-power-of-two seqs must shrink blocks, not fall back to the
+    O(seq^2) reference (regression: seq=1536 silently took the fallback)."""
+    from hops_tpu.ops import attention as A
+
+    def boom(*a, **k):
+        raise AssertionError("fell back to attention_reference")
+
+    monkeypatch.setattr(A, "attention_reference", boom)
+    q, k, v = _inputs(batch=1, heads=1, seq=seq, d=32)
+    out = A.flash_attention(q, k, v, causal=True)
+    assert out.shape == q.shape
+
+
+def test_fit_block_divisors():
+    from hops_tpu.ops.attention import _fit_block
+
+    assert _fit_block(1536, 1024) == 512
+    assert _fit_block(2048, 1024) == 1024
+    assert _fit_block(2560, 2048) == 512
+    assert _fit_block(100, 128) is None
