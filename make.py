@@ -87,6 +87,11 @@ def build(out_dir: Path) -> list[Path]:
     sources = sorted((ROOT / "hops_tpu").rglob("*.py")) + sorted(
         (ROOT / "examples").glob("*.py")
     )
+    # Hand-written guides pass through unchanged.
+    for guide in sorted((ROOT / "docs").glob("*.md")) if (ROOT / "docs").exists() else []:
+        dst = content / guide.name
+        dst.write_text(guide.read_text())
+        written.append(dst)
     index = [
         "# hops-tpu",
         "",
