@@ -107,12 +107,10 @@ def main() -> None:
 
     # Baselines are recorded per platform: the first real run on a
     # platform becomes that platform's baseline; later runs report
-    # against it. (The legacy single-record file form is migrated.)
+    # against it.
     baseline = None
     if not args.smoke:
         recorded = json.loads(BASELINE_FILE.read_text()) if BASELINE_FILE.exists() else {}
-        if "platform" in recorded:  # legacy single-record form
-            recorded = {recorded["platform"]: recorded}
         entry = recorded.get(result["platform"])
         if entry is not None:
             baseline = entry.get("samples_per_sec_per_chip")

@@ -10,6 +10,7 @@ dataset; the latest record per run_id wins, so status transitions
 from __future__ import annotations
 
 import json
+import logging
 import time
 from pathlib import Path
 from typing import Any
@@ -29,10 +30,15 @@ def register(record: dict[str, Any]) -> None:
     with _index_path().open("a") as f:
         f.write(json.dumps(record, default=str) + "\n")
     # Make the run findable (the platform indexed runs into ES for the
-    # Experiments UI search; SURVEY.md §2.2 elasticsearch row).
-    from hops_tpu.messaging import searchindex
+    # Experiments UI search; SURVEY.md §2.2 elasticsearch row). Indexing
+    # is best-effort: the JSONL append above is the record of truth, and
+    # a search-index failure must not fail run registration.
+    try:
+        from hops_tpu.messaging import searchindex
 
-    searchindex.index_run(record)
+        searchindex.index_run(record)
+    except Exception as exc:  # pragma: no cover - defensive
+        logging.getLogger(__name__).warning("run search-indexing failed: %s", exc)
 
 
 def list_runs(name: str | None = None) -> list[dict[str, Any]]:

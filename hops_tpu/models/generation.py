@@ -38,6 +38,8 @@ def generate(
     cover the full final length.
     """
     b, prompt_len = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if prompt_len + max_new_tokens > model.max_decode_len:
         raise ValueError(
             f"prompt {prompt_len} + {max_new_tokens} new tokens exceeds "

@@ -221,6 +221,7 @@ class TransformerLM(nn.Module):
                     seq_axis=self.seq_axis,
                     batch_axis=self.batch_axis,
                     dropout_rate=self.dropout_rate,
+                    max_decode_len=self.max_decode_len,
                     name=f"block_{i}",
                 )(x, train, decode)
                 continue

@@ -69,3 +69,25 @@ def test_generate_rejects_overflow():
 
     with pytest.raises(ValueError, match="max_decode_len"):
         generate(model, params, prompt, jax.random.PRNGKey(0), max_new_tokens=10)
+
+
+def test_generate_rejects_zero_new_tokens():
+    model, params = _model_and_params()
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    import pytest
+
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(model, params, prompt, jax.random.PRNGKey(0), max_new_tokens=0)
+
+
+def test_moe_blocks_inherit_max_decode_len():
+    """MoE layers' KV caches must size to the model's max_decode_len, not
+    the MoEBlock default — otherwise decode past 2048 silently clamps."""
+    model = TransformerLM(**{**TINY, "moe_every": 1, "num_experts": 2, "moe_top_k": 1})
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens, decode=True)
+    caches = jax.tree_util.tree_leaves_with_path(variables["cache"])
+    # Cache layout: (batch, heads, max_decode_len, head_dim) — transformer.py
+    # _decode_attend. Every k/v cache in every (MoE) block must use it.
+    key_lens = {leaf.shape[2] for path, leaf in caches if leaf.ndim == 4}
+    assert key_lens == {TINY["max_decode_len"]}, key_lens
