@@ -100,8 +100,16 @@ class Query:
 
     # -- execution ------------------------------------------------------------
 
-    def _base_frame(self, as_of) -> pd.DataFrame:
-        df = self._fg.read(wallclock_time=as_of)
+    def _base_frame(self, as_of, online: bool) -> pd.DataFrame:
+        if online:
+            if not self._fg.online_enabled:
+                raise ValueError(
+                    f"feature group {self._fg.name}_{self._fg.version} is not "
+                    "online_enabled; online=True would silently return no rows"
+                )
+            df = self._fg.read(online=True)
+        else:
+            df = self._fg.read(wallclock_time=as_of)
         if df.empty:
             return pd.DataFrame(columns=[f.name for f in self._fg.features])
         return df
@@ -124,11 +132,22 @@ class Query:
         return cols
 
     def read(self, online: bool = False, dataframe_type: str = "pandas",
-             _extra_keep: tuple = (), _as_of=None, _project: bool = True) -> pd.DataFrame:
+             _extra_keep: tuple = (), _as_of=None, _project: bool = True):
+        """Execute the query. ``online=True`` runs the same select/join/
+        filter tree against every group's online store (latest values
+        only — reference: ``query.show(n, online=True)``,
+        feature_exploration.ipynb cell 12); the offline commit log is
+        not consulted, so rows committed offline-only are absent.
+        """
         # as_of flows down from the root read without mutating children, so
         # a shared sub-query is unaffected by a parent's point-in-time read.
         as_of = self._as_of if self._as_of is not None else _as_of
-        df = self._base_frame(as_of)
+        if online and as_of is not None:
+            raise ValueError(
+                "online=True reads latest serving values; it cannot be "
+                "combined with as_of() time travel"
+            )
+        df = self._base_frame(as_of, online)
         # Columns needed for execution: selected + join keys + filter columns
         # (+ anything a parent needs from this side: its join keys AND its
         # filter columns, which may live in this group or deeper).
@@ -144,7 +163,8 @@ class Query:
         for j in self._joins:
             right_keys = tuple(j.on or j.right_on)
             right = j.query.read(
-                _extra_keep=right_keys + pass_down, _as_of=as_of, _project=False
+                online=online, _extra_keep=right_keys + pass_down,
+                _as_of=as_of, _project=False,
             )
             if j.prefix:
                 key_cols = set(j.on or j.right_on)
@@ -165,7 +185,8 @@ class Query:
             # result — and any TD schema derived from it — is exactly the
             # selection.
             df = df[[c for c in self._output_columns() if c in df.columns]]
-        return df.reset_index(drop=True)
+        df = df.reset_index(drop=True)
+        return _convert(df, dataframe_type) if _project else df
 
     def show(self, n: int = 5, online: bool = False) -> pd.DataFrame:
         return self.read(online=online).head(n)
@@ -230,6 +251,22 @@ class Query:
 
     def __repr__(self) -> str:
         return f"Query({self.to_string()})"
+
+
+def _convert(df: pd.DataFrame, dataframe_type: str):
+    """Result conversion — reference hsfs ``dataframe_type`` values
+    pandas/numpy/python (spark has no TPU-side analog)."""
+    kind = dataframe_type.lower()
+    if kind in ("pandas", "default"):
+        return df
+    if kind == "numpy":
+        return df.to_numpy()
+    if kind == "python":
+        return df.to_dict("records")
+    raise ValueError(
+        f"unsupported dataframe_type {dataframe_type!r}; "
+        "expected pandas | numpy | python"
+    )
 
 
 def _condition_columns(cond) -> set[str]:

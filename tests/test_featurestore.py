@@ -165,6 +165,42 @@ class TestQuery:
         df = fg.select_all().as_of(c1).read()
         assert df.set_index("store_id").loc[1, "sales"] == 10.0
 
+    def test_query_online_read_executes_against_online_store(self, fs):
+        """feature_exploration.ipynb cell 12: query.show(n, online=True)
+        reads the online store. Divergence setup: offline-only commits
+        land before online is enabled, so online holds a strict subset."""
+        fg = make_fg(fs)  # offline-only commit (stores 1-4)
+        fg.online_enabled = True
+        fg._save_meta()
+        fg.insert(pd.DataFrame({"store_id": [5], "sales": [50.0], "region": ["s"]}))
+
+        offline = fg.select(["store_id", "sales"]).filter(fg["sales"] > 15).read()
+        online = fg.select(["store_id", "sales"]).filter(fg["sales"] > 15).read(online=True)
+        assert sorted(offline["store_id"]) == [2, 3, 4, 5]
+        assert sorted(online["store_id"]) == [5]  # offline-only rows absent
+        assert list(online.columns) == ["store_id", "sales"]
+        assert len(fg.select_all().show(3, online=True)) == 1
+
+    def test_query_online_join_and_as_of_guard(self, fs):
+        fg1 = make_fg(fs, online=True)
+        fg2 = fs.create_feature_group("stores2", version=1, primary_key=["store_id"],
+                                      online_enabled=True)
+        fg2.save(pd.DataFrame({"store_id": [1, 2], "size": [5, 6]}))
+        q = fg1.select(["store_id", "sales"]).join(fg2.select(["size"]))
+        df = q.read(online=True)
+        assert sorted(df["store_id"]) == [1, 2]
+        with pytest.raises(ValueError, match="as_of"):
+            fg1.select_all().as_of("2020-01-01 00:00:00").read(online=True)
+
+    def test_query_dataframe_type(self, fs):
+        fg = make_fg(fs)
+        as_np = fg.select(["store_id", "sales"]).read(dataframe_type="numpy")
+        assert isinstance(as_np, np.ndarray) and as_np.shape == (4, 2)
+        as_py = fg.select(["store_id"]).read(dataframe_type="python")
+        assert isinstance(as_py, list) and as_py[0] == {"store_id": 1}
+        with pytest.raises(ValueError, match="dataframe_type"):
+            fg.select_all().read(dataframe_type="spark")
+
     def test_query_serialization_roundtrip(self, fs):
         fg = make_fg(fs)
         q = fg.select(["store_id", "sales"]).filter(fg["sales"] > 15)
