@@ -48,22 +48,31 @@ def attention_reference(
     v: jax.Array,
     causal: bool = False,
     sm_scale: float | None = None,
+    q_offset: int | None = None,
 ) -> jax.Array:
-    """Pure-XLA attention: numeric ground truth + fallback path."""
+    """Pure-XLA attention: numeric ground truth + fallback path.
+
+    ``q_offset`` places query row i at absolute position ``i + q_offset``
+    in the key sequence; the causal default aligns the queries with the
+    *last* ``seq_q`` keys (the chunked-prefill convention: the q chunk
+    extends an existing KV prefix).
+    """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if q_offset is None:
+        q_offset = k.shape[2] - q.shape[2] if causal else 0
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * sm_scale
     if causal:
-        q_pos = jnp.arange(q.shape[2])[:, None]
+        q_pos = jnp.arange(q.shape[2])[:, None] + q_offset
         k_pos = jnp.arange(k.shape[2])[None, :]
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
-def _causal_mask(s, qi, kj, block_q, block_k):
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+def _causal_mask(s, qi, kj, block_q, block_k, q_offset):
+    q_pos = qi * block_q + q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
     return jnp.where(q_pos >= k_pos, s, NEG_INF)
 
@@ -75,7 +84,7 @@ def _causal_mask(s, qi, kj, block_q, block_k):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, sm_scale, causal, block_q, block_k,
+    *, sm_scale, causal, block_q, block_k, q_offset,
 ):
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -86,8 +95,8 @@ def _fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Causal: skip K blocks entirely above the diagonal.
-    run = True if not causal else kj * block_k < (qi + 1) * block_q
+    # Causal: skip K blocks entirely above the (offset) diagonal.
+    run = True if not causal else kj * block_k < (qi + 1) * block_q + q_offset
 
     @pl.when(run)
     def _step():
@@ -98,7 +107,7 @@ def _fwd_kernel(
         )
         s = s * sm_scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, q_offset)
         m = m_scr[:, :1]  # (bq, 1), broadcast across lanes
         l = l_scr[:, :1]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -134,7 +143,7 @@ def _fwd_kernel(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, sm_scale, causal, block_q, block_k,
+    *, sm_scale, causal, block_q, block_k, q_offset,
 ):
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -143,7 +152,7 @@ def _bwd_dq_kernel(
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    run = True if not causal else kj * block_k < (qi + 1) * block_q
+    run = True if not causal else kj * block_k < (qi + 1) * block_q + q_offset
 
     @pl.when(run)
     def _step():
@@ -158,7 +167,7 @@ def _bwd_dq_kernel(
         )
         s = s * sm_scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, q_offset)
         lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
         p = jnp.where(lse == NEG_INF, 0.0, jnp.exp(s - lse_safe))
         dp = jax.lax.dot_general(
@@ -176,7 +185,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k,
+    dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k, q_offset,
 ):
     kj, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
@@ -186,7 +195,7 @@ def _bwd_dkv_kernel(
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    run = True if not causal else kj * block_k < (qi + 1) * block_q
+    run = True if not causal else kj * block_k < (qi + 1) * block_q + q_offset
 
     @pl.when(run)
     def _step():
@@ -201,7 +210,7 @@ def _bwd_dkv_kernel(
         )
         s = s * sm_scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k)
+            s = _causal_mask(s, qi, kj, block_q, block_k, q_offset)
         lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
         p = jnp.where(lse == NEG_INF, 0.0, jnp.exp(s - lse_safe))
         dv_scr[...] += jax.lax.dot_general(
@@ -231,12 +240,13 @@ def _flat(x):
     return x.reshape(b * h, s, d)
 
 
-def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, q_offset, interpret):
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     grid = (bh, seq_q // block_q, seq_k // block_k)
     kernel = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, q_offset=q_offset,
     )
     return pl.pallas_call(
         kernel,
@@ -264,20 +274,24 @@ def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     )(q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    o, _ = _fwd_call(_flat(q), _flat(k), _flat(v), causal, sm_scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset, interpret):
+    o, _ = _fwd_call(
+        _flat(q), _flat(k), _flat(v), causal, sm_scale, block_q, block_k,
+        q_offset, interpret,
+    )
     return o.reshape(q.shape)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_offset, interpret):
     o, lse = _fwd_call(
-        _flat(q), _flat(k), _flat(v), causal, sm_scale, block_q, block_k, interpret
+        _flat(q), _flat(k), _flat(v), causal, sm_scale, block_q, block_k,
+        q_offset, interpret,
     )
     return o.reshape(q.shape), (q, k, v, o.reshape(q.shape), lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, interpret, res, g):
     q, k, v, o, lse = res
     shape = q.shape
     qf, kf, vf, of, gf = _flat(q), _flat(k), _flat(v), _flat(o), _flat(g)
@@ -286,7 +300,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     delta = jnp.sum(of.astype(jnp.float32) * gf.astype(jnp.float32), axis=-1)[:, None, :]
 
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k
+        _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, q_offset=q_offset,
     )
     dq = pl.pallas_call(
         dq_kernel,
@@ -307,7 +322,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, g):
     )(qf, kf, vf, gf, lse, delta)
 
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k
+        _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
+        block_k=block_k, q_offset=q_offset,
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -350,6 +366,15 @@ def _fit_block(seq: int, preferred: int) -> int | None:
     return None
 
 
+# Below this key length the whole score matrix fits comfortably in VMEM
+# and XLA's fused attention beats the Pallas kernel's scratch bookkeeping
+# (measured on v5e, causal bf16 b4/h8/d128: flash 0.84-0.98x at
+# seq<=1024, 1.16x at 1536, 1.28-3.8x beyond — BENCHMARKS.md
+# "attention routing" table). Routed by measurement, not hope; pass
+# block sizes explicitly to force the kernel below this.
+_XLA_FASTER_BELOW = 1536
+
+
 def flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -359,18 +384,27 @@ def flash_attention(
     sm_scale: float | None = None,
     block_q: int | None = None,
     block_k: int | None = None,
+    q_offset: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Blocked flash attention over ``(batch, heads, seq, head_dim)``.
 
-    Falls back to the XLA reference when sequence lengths don't divide
-    the block sizes. ``interpret=None`` auto-selects the Pallas
-    interpreter off-TPU so tests exercise the same kernel code on the
-    fake CPU mesh (SURVEY.md §4).
+    Cross-length causal calls (chunked prefill: ``seq_q < seq_k``) run
+    in-kernel with the query chunk placed at ``q_offset`` (default: the
+    last ``seq_q`` key positions). Query rows whose positions precede
+    every key (possible only with a negative offset) return zeros —
+    unlike the XLA reference, which NaNs on an all-masked softmax row. Short sequences route to the XLA
+    reference where it measures faster; sequences that don't divide any
+    128-multiple block also fall back. ``interpret=None`` auto-selects
+    the Pallas interpreter off-TPU so tests exercise the same kernel
+    code on the fake CPU mesh (SURVEY.md §4).
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     seq_q, seq_k = q.shape[2], k.shape[2]
+    if q_offset is None:
+        q_offset = seq_k - seq_q if causal else 0
+    forced = block_q is not None or block_k is not None
     # Measured v5e sweet spots per sequence length (BENCHMARKS.md):
     # short sequences want fine tiles, long ones coarse tiles (fewer
     # K/V refetches across q blocks). A preferred size that doesn't
@@ -397,9 +431,11 @@ def flash_attention(
         or not block_k
         or seq_q % block_q
         or seq_k % block_k
-        or (causal and seq_q != seq_k)
+        or (seq_k < _XLA_FASTER_BELOW and not forced)
     ):
-        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+        return attention_reference(
+            q, k, v, causal=causal, sm_scale=sm_scale, q_offset=q_offset
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret)
+    return _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset, interpret)
