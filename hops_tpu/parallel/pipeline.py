@@ -32,6 +32,22 @@ def stack_stage_params(per_stage_params: list[Any]) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
 
 
+def chunk_stage_params(per_layer_params: list[Any], n_stages: int) -> Any:
+    """Split L same-structure layer trees into S stage chunks of K=L/S
+    layers; leaves come out ``(S, K, ...)`` — stage-sharded outside,
+    scanned inside the stage."""
+    n_layers = len(per_layer_params)
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible by {n_stages} stages")
+    k = n_layers // n_stages
+    return stack_stage_params(
+        [
+            jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer_params[s * k : (s + 1) * k])
+            for s in range(n_stages)
+        ]
+    )
+
+
 def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
     stacked_params: Any,
@@ -40,22 +56,44 @@ def pipeline_apply(
     *,
     axis: str = "stage",
     num_microbatches: int | None = None,
+    ingest_fn: Callable[[Any, jax.Array], jax.Array] | None = None,
+    ingest_params: Any = None,
+    emit_fn: Callable[[Any, jax.Array], jax.Array] | None = None,
+    emit_params: Any = None,
 ) -> jax.Array:
-    """Run ``x`` through S pipelined stages; returns the final activations.
+    """Run ``x`` through S pipelined stages; returns the final outputs.
 
     ``stage_fn(params_s, h) -> h`` must preserve ``h``'s shape (a
     residual-block stack). ``stacked_params`` leaves have leading dim S
     and are consumed sharded ``P(axis)``; ``x`` is ``(batch, ...)``,
     replicated over the stage axis, split into ``num_microbatches``
     (default S) equal microbatches.
+
+    Heterogeneous models (embed → blocks → head) hang their non-shape-
+    preserving ends on the ring boundary:
+
+    - ``ingest_fn(ingest_params, micro) -> h`` maps a raw microbatch
+      (any shape/dtype, e.g. int token ids) to the uniform carried
+      activation before stage 0's body;
+    - ``emit_fn(emit_params, outputs) -> y`` maps the collected
+      activations to the final output (e.g. logits) after the loop.
+
+    Both run replicated: ingest is cheap (an embed gather), and emit
+    runs ONCE over the full batch after the loop rather than per tick —
+    so the head matmul costs one replicated pass, not S copies. Their
+    params replicate over ``axis`` (the memory that pp exists to shard —
+    the L-block stack — stays stage-sharded; a vocab-huge embed/head
+    should be Megatron-split on an orthogonal ``model`` axis instead).
     """
     n_stages = mesh.shape[axis]
     m = num_microbatches or n_stages
     batch = x.shape[0]
     if batch % m:
         raise ValueError(f"batch {batch} not divisible by {m} microbatches")
+    ingest = ingest_fn or (lambda _, v: v)
+    has_params = (ingest_params is not None, emit_params is not None)
 
-    def local_fn(params, x):
+    def local_fn(params, ingest_p, emit_p, x):
         # params leaves arrive as (1, ...) slices of the stage stack.
         from hops_tpu.parallel.mesh import pvary as _pvary
 
@@ -64,14 +102,15 @@ def pipeline_apply(
         micro = x.reshape(m, batch // m, *x.shape[1:])
         # Carries start as broadcast constants; mark them device-varying
         # on the stage axis so the fori_loop carry types stay stable.
-        buf = _pvary(jnp.zeros_like(micro[0]), (axis,))
-        outputs = _pvary(jnp.zeros_like(micro), (axis,))
+        h0 = ingest(ingest_p, micro[0])
+        buf = _pvary(jnp.zeros_like(h0), (axis,))
+        outputs = _pvary(jnp.zeros((m,) + h0.shape, h0.dtype), (axis,))
 
         def tick(t, carry):
             buf, outputs = carry
             # Stage 0 ingests microbatch t (while t < m); later stages
             # consume what the previous tick's ppermute delivered.
-            feed = micro[jnp.clip(t, 0, m - 1)]
+            feed = ingest(ingest_p, micro[jnp.clip(t, 0, m - 1)])
             h_in = jnp.where(s == 0, feed, buf)
             h_out = stage_fn(params, h_in)
             # The last stage emits microbatch t-(S-1) once the pipe fills.
@@ -91,11 +130,85 @@ def pipeline_apply(
         outputs = jax.lax.psum(
             jnp.where(s == n_stages - 1, outputs, jnp.zeros_like(outputs)), axis
         )
-        return outputs.reshape(batch, *x.shape[1:])
+        outputs = outputs.reshape(batch, *h0.shape[1:])
+        return emit_fn(emit_p, outputs) if emit_fn else outputs
 
     return shard_map(
         local_fn,
         mesh=mesh,
-        in_specs=(P(axis), P()),
+        in_specs=(
+            P(axis),
+            P() if has_params[0] else None,
+            P() if has_params[1] else None,
+            P(),
+        ),
         out_specs=P(),
-    )(stacked_params, x)
+    )(stacked_params, ingest_params, emit_params, x)
+
+
+def pipelined_lm_apply(
+    model: Any,
+    params: Any,
+    tokens: jax.Array,
+    mesh: Mesh,
+    *,
+    axis: str = "stage",
+    num_microbatches: int | None = None,
+) -> jax.Array:
+    """Run a ``TransformerLM`` forward through the GPipe ring.
+
+    Heterogeneous stage signatures via the ring-boundary hooks: embed is
+    the ingest transform, final-norm + unembed the emit transform, and
+    the L blocks split into S stage chunks of K=L/S layers (leaves
+    ``(S, K, ...)`` — stage-sharded outside, ``lax.scan`` inside).
+    Logits match ``model.apply`` exactly (tests/test_pipeline.py).
+    """
+    from hops_tpu.models.transformer import Block, RMSNorm
+    from flax import linen as nn
+
+    n_stages = mesh.shape[axis]
+    if model.moe_every:
+        raise NotImplementedError("pipelined MoE blocks not supported yet")
+    block = Block(
+        model.num_heads,
+        dtype=model.dtype,
+        attention_impl=model.attention_impl,
+        mesh=None,  # sp inside pp stages would need a second mesh axis
+        dropout_rate=0.0,
+    )
+    embed = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
+    norm = RMSNorm(dtype=model.dtype)
+    unembed = nn.Dense(model.vocab_size, dtype=model.dtype, use_bias=False)
+
+    stacked = chunk_stage_params(
+        [params[f"block_{i}"] for i in range(model.num_layers)], n_stages
+    )
+
+    def stage_fn(stage_params, h):
+        def body(h, layer_params):
+            return block.apply({"params": layer_params}, h), None
+
+        h, _ = jax.lax.scan(body, h, stage_params)
+        return h
+
+    def ingest_fn(p, micro_tokens):
+        return embed.apply({"params": p}, micro_tokens)
+
+    def emit_fn(p, h):
+        logits = unembed.apply(
+            {"params": p["unembed"]}, norm.apply({"params": p["final_norm"]}, h)
+        )
+        return logits.astype(jnp.float32)
+
+    return pipeline_apply(
+        stage_fn,
+        stacked,
+        tokens,
+        mesh,
+        axis=axis,
+        num_microbatches=num_microbatches,
+        ingest_fn=ingest_fn,
+        ingest_params=params["embed"],
+        emit_fn=emit_fn,
+        emit_params={"final_norm": params["final_norm"], "unembed": params["unembed"]},
+    )
