@@ -10,7 +10,6 @@ dataset; the latest record per run_id wins, so status transitions
 from __future__ import annotations
 
 import json
-import logging
 import time
 from pathlib import Path
 from typing import Any
@@ -38,7 +37,9 @@ def register(record: dict[str, Any]) -> None:
 
         searchindex.index_run(record)
     except Exception as exc:  # pragma: no cover - defensive
-        logging.getLogger(__name__).warning("run search-indexing failed: %s", exc)
+        from hops_tpu.runtime.logging import get_logger
+
+        get_logger(__name__).warning("run search-indexing failed: %s", exc)
 
 
 def list_runs(name: str | None = None) -> list[dict[str, Any]]:

@@ -34,6 +34,22 @@ from hops_tpu.runtime.logging import get_logger
 log = get_logger(__name__)
 
 _procs: dict[str, subprocess.Popen] = {}
+
+# Execution bootstrap: runs the app file as __main__ with its argv, but
+# first re-applies JAX_PLATFORMS if a sitecustomize pre-imported jax
+# (which snapshots the env var before the job's intent can take effect).
+# Without this, a cpu-destined job still initializes the accelerator
+# backend — and hangs outright if the accelerator is unreachable. The
+# platform-forcing trick matches tests/conftest.py and launch.py.
+_BOOTSTRAP = """\
+import os, sys, runpy
+_p = os.environ.get("JAX_PLATFORMS")
+if _p and "jax" in sys.modules:
+    sys.modules["jax"].config.update("jax_platforms", _p)
+sys.argv = sys.argv[1:]
+sys.path.insert(0, os.path.dirname(os.path.abspath(sys.argv[0])))
+runpy.run_path(sys.argv[0], run_name="__main__")
+"""
 _procs_lock = threading.Lock()
 
 
@@ -168,7 +184,7 @@ def start_job(name: str, args: list[str] | None = None) -> Execution:
     logfile = open(ex.log_path, "w")
     try:
         proc = subprocess.Popen(
-            [sys.executable, job.config.app_file, *ex.args],
+            [sys.executable, "-c", _BOOTSTRAP, job.config.app_file, *ex.args],
             stdout=logfile,
             stderr=subprocess.STDOUT,
             env=env,

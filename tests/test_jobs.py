@@ -28,6 +28,23 @@ def test_create_start_and_finish(tmp_path):
     assert "hello ['a', 'b']" in done.stdout()
 
 
+def test_job_sibling_import_and_main_semantics(tmp_path):
+    """The bootstrap must preserve `python app.py` semantics: the app
+    dir on sys.path (sibling imports) and __name__ == "__main__"."""
+    (tmp_path / "sibling.py").write_text("VALUE = 41\n")
+    app = _write_app(
+        tmp_path,
+        "import sibling\n"
+        "if __name__ == '__main__':\n"
+        "    print('got', sibling.VALUE + 1)\n",
+    )
+    jobs.create_job("sib", api.JobConfig(app_file=app))
+    ex = jobs.start_job("sib")
+    done = jobs.wait_for_completion("sib", ex.execution_id, timeout_s=30)
+    assert done.state == "FINISHED", done.stdout()
+    assert "got 42" in done.stdout()
+
+
 def test_failing_job_marked_failed(tmp_path):
     app = _write_app(tmp_path, "raise SystemExit(3)")
     jobs.create_job("boom", api.JobConfig(app_file=app))
