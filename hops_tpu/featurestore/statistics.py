@@ -49,6 +49,17 @@ def compute_statistics(df: pd.DataFrame, cfg: StatisticsConfig) -> dict:
         if c not in df.columns:
             continue
         s = df[c]
+        # Tensor columns (petastorm-style object cells) are unhashable;
+        # describe their presence only. Sniff the first non-null cell —
+        # row 0 may be missing.
+        probe = s.dropna()
+        if s.dtype == object and len(probe) and isinstance(probe.iloc[0], np.ndarray):
+            out["features"][c] = {
+                "count": int(s.count()),
+                "num_missing": int(s.isna().sum()),
+                "tensor_shape": list(np.asarray(probe.iloc[0]).shape),
+            }
+            continue
         entry: dict = {
             "count": int(s.count()),
             "num_missing": int(s.isna().sum()),

@@ -28,12 +28,13 @@ if TYPE_CHECKING:
     from hops_tpu.featurestore.connection import FeatureStore
 
 _KIND = "trainingdatasets"
-_FORMATS = ("parquet", "csv", "tfrecord", "recordio")
-# Parquet-based formats the reference materialized through Spark
-# libraries (petastorm/PetastormHelloWorld.ipynb, delta/DeltaOnHops.ipynb,
-# SURVEY.md §2.6 "Formats on disk") store as parquet here; time travel
-# (the Delta/Hudi capability) lives on feature groups' commit log.
-_FORMAT_ALIASES = {"petastorm": "parquet", "delta": "parquet", "hudi": "parquet"}
+# - petastorm: schema'd columnar with tensor columns + row-group reader
+#   (featurestore/columnar.py; reference PetastormHelloWorld.ipynb:21-44)
+# - delta: transactional commit-log materialization with append/overwrite
+#   history and as_of reads (reference delta/DeltaOnHops.ipynb), reusing
+#   the feature-group commit-log machinery (featurestore/storage.py)
+_FORMATS = ("parquet", "csv", "tfrecord", "recordio", "petastorm", "delta")
+_FORMAT_ALIASES = {"hudi": "delta"}
 
 
 class TrainingDataset:
@@ -149,6 +150,16 @@ class TrainingDataset:
 
     def insert(self, data: Query | pd.DataFrame, overwrite: bool = True,
                write_options: dict | None = None) -> "TrainingDataset":
+        """Re-materialize. For ``delta`` format, ``overwrite=False``
+        appends a commit to each split's log instead (DeltaOnHops.ipynb
+        append-mode write); ``overwrite=True`` starts a new table version
+        that as_of reads can still see past."""
+        if self.data_format == "delta" and not overwrite:
+            df = data.read() if isinstance(data, Query) else data.copy()
+            df.columns = [str(c).lower() for c in df.columns]
+            for split_name, frame in self._split(df).items():
+                storage.write_commit(self._split_dir(split_name), frame, operation="insert")
+            return self
         return self.save(data, write_options)
 
     def _split(self, df: pd.DataFrame) -> dict[str, pd.DataFrame]:
@@ -173,6 +184,11 @@ class TrainingDataset:
 
     def _write_split(self, split: str, df: pd.DataFrame) -> None:
         d = self._split_dir(split)
+        if self.data_format == "delta":
+            # A save is a truncating commit: history before it survives
+            # for as_of reads, current reads start from it.
+            storage.write_commit(d, df, operation="insert", extra={"truncate": True})
+            return
         # coalesce=True -> single output file (training-data-coalesced.ipynb:61);
         # otherwise shard for parallel reads.
         n_parts = 1 if (self.coalesce or len(df) < 10_000) else 8
@@ -188,13 +204,26 @@ class TrainingDataset:
                 _write_tfrecord(part, f"{stem}.tfrecord")
             elif self.data_format == "recordio":
                 _write_recordio(part, f"{stem}.rio")
+            elif self.data_format == "petastorm":
+                from hops_tpu.featurestore import columnar
+
+                columnar.write_dataset(d, part, part=i)
 
     # -- read path ------------------------------------------------------------
 
     def read(self, split: str | None = None, read_options: dict | None = None) -> pd.DataFrame:
+        """``read_options`` (per format): ``{"as_of": ts}`` time-travels a
+        delta TD; ``{"columns": [...]}`` column-projects a petastorm TD."""
+        opts = read_options or {}
         d = self.dir / (split or ("data" if not self.splits else next(iter(self.splits))))
         if not d.exists():
             raise KeyError(f"split {split!r} of {self.name}_{self.version} not materialized")
+        if self.data_format == "delta":
+            return _read_delta(d, as_of=opts.get("as_of"))
+        if self.data_format == "petastorm":
+            from hops_tpu.featurestore import columnar
+
+            return columnar.read_dataset(d, columns=opts.get("columns"))
         frames = []
         for p in sorted(d.iterdir()):
             if p.suffix == ".parquet":
@@ -206,6 +235,27 @@ class TrainingDataset:
             elif p.suffix == ".rio":
                 frames.append(_read_recordio(p))
         return pd.concat(frames, ignore_index=True) if frames else pd.DataFrame()
+
+    def commit_details(self, split: str | None = None) -> dict[int, dict]:
+        """Delta-format history: commit id -> metadata, oldest first
+        (reference: Delta table history, DeltaOnHops.ipynb)."""
+        if self.data_format != "delta":
+            raise ValueError(f"commit_details requires delta format, not {self.data_format}")
+        d = self.dir / (split or ("data" if not self.splits else next(iter(self.splits))))
+        return {c: storage.read_commit_meta(d, c) for c in storage.commit_ids(d)}
+
+    def row_group_reader(self, split: str | None = None,
+                         columns: list[str] | None = None,
+                         shuffle: bool = True, seed: int = 0):
+        """Petastorm-format streaming reader: decoded numpy batches one
+        parquet row group at a time, shuffled at row-group granularity
+        (the ``make_reader`` role, PetastormHelloWorld.ipynb)."""
+        if self.data_format != "petastorm":
+            raise ValueError(f"row_group_reader requires petastorm format, not {self.data_format}")
+        from hops_tpu.featurestore import columnar
+
+        d = self.dir / (split or ("data" if not self.splits else next(iter(self.splits))))
+        return columnar.RowGroupReader(d, columns=columns, shuffle=shuffle, seed=seed)
 
     def show(self, n: int = 5, split: str | None = None) -> pd.DataFrame:
         return self.read(split=split).head(n)
@@ -296,6 +346,19 @@ class TrainingDataset:
 
 
 # -- format codecs ------------------------------------------------------------
+
+
+def _read_delta(d, as_of=None) -> pd.DataFrame:
+    """Replay a delta TD split: commits from the last truncating commit
+    at-or-before ``as_of`` (truncate = a fresh save over the table).
+    The replay itself is storage.read_as_of — one commit-log codec."""
+    ts = storage.resolve_timestamp(as_of)
+    ids = [c for c in storage.commit_ids(d) if ts is None or c <= ts]
+    truncates = [c for c in ids if storage.read_commit_meta(d, c).get("truncate")]
+    exclude_until = truncates[-1] - 1 if truncates else None
+    if not ids:
+        return pd.DataFrame()
+    return storage.read_as_of(d, primary_key=[], as_of=ts, exclude_until=exclude_until)
 
 
 def _write_tfrecord(df: pd.DataFrame, path: str) -> None:

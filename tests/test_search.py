@@ -198,6 +198,67 @@ class TestDrivers:
         )
         assert summary["num_trials"] == 13  # 9 + 3 + 1 promotions
 
+    def test_subslice_trials_train_on_disjoint_device_groups(self):
+        """SURVEY.md §7 hard part #2: concurrent trials lease disjoint
+        sub-slices (here 4 chips each of the fake 8-chip mesh) and
+        actually place their pjit'd work on their own group only."""
+        import threading
+
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from hops_tpu.parallel import mesh as mesh_lib
+
+        barrier = threading.Barrier(2, timeout=30)
+        placements: dict[str, tuple] = {}
+
+        def train_fn(x):
+            mesh = mesh_lib.make_mesh({"data": -1})  # the trial's group
+            barrier.wait()  # prove two trials really run concurrently
+            arr = jax.device_put(
+                jnp.arange(8.0), NamedSharding(mesh, P("data"))
+            )
+            out = jax.jit(lambda a: a * 2)(arr)
+            devs = tuple(sorted(d.id for d in out.sharding.device_set))
+            placements[f"x={x}"] = devs
+            return {"metric": float(out.sum())}
+
+        _, summary = grid_search(
+            train_fn,
+            {"x": [0, 1, 2, 3]},
+            optimization_key="metric",
+            devices_per_trial=4,
+        )
+        assert summary["num_trials"] == 4
+        groups = set(placements.values())
+        assert len(placements) == 4 and len(groups) == 2
+        g1, g2 = groups
+        assert len(g1) == 4 and len(g2) == 4 and not set(g1) & set(g2)
+
+    def test_devices_per_trial_validation(self):
+        import jax
+
+        with pytest.raises(ValueError, match="devices_per_trial"):
+            grid_search(
+                lambda x: {"m": x},
+                {"x": [1]},
+                devices_per_trial=len(jax.devices()) + 1,
+            )
+
+    def test_device_scope_defaults_mesh_construction(self):
+        import jax
+
+        from hops_tpu.parallel import mesh as mesh_lib
+
+        group = jax.devices()[2:4]
+        with mesh_lib.device_scope(group):
+            m = mesh_lib.make_mesh()
+            assert [d.id for d in m.devices.flat] == [d.id for d in group]
+            assert mesh_lib.local_mesh().devices.size == 2
+        assert mesh_lib.scoped_devices() is None
+        assert mesh_lib.make_mesh().devices.size == len(jax.devices())
+
     def test_failing_trial_does_not_kill_search(self):
         def train_fn(a):
             if a == 2:
