@@ -39,22 +39,33 @@ def _sync(tree) -> float:
 
 
 def run_bench(
-    per_chip_batch: int = 128,  # measured sweet spot on v5e (64→1898, 128→2053, 256→1982 samples/s/chip)
+    per_chip_batch: int = 128,  # measured sweet spot on v5e (96/192/256 all slower, BENCHMARKS.md)
     image_size: int = 224,
-    steps: int = 30,
-    warmup: int = 5,
+    steps: int = 32,
+    warmup: int = 16,
     smoke: bool = False,
+    scan_chunk: int = 16,
 ) -> dict:
+    """Time the ResNet-50 train step with a device-side training loop.
+
+    ``lax.scan`` runs ``scan_chunk`` optimizer steps per dispatch — the
+    idiomatic TPU training loop (host only dispatches and reads
+    metrics). This matters doubly here: the axon relay adds ~6 ms of
+    host→device overhead per dispatch (measured, BENCHMARKS.md
+    roofline section), which a per-step Python loop pays 16× more often.
+    Pass ``scan_chunk=1`` for the per-dispatch variant.
+    """
     from hops_tpu.models import common
     from hops_tpu.models.resnet import ResNet18ish, ResNet50
     from hops_tpu.parallel.strategy import Strategy
 
     if smoke:
         model = ResNet18ish(dtype=jnp.float32)
-        per_chip_batch, image_size, steps, warmup = 8, 32, 4, 1
+        per_chip_batch, image_size, steps, warmup, scan_chunk = 8, 32, 4, 2, 2
     else:
         model = ResNet50(num_classes=1000)
 
+    scan_chunk = min(scan_chunk, steps)  # --steps 8 means 8 steps, not 16
     strategy = Strategy()  # data-parallel over all visible chips
     n_chips = strategy.num_replicas_in_sync
     global_batch = per_chip_batch * n_chips
@@ -64,7 +75,17 @@ def run_bench(
             model, jax.random.PRNGKey(0), (per_chip_batch, image_size, image_size, 3)
         )
     )
-    step_fn = strategy.step(common.make_bn_train_step())
+    train_step = common.make_bn_train_step()
+
+    def multi_step(state, batch):
+        def body(st, _):
+            st, metrics = train_step(st, batch)
+            return st, metrics["loss"]
+
+        state, losses = jax.lax.scan(body, state, None, length=scan_chunk)
+        return state, losses[-1]
+
+    step_fn = strategy.step(multi_step)
 
     rs = np.random.RandomState(0)
     batch = strategy.distribute_batch(
@@ -74,21 +95,23 @@ def run_bench(
         }
     )
 
-    for _ in range(warmup):
-        state, metrics = step_fn(state, batch)
-    _sync(metrics)
+    for _ in range(max(1, warmup // scan_chunk)):
+        state, loss = step_fn(state, batch)
+    _sync(loss)
 
+    n_dispatch = max(1, steps // scan_chunk)  # whole dispatches only, never overshoot
     t0 = time.perf_counter()
-    for _ in range(steps):
-        state, metrics = step_fn(state, batch)
-    _sync(metrics)
+    for _ in range(n_dispatch):
+        state, loss = step_fn(state, batch)
+    _sync(loss)
     elapsed = time.perf_counter() - t0
 
-    samples_per_sec = global_batch * steps / elapsed
+    total_steps = n_dispatch * scan_chunk
+    samples_per_sec = global_batch * total_steps / elapsed
     return {
         "samples_per_sec": samples_per_sec,
         "samples_per_sec_per_chip": samples_per_sec / n_chips,
-        "step_time_ms": elapsed / steps * 1e3,
+        "step_time_ms": elapsed / total_steps * 1e3,
         "n_chips": n_chips,
         "global_batch": global_batch,
         "platform": jax.devices()[0].platform,
@@ -99,10 +122,18 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
     parser.add_argument("--batch", type=int, default=128, help="per-chip batch size")
-    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--steps", type=int, default=32)
+    parser.add_argument(
+        "--scan-chunk", type=int, default=16, help="train steps per dispatch (1 = python loop)"
+    )
     args = parser.parse_args()
 
-    result = run_bench(per_chip_batch=args.batch, steps=args.steps, smoke=args.smoke)
+    result = run_bench(
+        per_chip_batch=args.batch,
+        steps=args.steps,
+        smoke=args.smoke,
+        scan_chunk=args.scan_chunk,
+    )
     value = result["samples_per_sec_per_chip"]
 
     # Baselines are recorded per platform: the first real run on a

@@ -126,3 +126,155 @@ def deterministic_mode(seed: int = 0) -> Iterator[jax.Array]:
         yield jax.random.PRNGKey(seed)
     finally:
         jax.config.update("jax_default_prng_impl", prev)
+
+
+# -- roofline analysis over profiler traces ----------------------------------
+
+#: Peak specs per TPU generation for roofline bounds (bf16 matmul
+#: TFLOP/s, HBM GB/s). v5e figures are the published 197/819; other
+#: rows are fallbacks so the report still renders off-TPU.
+_PEAKS = {
+    "v5 lite": (197e12, 819e9),
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "cpu": (1e12, 100e9),
+}
+
+
+def _find_trace_file(trace_dir: str) -> str:
+    import glob
+
+    files = sorted(glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True))
+    if not files:
+        raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir}")
+    return files[-1]
+
+
+def roofline_report(
+    trace_dir: str,
+    peak_flops: float | None = None,
+    peak_bw: float | None = None,
+    steps: int = 1,
+) -> dict:
+    """Aggregate a :func:`trace` capture into a per-HLO-category roofline.
+
+    Reads the Chrome-trace export ``jax.profiler`` writes, sums device
+    op time / model FLOPs / bytes accessed by ``hlo_category``, and for
+    each category reports achieved FLOP/s and bytes/s against the
+    chip's compute and HBM roofs — the analysis the reference's
+    TensorBoard profiler window left to the reader (SURVEY.md §5).
+
+    Returns ``{"total_ms", "device": str, "categories": [{name, ms,
+    tflops_per_s, gb_per_s, gb, bound, roofline_ms}, ...]}`` where
+    ``bound`` is which roof the category sits under and ``roofline_ms``
+    is the best-case time at 100% of that roof.
+    """
+    import collections
+    import gzip
+    import json
+    import re
+
+    with gzip.open(_find_trace_file(trace_dir)) as f:
+        events = json.load(f)["traceEvents"]
+    pid_names = {
+        e["pid"]: e["args"].get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    # One device pid only: in SPMD every chip runs the same program, so
+    # a single chip IS the per-chip roofline; summing all pids would
+    # multiply time and bytes by the chip count.
+    device_pids = sorted(p for p, n in pid_names.items() if "TPU" in n or "GPU" in n)[:1]
+    device_pids = set(device_pids)
+    device_name = next((pid_names[p] for p in device_pids), "")
+
+    if peak_flops is None or peak_bw is None:
+        # The chrome trace doesn't record the device *kind*, only
+        # "/device:TPU:0" — so peaks come from the local backend. When
+        # analyzing a trace on a different machine (or an unknown chip),
+        # pass peak_flops/peak_bw explicitly.
+        kind = jax.devices()[0].device_kind.lower()
+        match = next((v for k, v in _PEAKS.items() if k in kind), None)
+        if match is None:
+            log.warning(
+                "roofline_report: unknown device kind %r — using conservative "
+                "cpu peaks; pass peak_flops/peak_bw for a meaningful roofline",
+                kind,
+            )
+            match = _PEAKS["cpu"]
+        peak_flops, peak_bw = peak_flops or match[0], peak_bw or match[1]
+
+    # one entry per op name (summed over repeated steps), then by category
+    per_op: dict[str, list] = {}
+    for e in events:
+        args = e.get("args") or {}
+        if e.get("ph") != "X" or e["pid"] not in device_pids or "device_duration_ps" not in args:
+            continue
+        # skip program envelopes (jit_fn(...), bare step numbers) and the
+        # *-start halves of async pairs (bytes live on the -done event)
+        if re.match(r"^(jit_|\d+$)", e["name"]) or e["name"].split(".")[0].endswith("-start"):
+            continue
+        row = per_op.setdefault(
+            e["name"], [args.get("hlo_category", e["name"]), 0.0, 0.0, 0.0]
+        )
+        row[1] += int(args["device_duration_ps"]) / 1e12
+        row[2] += float(args.get("model_flops", 0) or 0)
+        row[3] += float(args.get("raw_bytes_accessed", 0) or 0)
+
+    by_cat = collections.defaultdict(lambda: [0.0, 0.0, 0.0])
+    for cat, dur, fl, by in per_op.values():
+        agg = by_cat[cat]
+        agg[0] += dur
+        agg[1] += fl
+        agg[2] += by
+
+    categories = []
+    for cat, (dur, fl, by) in sorted(by_cat.items(), key=lambda kv: -kv[1][0]):
+        if dur <= 0:
+            continue
+        flop_bound, byte_bound = fl / peak_flops, by / peak_bw
+        categories.append(
+            {
+                "name": cat,
+                "ms": dur * 1e3,
+                "tflops_per_s": fl / dur / 1e12,
+                "gb_per_s": by / dur / 1e9,
+                "gb": by / 1e9,
+                "bound": "compute" if flop_bound >= byte_bound else "memory",
+                "roofline_ms": max(flop_bound, byte_bound) * 1e3,
+            }
+        )
+    for c in categories:
+        for k in ("ms", "gb", "roofline_ms"):
+            c[k] /= steps
+    total = sum(c["ms"] for c in categories)
+    ideal = sum(c["roofline_ms"] for c in categories)
+    return {
+        "steps": steps,
+        "total_ms": total,
+        "roofline_ms": ideal,
+        "roofline_fraction": ideal / total if total else 0.0,
+        "device": device_name,
+        "peak_tflops": peak_flops / 1e12,
+        "peak_gbps": peak_bw / 1e9,
+        "categories": categories,
+    }
+
+
+def print_roofline(report: dict) -> None:
+    """Render :func:`roofline_report` as the table BENCHMARKS.md carries."""
+    print(
+        f"device {report['device']}  roofs: {report['peak_tflops']:.0f} TFLOP/s, "
+        f"{report['peak_gbps']:.0f} GB/s"
+    )
+    print(f"{'category':26s}{'ms':>9s}{'TFLOP/s':>9s}{'GB/s':>7s}{'GB':>7s}  bound  best-case ms")
+    for c in report["categories"]:
+        print(
+            f"{c['name']:26s}{c['ms']:9.2f}{c['tflops_per_s']:9.1f}{c['gb_per_s']:7.0f}"
+            f"{c['gb']:7.2f}  {c['bound']:6s}{c['roofline_ms']:10.2f}"
+        )
+    print(
+        f"total {report['total_ms']:.1f} ms vs roofline best-case {report['roofline_ms']:.1f} ms "
+        f"-> running at {report['roofline_fraction'] * 100:.0f}% of the roofline bound"
+    )

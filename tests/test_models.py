@@ -102,3 +102,34 @@ class TestWideDeep:
         logits = model.apply({"params": params}, batch)
         acc = float((jnp.argmax(logits, -1) == batch["label"]).mean())
         assert acc > 0.85
+
+
+class TestResNetTPUForm:
+    """The HBM-roofline optimizations (BENCHMARKS.md) must not change math."""
+
+    def test_s2d_stem_matches_dense_stem(self):
+        # Same parameter tree (canonical 7x7 kernel) drives both paths;
+        # the space-to-depth rewrite is an algebraic identity.
+        dense = ResNet50(num_classes=10, dtype=jnp.float32, norm_dtype=jnp.float32,
+                         s2d_stem=False)
+        s2d = ResNet50(num_classes=10, dtype=jnp.float32, norm_dtype=jnp.float32,
+                       s2d_stem=True)
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 64, 64, 3))
+        variables = dense.init(jax.random.PRNGKey(1), x, train=False)
+        y_dense = dense.apply(variables, x, train=False)
+        y_s2d = s2d.apply(variables, x, train=False)
+        np.testing.assert_allclose(np.asarray(y_dense), np.asarray(y_s2d), atol=1e-4)
+
+    def test_s2d_stem_falls_back_on_odd_sizes(self):
+        model = ResNet50(num_classes=10, dtype=jnp.float32, s2d_stem=True)
+        x = jnp.zeros((1, 65, 65, 3))
+        variables = model.init(jax.random.PRNGKey(0), x, train=False)
+        assert model.apply(variables, x, train=False).shape == (1, 10)
+
+    def test_bf16_norm_keeps_f32_stats_and_params(self):
+        model = ResNet50(num_classes=10)  # norm_dtype defaults to bf16
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)), train=False)
+        for leaf in jax.tree.leaves(variables["params"]):
+            assert leaf.dtype == jnp.float32
+        for leaf in jax.tree.leaves(variables["batch_stats"]):
+            assert leaf.dtype == jnp.float32
