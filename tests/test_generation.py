@@ -91,3 +91,27 @@ def test_moe_blocks_inherit_max_decode_len():
     # _decode_attend. Every k/v cache in every (MoE) block must use it.
     key_lens = {leaf.shape[2] for path, leaf in caches if leaf.ndim == 4}
     assert key_lens == {TINY["max_decode_len"]}, key_lens
+
+
+def test_long_prefill_kernel_path_matches_full_forward():
+    """Prefill with s>1 rides the flash kernel (round 3); at a kernel-eligible
+    length it must still reproduce the dense causal forward."""
+    model = TransformerLM(
+        vocab_size=64, d_model=32, num_heads=1, num_layers=1,
+        dtype=jnp.float32, attention_impl="flash", max_decode_len=2048,
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 1536), 0, 64)
+    variables = model.init(jax.random.PRNGKey(0), tokens[:, :8])
+    params = variables["params"]
+    full = model.apply({"params": params}, tokens)
+    prefill, vars_ = model.apply(
+        {"params": params}, tokens, decode=True, mutable=["cache"]
+    )
+    np.testing.assert_allclose(prefill, full, atol=2e-3, rtol=1e-3)
+    # ...and the next single-token step continues coherently from the cache.
+    nxt = jnp.argmax(full[:, -1:], axis=-1)
+    step_logits, _ = model.apply(
+        {"params": params, "cache": vars_["cache"]}, nxt, decode=True, mutable=["cache"]
+    )
+    assert step_logits.shape == (1, 1, 64)
+    assert bool(jnp.all(jnp.isfinite(step_logits)))
