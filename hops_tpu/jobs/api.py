@@ -156,6 +156,32 @@ def delete_job(name: str) -> None:
     fs.rmr(_job_dir(name))
 
 
+def _child_pythonpath(existing: str | None) -> str:
+    """Import path for job children: inherited/job-config ``PYTHONPATH``,
+    then the framework's own location, then the parent's on-disk
+    ``sys.path`` entries.
+
+    A clean checkout is neither pip-installed nor on ``PYTHONPATH``, so
+    without this a child spawned by ``start_job`` cannot
+    ``import hops_tpu`` at all. The reference's client stages its
+    dependencies alongside the job for the same reason
+    (jobs-client/spark/jobs_spark_client.py:49-54).
+    """
+    import hops_tpu
+
+    # Job-configured / inherited PYTHONPATH keeps precedence over
+    # everything — including the parent's framework checkout — so a job
+    # can pin its own staged dependencies (even a staged hops_tpu);
+    # the framework root after that covers the bare-checkout case;
+    # sys.path[0] (the parent script's directory) is excluded so stray
+    # modules next to the launcher don't shadow the child's imports.
+    entries = existing.split(os.pathsep) if existing else []
+    entries.append(str(Path(hops_tpu.__file__).resolve().parent.parent))
+    entries += [p for p in sys.path[1:] if p and Path(p).exists()]
+    deduped = list(dict.fromkeys(entries))
+    return os.pathsep.join(deduped)
+
+
 def start_job(name: str, args: list[str] | None = None) -> Execution:
     """Launch an execution as a supervised subprocess; returns immediately.
 
@@ -180,6 +206,7 @@ def start_job(name: str, args: list[str] | None = None) -> Execution:
     env["HOPS_TPU_WORKSPACE"] = str(fs.workspace_root())
     env["HOPS_TPU_JOB_NAME"] = name
     env["HOPS_TPU_EXECUTION_ID"] = ex.execution_id
+    env["PYTHONPATH"] = _child_pythonpath(env.get("PYTHONPATH"))
 
     logfile = open(ex.log_path, "w")
     try:
