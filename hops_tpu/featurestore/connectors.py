@@ -41,6 +41,10 @@ class StorageConnector:
     type: str = "HOPSFS"
     options: dict = dataclasses.field(default_factory=dict)
 
+    #: True when read(query=...) executes SQL in the external system
+    #: (JDBC); path-based connectors ignore ``query``.
+    executes_sql = False
+
     def read(self, query: str | None = None, data_format: str | None = None,
              path: str | None = None) -> pd.DataFrame:
         raise NotImplementedError
@@ -82,12 +86,53 @@ class S3Connector(StorageConnector):
 
 
 class JDBCConnector(StorageConnector):
+    """JDBC-role connector, functional for embedded sqlite databases.
+
+    The reference ingests from warehouse SQL through JDBC connectors
+    (Redshift_pyspark.ipynb:129,138; snowflake/getting-started.ipynb:
+    115-124 role). Network drivers aren't in this image, but the
+    embedded SQL engine is (sql/gateway.py), so a connection string of
+    ``jdbc:sqlite:<path>``, ``sqlite:<path>`` or a bare file path
+    executes ``read(query)`` directly against that database — the full
+    external-SQL → on-demand FG → training-dataset path runs. Other
+    JDBC URLs still raise honestly.
+    """
+
+    executes_sql = True
+
     def read(self, query=None, data_format=None, path=None) -> pd.DataFrame:
-        raise RuntimeError(
-            f"JDBC connector {self.name!r} requires a database driver not in this image")
+        db_path = self._sqlite_path()
+        if db_path is None:
+            raise RuntimeError(
+                f"JDBC connector {self.name!r}: connection string "
+                f"{self.connection_string()!r} requires a network database "
+                "driver not in this image; embedded sqlite "
+                "(jdbc:sqlite:<path>) is supported")
+        if not Path(db_path).exists():
+            raise FileNotFoundError(
+                f"JDBC connector {self.name!r}: database {db_path} does not exist")
+        sql = query or self.options.get("query")
+        if not sql:
+            raise ValueError(f"JDBC connector {self.name!r}: read() needs a query")
+        import sqlite3
+
+        db = sqlite3.connect(db_path)
+        try:
+            return pd.read_sql_query(sql, db)
+        finally:
+            db.close()
 
     def connection_string(self) -> str:
         return self.options.get("connection_string", "")
+
+    def _sqlite_path(self) -> str | None:
+        cs = self.connection_string()
+        for prefix in ("jdbc:sqlite:", "sqlite:///", "sqlite:"):
+            if cs.startswith(prefix):
+                return cs[len(prefix):]
+        if cs and ":" not in cs.split("/", 1)[0]:
+            return cs  # bare filesystem path
+        return None
 
 
 class SnowflakeConnector(StorageConnector):

@@ -375,6 +375,15 @@ class OnDemandFeatureGroup(FeatureGroup):
 
     def read(self, wallclock_time=None, online=False, dataframe_type="pandas") -> pd.DataFrame:
         if self.query:
+            if getattr(self.storage_connector, "executes_sql", False):
+                # SQL-capable connectors (JDBC over embedded sqlite)
+                # execute the query in the external database itself —
+                # the reference's external-SQL on-demand FG semantics
+                # (ComputeFeatures.scala:179-191, snowflake role).
+                try:
+                    return self.storage_connector.read(query=self.query)
+                except (RuntimeError, NotImplementedError):
+                    pass  # config-only connector: fall back to the gateway
             from hops_tpu.sql import gateway
 
             return gateway.execute(self.query, feature_store=self._fs, connector=self.storage_connector)

@@ -570,3 +570,69 @@ class TestReviewRegressions:
         for split in ("train", "test"):
             for code, y in zip(xs[split][:, 0], ys[split]):
                 assert mapping.setdefault(code, y) == y
+
+
+class TestJDBCIngest:
+    """Warehouse-SQL ingest (round 3): external sqlite -> on-demand FG ->
+    query join -> training dataset (reference: snowflake/getting-started
+    + Redshift_pyspark roles)."""
+
+    def _external_db(self, tmp_path):
+        import sqlite3
+
+        db = tmp_path / "warehouse.db"
+        con = sqlite3.connect(db)
+        con.executescript(
+            """
+            CREATE TABLE orders (store_id INTEGER, amount REAL);
+            INSERT INTO orders VALUES (1, 10.0), (1, 5.0), (2, 7.5), (3, 2.5);
+            """
+        )
+        con.commit()
+        con.close()
+        return db
+
+    def test_jdbc_connector_executes_query(self, fs, workspace, tmp_path):
+        from hops_tpu.featurestore import connectors
+
+        db = self._external_db(tmp_path)
+        c = connectors.create("wh", "JDBC", connection_string=f"jdbc:sqlite:{db}")
+        df = c.read("SELECT store_id, SUM(amount) AS total FROM orders GROUP BY store_id")
+        assert list(df["total"]) == [15.0, 7.5, 2.5]
+        # registry round-trip keeps it functional
+        again = connectors.get("wh", "JDBC")
+        assert len(again.read("SELECT * FROM orders")) == 4
+
+    def test_jdbc_network_urls_still_raise(self, fs, workspace):
+        from hops_tpu.featurestore import connectors
+
+        c = connectors.create(
+            "rs", "REDSHIFT",
+            connection_string="jdbc:redshift://cluster:5439/db")
+        with pytest.raises(RuntimeError, match="driver"):
+            c.read("SELECT 1")
+
+    def test_external_sql_to_on_demand_fg_to_training_dataset(self, fs, workspace, tmp_path):
+        from hops_tpu.featurestore import connectors
+
+        db = self._external_db(tmp_path)
+        wh = connectors.create("wh2", "JDBC", connection_string=f"jdbc:sqlite:{db}")
+
+        # On-demand FG whose query executes IN the external database.
+        odfg = fs.create_on_demand_feature_group(
+            "order_totals", version=1,
+            query="SELECT store_id, SUM(amount) AS total FROM orders GROUP BY store_id",
+            storage_connector=wh)
+        odfg.save()
+        assert list(odfg.read()["total"]) == [15.0, 7.5, 2.5]
+
+        # Join against a materialized FG and land a training dataset.
+        stores = fs.create_feature_group("stores", version=1, primary_key=["store_id"])
+        stores.save(pd.DataFrame({"store_id": [1, 2, 3], "region": ["n", "s", "w"]}))
+        joined = fs.sql(
+            "SELECT s.region, o.total FROM stores s "
+            "JOIN order_totals o ON s.store_id = o.store_id")
+        td = fs.create_training_dataset("wh_td", version=1)
+        td.save(joined)
+        out = td.read()
+        assert set(out.columns) == {"region", "total"} and len(out) == 3
