@@ -204,6 +204,7 @@ def start_job(name: str, args: list[str] | None = None) -> Execution:
     env = dict(os.environ)
     env.update(job.config.env)
     env["HOPS_TPU_WORKSPACE"] = str(fs.workspace_root())
+    env["HOPS_TPU_PROJECT"] = fs.project_name()
     env["HOPS_TPU_JOB_NAME"] = name
     env["HOPS_TPU_EXECUTION_ID"] = ex.execution_id
     env["PYTHONPATH"] = _child_pythonpath(env.get("PYTHONPATH"))

@@ -18,9 +18,14 @@ from __future__ import annotations
 
 import importlib.util
 import json
+import os
 import pickle
+import signal
 import socket
+import subprocess
+import sys
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
@@ -54,7 +59,12 @@ def _load_registry() -> dict[str, dict[str, Any]]:
 
 
 def _save_registry(reg: dict[str, dict[str, Any]]) -> None:
-    _servings_file().write_text(json.dumps(reg, indent=2, default=str))
+    # Atomic replace: standalone starts and supervisors poll this file
+    # from other processes at 10 Hz (same rationale as jobs Execution.save).
+    f = _servings_file()
+    tmp = f.with_suffix(f".tmp{os.getpid()}")
+    tmp.write_text(json.dumps(reg, indent=2, default=str))
+    os.replace(tmp, f)
 
 
 # -- predictors ---------------------------------------------------------------
@@ -249,30 +259,52 @@ def get_status(name: str) -> str:
     if cfg.get("status") == "Running":
         if _port_alive(cfg.get("port")):
             return "Running"
-        # Heal just this record against a FRESH snapshot under the lock —
-        # the port probe above can take 0.5 s, during which another
-        # thread may have updated other servings.
+        if _pid_alive(cfg.get("pid")):
+            # The hosting process is alive but its port didn't answer —
+            # a transient probe failure or a wedged host. Do NOT heal
+            # (that would orphan the process and invite a duplicate from
+            # restore()); report Stopped and leave the record intact so
+            # stop() can still reach the pid.
+            return "Stopped"
+        # Host process is dead: heal against a FRESH snapshot under the
+        # lock — the port probe above can take 0.5 s, during which
+        # another thread may have updated other servings. "Failed"
+        # (reported as Stopped) preserves the owner's running-intent so
+        # restore() still revives it — healing must not erase what it heals.
         with _lock:
             reg = _load_registry()
             if name in reg and reg[name].get("status") == "Running":
-                reg[name]["status"] = "Stopped"
+                reg[name]["status"] = "Failed"
                 reg[name].pop("port", None)
+                reg[name].pop("pid", None)
                 _save_registry(reg)
     return "Stopped"
 
 
-def restore() -> list[str]:
+def restore(standalone: bool = False) -> list[str]:
     """Re-start endpoints recorded Running whose server died with its
     process — the restart-survival story (reference: platform servings
     outlive the notebook that created them, model_repo_and_serving.ipynb
-    cells 15-21). Call after process start; returns restarted names."""
+    cells 15-21). Returns restarted names.
+
+    Deliberate entry points that call this: the supervisor verb
+    ``python -m hops_tpu.modelrepo.serving_host --restore [--watch N]``
+    (resident, revives in-process) and ``standalone=True`` (spawns a
+    detached host per serving)."""
     restarted = []
     for name, cfg in _load_registry().items():
         with _lock:
             hosted = name in _servers
-        if cfg.get("status") == "Running" and not hosted and not _port_alive(cfg.get("port")):
+        # "Failed" = a dead-Running record already healed by get_status;
+        # the owner's intent is still Running.
+        if cfg.get("status") in ("Running", "Failed") and not hosted and not _port_alive(cfg.get("port")):
+            if _pid_alive(cfg.get("pid")):
+                log.warning(
+                    "serving %s: host pid %s alive but port unresponsive — "
+                    "not spawning a duplicate; stop() it first", name, cfg.get("pid"))
+                continue
             try:
-                start(name)
+                start(name, standalone=standalone)
             except Exception as exc:  # one broken artifact must not block the rest
                 log.warning("restore of serving %s failed: %s", name, exc)
                 continue
@@ -280,7 +312,21 @@ def restore() -> list[str]:
     return restarted
 
 
-def start(name: str) -> dict[str, Any]:
+def start(name: str, standalone: bool = False, timeout_s: float = 60.0) -> dict[str, Any]:
+    """Start a serving endpoint.
+
+    ``standalone=True`` hosts it in a detached process
+    (``python -m hops_tpu.modelrepo.serving_host <name>``) that outlives
+    the caller — the stand-in for the reference's platform-owned serving
+    containers (model_repo_and_serving.ipynb:370-374). Default hosts it
+    as a thread of this process, as before.
+    """
+    if standalone:
+        return _start_standalone(name, timeout_s)
+    return _host_here(name)
+
+
+def _host_here(name: str, dedicated: bool = False) -> dict[str, Any]:
     reg = _load_registry()
     if name not in reg:
         raise KeyError(f"serving {name!r} not found")
@@ -289,11 +335,82 @@ def start(name: str) -> dict[str, Any]:
             return reg[name]
         running = _RunningServing(reg[name])
         _servers[name] = running
+    reg = _load_registry()
     reg[name]["status"] = "Running"
     reg[name]["port"] = running.port
+    reg[name]["pid"] = os.getpid()
+    # Only a DEDICATED host process (serving_host <name>) may be killed
+    # by stop() — never a notebook or a shared supervisor whose pid
+    # happens to be on the record.
+    if dedicated:
+        reg[name]["host"] = "standalone"
+    else:
+        reg[name].pop("host", None)
     _save_registry(reg)
     log.info("serving %s listening on 127.0.0.1:%d", name, running.port)
     return reg[name]
+
+
+def _host_log(name: str) -> Path:
+    return _servings_file().parent / f"{name}.host.log"
+
+
+def _start_standalone(name: str, timeout_s: float) -> dict[str, Any]:
+    if name not in _load_registry():
+        raise KeyError(f"serving {name!r} not found")
+    if get_status(name) == "Running":
+        return _load_registry()[name]
+    from hops_tpu.jobs.api import _child_pythonpath
+
+    env = dict(os.environ)
+    env["HOPS_TPU_WORKSPACE"] = str(fs.workspace_root())
+    env["HOPS_TPU_PROJECT"] = fs.project_name()
+    env["PYTHONPATH"] = _child_pythonpath(env.get("PYTHONPATH"))
+    with open(_host_log(name), "a") as logfile:
+        # start_new_session detaches the host from our process group: our
+        # death (even SIGKILL) leaves the endpoint serving. The child owns
+        # its copy of the log fd from here.
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "hops_tpu.modelrepo.serving_host", name],
+            stdout=logfile,
+            stderr=subprocess.STDOUT,
+            env=env,
+            start_new_session=True,
+        )
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        cfg = _load_registry().get(name, {})
+        if cfg.get("pid") == proc.pid and _port_alive(cfg.get("port")):
+            return cfg
+        if proc.poll() is not None:
+            break
+        time.sleep(0.1)
+    tail = _host_log(name).read_text()[-2000:] if _host_log(name).exists() else ""
+    proc.poll() is None and proc.terminate()
+    raise RuntimeError(
+        f"standalone serving {name!r} failed to come up within {timeout_s}s; "
+        f"host log tail:\n{tail}"
+    )
+
+
+def _is_serving_host(pid: int) -> bool:
+    """Guard against pid reuse: only signal a process that actually is a
+    serving host (best-effort; non-Linux says yes)."""
+    try:
+        cmdline = Path(f"/proc/{pid}/cmdline").read_bytes()
+    except OSError:
+        return True
+    return b"serving_host" in cmdline
+
+
+def _pid_alive(pid: int | None) -> bool:
+    if not pid or pid == os.getpid():
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (ProcessLookupError, PermissionError):
+        return False
 
 
 def stop(name: str) -> None:
@@ -303,8 +420,29 @@ def stop(name: str) -> None:
         running.stop()
     reg = _load_registry()
     if name in reg:
+        # A DEDICATED standalone host (another process) owns the server:
+        # terminate it, then record the deliberate stop. In-process hosts
+        # (notebooks, shared supervisors) are never signaled — their pid
+        # on the record is informational.
+        pid = reg[name].get("pid")
+        if (running is None and reg[name].get("host") == "standalone"
+                and _pid_alive(pid) and _is_serving_host(pid)):
+            try:
+                os.kill(pid, signal.SIGTERM)
+                deadline = time.monotonic() + 10.0
+                while time.monotonic() < deadline and _pid_alive(pid):
+                    time.sleep(0.1)
+                if _pid_alive(pid):
+                    os.kill(pid, signal.SIGKILL)
+                    deadline = time.monotonic() + 5.0
+                    while time.monotonic() < deadline and _pid_alive(pid):
+                        time.sleep(0.05)
+            except (ProcessLookupError, PermissionError):
+                pass
+        reg = _load_registry()
         reg[name]["status"] = "Stopped"
         reg[name].pop("port", None)
+        reg[name].pop("pid", None)
         _save_registry(reg)
 
 

@@ -140,7 +140,11 @@ class RuntimeConfig:
     log_level: str = "INFO"
 
 
-_current = RuntimeConfig()
+# Initialized through load() so the documented precedence applies from
+# the start: env (HOPS_TPU_PROJECT / HOPS_TPU_WORKSPACE, as exported to
+# job children and serving hosts) > field defaults; an explicit
+# configure(...) later still overrides either.
+_current = load(RuntimeConfig)
 
 
 def runtime() -> RuntimeConfig:

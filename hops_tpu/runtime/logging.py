@@ -23,10 +23,20 @@ _FORMAT = "%(asctime)s [%(hosttag)s] %(levelname)s %(name)s: %(message)s"
 class _HostTagFilter(logging.Filter):
     def filter(self, record: logging.LogRecord) -> bool:
         if not hasattr(record, "hosttag"):
+            # Tag with the host index ONLY if the jax backend is already
+            # up. ``process_index()`` would otherwise initialize it as a
+            # side effect of logging — which blocks for minutes in
+            # processes that can't reach the accelerator (serving hosts,
+            # job children competing for a single-tenant TPU relay).
             try:
-                import jax
+                from jax._src import xla_bridge
 
-                record.hosttag = f"h{jax.process_index()}"
+                if xla_bridge.backends_are_initialized():
+                    import jax
+
+                    record.hosttag = f"h{jax.process_index()}"
+                else:
+                    record.hosttag = "h?"
             except Exception:
                 record.hosttag = "h?"
         return True

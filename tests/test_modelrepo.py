@@ -261,3 +261,87 @@ class TestTLSLegacyLayout:
         assert Path(tls.get_client_certificate_location()).read_text() == "LEGACY-CERT\n"
         assert Path(tls.get_trust_store()).read_bytes() == b"LEGACY-CA\n"
         assert tls.get_key_store_pwd()  # reconstructed
+
+
+class TestStandaloneServing:
+    """Round-3: out-of-process serving (detached host) + supervisor verb
+    (reference: platform-owned serving containers outlive their creator,
+    model_repo_and_serving.ipynb:370-374)."""
+
+    def _make(self, tmp_path, name):
+        (tmp_path / "p.py").write_text(
+            "class Predict:\n    def predict(self, instances):\n"
+            "        return [[v[0] * 2] for v in instances]\n"
+        )
+        serving.create_or_update(name, model_path=str(tmp_path), model_server="PYTHON")
+
+    def test_standalone_serving_outlives_its_creator(self, tmp_path, workspace):
+        import os
+        import subprocess
+        import sys
+        import textwrap
+
+        self._make(tmp_path, "detached")
+        # The CREATOR is a separate short-lived process: it starts the
+        # standalone host and exits. The endpoint must keep serving.
+        creator = textwrap.dedent(
+            """
+            from hops_tpu.modelrepo import serving
+            cfg = serving.start("detached", standalone=True)
+            print("CREATOR-DONE", cfg["port"], cfg["pid"])
+            """
+        )
+        env = dict(os.environ)
+        env["HOPS_TPU_PROJECT"] = serving.fs.project_name()
+        r = subprocess.run(
+            [sys.executable, "-c", creator], capture_output=True, text=True,
+            env=env, timeout=120,
+        )
+        assert "CREATOR-DONE" in r.stdout, r.stdout + r.stderr
+        try:
+            # Creator is gone; the serving still answers from here.
+            assert serving.get_status("detached") == "Running"
+            out = serving.make_inference_request("detached", {"instances": [[21]]})
+            assert out["predictions"] == [[42]]
+            pid = serving._load_registry()["detached"]["pid"]
+            assert serving._pid_alive(pid)
+        finally:
+            serving.stop("detached")
+        assert serving.get_status("detached") == "Stopped"
+        assert not serving._pid_alive(pid)  # host terminated by stop()
+
+    def test_supervisor_restores_and_serves(self, tmp_path, workspace):
+        import os
+        import signal as sig
+        import subprocess
+        import sys
+        import time
+
+        self._make(tmp_path, "phoenix2")
+        # Orphaned record: Running with a dead port (its host crashed).
+        reg = serving._load_registry()
+        reg["phoenix2"]["status"], reg["phoenix2"]["port"] = "Running", 1
+        serving._save_registry(reg)
+
+        env = dict(os.environ)
+        env["HOPS_TPU_WORKSPACE"] = str(serving.fs.workspace_root())
+        env["HOPS_TPU_PROJECT"] = serving.fs.project_name()
+        sup = subprocess.Popen(
+            [sys.executable, "-m", "hops_tpu.modelrepo.serving_host", "--restore"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        try:
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline:
+                if serving.get_status("phoenix2") == "Running":
+                    break
+                time.sleep(0.2)
+            out = serving.make_inference_request("phoenix2", {"instances": [[3]]})
+            assert out["predictions"] == [[6]]
+        finally:
+            sup.send_signal(sig.SIGTERM)
+            sup.wait(timeout=30)
+            reg = serving._load_registry()
+            reg["phoenix2"]["status"] = "Stopped"
+            reg["phoenix2"].pop("port", None)
+            serving._save_registry(reg)
