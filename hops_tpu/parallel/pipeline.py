@@ -162,13 +162,23 @@ def pipelined_lm_apply(
     the L blocks split into S stage chunks of K=L/S layers (leaves
     ``(S, K, ...)`` — stage-sharded outside, ``lax.scan`` inside).
     Logits match ``model.apply`` exactly (tests/test_pipeline.py).
+
+    MoE models (``moe_every > 0``) pipeline too: layers chunk into
+    uniform (moe_every-1 dense + 1 MoE) groups. Three semantic notes:
+    MoE routing (expert capacity, token drops) is computed per
+    microbatch — the batch a stage sees IS the microbatch, as in any
+    GPipe x MoE system — so whole-batch parity is exact only for
+    drop-free routing; expert weights run REPLICATED within each stage
+    (an ``expert`` mesh axis inside pp stages is not composed yet — use
+    ``models.moe.expert_specs`` on a flat mesh for true ep); and the
+    sown load-balancing aux losses are not threaded through the ring
+    (the pp train loss is the main loss).
     """
+    from hops_tpu.models.moe import MoEBlock
     from hops_tpu.models.transformer import Block, RMSNorm
     from flax import linen as nn
 
     n_stages = mesh.shape[axis]
-    if model.moe_every:
-        raise NotImplementedError("pipelined MoE blocks not supported yet")
     block = Block(
         model.num_heads,
         dtype=model.dtype,
@@ -180,16 +190,61 @@ def pipelined_lm_apply(
     norm = RMSNorm(dtype=model.dtype)
     unembed = nn.Dense(model.vocab_size, dtype=model.dtype, use_bias=False)
 
-    stacked = chunk_stage_params(
-        [params[f"block_{i}"] for i in range(model.num_layers)], n_stages
-    )
+    if model.moe_every:
+        # MoE layers sit at positions g-1, 2g-1, ... (g = moe_every), so
+        # g consecutive layers form a uniform group tree of (g-1 dense +
+        # 1 MoE) params: groups stack/scan exactly like layers do in the
+        # dense path. Router/expert shapes repeat per MoE layer, so the
+        # group trees all share structure. Load-balancing aux losses are
+        # sown inside MoEMLP and dropped here (forward logits are exact;
+        # pp training sees the main loss only — PARITY.md).
+        g = model.moe_every
+        if model.num_layers % g:
+            raise ValueError(
+                f"{model.num_layers} layers not divisible by moe_every={g}")
+        moe_block = MoEBlock(
+            model.num_heads,
+            num_experts=model.num_experts,
+            top_k=model.moe_top_k,
+            dtype=model.dtype,
+            attention_impl=model.attention_impl,
+            mesh=None,
+            dropout_rate=0.0,
+        )
+        groups = []
+        for start in range(0, model.num_layers, g):
+            group = {"moe": params[f"block_{start + g - 1}"]}
+            if g > 1:
+                group["dense"] = jax.tree.map(
+                    lambda *xs: jnp.stack(xs),
+                    *[params[f"block_{i}"] for i in range(start, start + g - 1)],
+                )
+            groups.append(group)
+        stacked = chunk_stage_params(groups, n_stages)
 
-    def stage_fn(stage_params, h):
-        def body(h, layer_params):
-            return block.apply({"params": layer_params}, h), None
+        def stage_fn(stage_params, h):
+            def group_body(h, gp):
+                if g > 1:
+                    def dense_body(h, lp):
+                        return block.apply({"params": lp}, h), None
 
-        h, _ = jax.lax.scan(body, h, stage_params)
-        return h
+                    h, _ = jax.lax.scan(dense_body, h, gp["dense"])
+                return moe_block.apply({"params": gp["moe"]}, h), None
+
+            h, _ = jax.lax.scan(group_body, h, stage_params)
+            return h
+
+    else:
+        stacked = chunk_stage_params(
+            [params[f"block_{i}"] for i in range(model.num_layers)], n_stages
+        )
+
+        def stage_fn(stage_params, h):
+            def body(h, layer_params):
+                return block.apply({"params": layer_params}, h), None
+
+            h, _ = jax.lax.scan(body, h, stage_params)
+            return h
 
     def ingest_fn(p, micro_tokens):
         return embed.apply({"params": p}, micro_tokens)
