@@ -45,6 +45,7 @@ def run_bench(
     warmup: int = 16,
     smoke: bool = False,
     scan_chunk: int = 16,
+    multihost: bool = False,
 ) -> dict:
     """Time the ResNet-50 train step with a device-side training loop.
 
@@ -57,7 +58,7 @@ def run_bench(
     """
     from hops_tpu.models import common
     from hops_tpu.models.resnet import ResNet18ish, ResNet50
-    from hops_tpu.parallel.strategy import Strategy
+    from hops_tpu.parallel.strategy import CollectiveAllReduceStrategy, Strategy
 
     if smoke:
         model = ResNet18ish(dtype=jnp.float32)
@@ -66,9 +67,13 @@ def run_bench(
         model = ResNet50(num_classes=1000)
 
     scan_chunk = min(scan_chunk, steps)  # --steps 8 means 8 steps, not 16
-    strategy = Strategy()  # data-parallel over all visible chips
+    # --multihost: the whole-slice mesh (XLA AllReduce over ICI/DCN),
+    # launched one process per host via ``python -m hops_tpu.launch``
+    # (RUNBOOK_v5e64.md). Default: all chips of this host.
+    strategy = CollectiveAllReduceStrategy() if multihost else Strategy()
     n_chips = strategy.num_replicas_in_sync
     global_batch = per_chip_batch * n_chips
+    local_batch = per_chip_batch * (jax.local_device_count() if multihost else n_chips)
 
     state = strategy.replicate(
         common.create_bn_train_state(
@@ -87,11 +92,12 @@ def run_bench(
 
     step_fn = strategy.step(multi_step)
 
-    rs = np.random.RandomState(0)
+    # Each process contributes its own local shard of the global batch.
+    rs = np.random.RandomState(jax.process_index())
     batch = strategy.distribute_batch(
         {
-            "image": rs.randn(global_batch, image_size, image_size, 3).astype(np.float32),
-            "label": rs.randint(0, 10, (global_batch,)),
+            "image": rs.randn(local_batch, image_size, image_size, 3).astype(np.float32),
+            "label": rs.randint(0, 10, (local_batch,)),
         }
     )
 
@@ -126,6 +132,11 @@ def main() -> None:
     parser.add_argument(
         "--scan-chunk", type=int, default=16, help="train steps per dispatch (1 = python loop)"
     )
+    parser.add_argument(
+        "--multihost", action="store_true",
+        help="whole-slice data parallelism; launch per host via hops_tpu.launch "
+        "(see RUNBOOK_v5e64.md)",
+    )
     args = parser.parse_args()
 
     result = run_bench(
@@ -133,8 +144,11 @@ def main() -> None:
         steps=args.steps,
         smoke=args.smoke,
         scan_chunk=args.scan_chunk,
+        multihost=args.multihost,
     )
     value = result["samples_per_sec_per_chip"]
+    if args.multihost and jax.process_index() != 0:
+        return  # one JSON line total: the chief's
 
     # Baselines are recorded per platform: the first real run on a
     # platform becomes that platform's baseline; later runs report

@@ -108,3 +108,50 @@ def test_two_process_collective_all_reduce(tmp_path):
     sessions = {line.split("session=")[1].split()[0]
                 for out in outs for line in out.splitlines() if "WORKER_OK" in line}
     assert len(sessions) == 1
+
+
+def test_two_process_multihost_bench(tmp_path):
+    """`bench.py --multihost` — the v5e-64 scaling harness (RUNBOOK_v5e64.md)
+    — runs the whole-slice data-parallel benchmark across two OS
+    processes on the fake mesh; the chief prints the one JSON line."""
+    import json
+
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "HOPS_TPU_WORKSPACE": str(tmp_path / "ws"),
+            "TF_CPP_MIN_LOG_LEVEL": "3",
+        }
+    )
+    bench = str(Path(__file__).parent.parent / "bench.py")
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "hops_tpu.launch",
+                "--platform", "cpu",
+                "--coordinator", f"127.0.0.1:{port}",
+                "--num-processes", "2",
+                "--process-id", str(i),
+                bench, "--smoke", "--multihost",
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=str(Path(__file__).parent.parent),
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+    json_lines = [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("{") and "resnet50" in line
+    ]
+    assert len(json_lines) == 1, outs  # chief only
+    rec = json.loads(json_lines[0])
+    assert rec["value"] > 0
