@@ -13,6 +13,7 @@ stopped (the ``checkpointLocation`` contract).
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from pathlib import Path
@@ -80,7 +81,12 @@ class StreamingRunner:
             values = [r["value"] for r in records]
             df = self.transform(values) if self.transform else pd.DataFrame(values)
             out = self.sink_dir / f"part-{self._part:05d}.parquet"
-            df.to_parquet(out, index=False)
+            # Atomic publish: read_sink() may glob concurrently (its
+            # checkpointLocation contract allows external readers), and
+            # a half-written parquet file is a reader crash.
+            tmp = out.with_suffix(f".tmp{os.getpid()}")
+            df.to_parquet(tmp, index=False)
+            os.replace(tmp, out)
             self._part += 1
             self._save_checkpoint()
             return len(records)

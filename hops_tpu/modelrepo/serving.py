@@ -53,6 +53,27 @@ def _servings_file() -> Path:
     return p / "servings.json"
 
 
+import contextlib
+import fcntl
+
+
+@contextlib.contextmanager
+def _registry_lock():
+    """Cross-process lock for registry read-modify-write cycles.
+
+    Atomic replace in _save_registry keeps READERS consistent, but two
+    processes interleaving load-modify-save (a supervisor reviving A
+    while a notebook stops B) would lose updates without this.
+    """
+    lockfile = _servings_file().with_suffix(".lock")
+    with open(lockfile, "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+
+
 def _load_registry() -> dict[str, dict[str, Any]]:
     f = _servings_file()
     return json.loads(f.read_text()) if f.exists() else {}
@@ -259,7 +280,7 @@ def get_status(name: str) -> str:
     if cfg.get("status") == "Running":
         if _port_alive(cfg.get("port")):
             return "Running"
-        if _pid_alive(cfg.get("pid")):
+        if _host_process_alive(cfg):
             # The hosting process is alive but its port didn't answer —
             # a transient probe failure or a wedged host. Do NOT heal
             # (that would orphan the process and invite a duplicate from
@@ -271,7 +292,7 @@ def get_status(name: str) -> str:
         # another thread may have updated other servings. "Failed"
         # (reported as Stopped) preserves the owner's running-intent so
         # restore() still revives it — healing must not erase what it heals.
-        with _lock:
+        with _lock, _registry_lock():
             reg = _load_registry()
             if name in reg and reg[name].get("status") == "Running":
                 reg[name]["status"] = "Failed"
@@ -298,7 +319,7 @@ def restore(standalone: bool = False) -> list[str]:
         # "Failed" = a dead-Running record already healed by get_status;
         # the owner's intent is still Running.
         if cfg.get("status") in ("Running", "Failed") and not hosted and not _port_alive(cfg.get("port")):
-            if _pid_alive(cfg.get("pid")):
+            if _host_process_alive(cfg):
                 log.warning(
                     "serving %s: host pid %s alive but port unresponsive — "
                     "not spawning a duplicate; stop() it first", name, cfg.get("pid"))
@@ -310,6 +331,26 @@ def restore(standalone: bool = False) -> list[str]:
                 continue
             restarted.append(name)
     return restarted
+
+
+def reconcile() -> list[str]:
+    """Shut down in-process servers whose record no longer says Running —
+    the other half of supervision: restore() revives, reconcile() honors
+    deliberate stop()s issued from other processes (which can only flip
+    the record of a server they don't host). Returns stopped names."""
+    stopped = []
+    reg = _load_registry()
+    with _lock:
+        hosted = list(_servers)
+    for name in hosted:
+        if reg.get(name, {}).get("status") == "Running":
+            continue
+        with _lock:
+            running = _servers.pop(name, None)
+        if running is not None:
+            running.stop()
+            stopped.append(name)
+    return stopped
 
 
 def start(name: str, standalone: bool = False, timeout_s: float = 60.0) -> dict[str, Any]:
@@ -335,18 +376,19 @@ def _host_here(name: str, dedicated: bool = False) -> dict[str, Any]:
             return reg[name]
         running = _RunningServing(reg[name])
         _servers[name] = running
-    reg = _load_registry()
-    reg[name]["status"] = "Running"
-    reg[name]["port"] = running.port
-    reg[name]["pid"] = os.getpid()
-    # Only a DEDICATED host process (serving_host <name>) may be killed
-    # by stop() — never a notebook or a shared supervisor whose pid
-    # happens to be on the record.
-    if dedicated:
-        reg[name]["host"] = "standalone"
-    else:
-        reg[name].pop("host", None)
-    _save_registry(reg)
+    with _registry_lock():
+        reg = _load_registry()
+        reg[name]["status"] = "Running"
+        reg[name]["port"] = running.port
+        reg[name]["pid"] = os.getpid()
+        # Only a DEDICATED host process (serving_host <name>) may be
+        # killed by stop() — never a notebook or a shared supervisor
+        # whose pid happens to be on the record.
+        if dedicated:
+            reg[name]["host"] = "standalone"
+        else:
+            reg[name].pop("host", None)
+        _save_registry(reg)
     log.info("serving %s listening on 127.0.0.1:%d", name, running.port)
     return reg[name]
 
@@ -386,11 +428,30 @@ def _start_standalone(name: str, timeout_s: float) -> dict[str, Any]:
             break
         time.sleep(0.1)
     tail = _host_log(name).read_text()[-2000:] if _host_log(name).exists() else ""
-    proc.poll() is None and proc.terminate()
+    if proc.poll() is None:
+        # The host blocks SIGTERM during startup (serving_host's sigwait
+        # routing), so a wedged predictor load must be SIGKILLed.
+        proc.terminate()
+        try:
+            proc.wait(timeout=3)
+        except subprocess.TimeoutExpired:
+            proc.kill()
     raise RuntimeError(
         f"standalone serving {name!r} failed to come up within {timeout_s}s; "
         f"host log tail:\n{tail}"
     )
+
+
+def _host_process_alive(cfg: dict[str, Any]) -> bool:
+    """Is the record's hosting process still alive — with the pid-reuse
+    guard for dedicated hosts (a recycled pid must actually be a
+    serving_host to count, or healing/restore would block forever)."""
+    pid = cfg.get("pid")
+    if not _pid_alive(pid):
+        return False
+    if cfg.get("host") == "standalone":
+        return _is_serving_host(pid)
+    return True
 
 
 def _is_serving_host(pid: int) -> bool:
@@ -439,11 +500,12 @@ def stop(name: str) -> None:
                         time.sleep(0.05)
             except (ProcessLookupError, PermissionError):
                 pass
-        reg = _load_registry()
-        reg[name]["status"] = "Stopped"
-        reg[name].pop("port", None)
-        reg[name].pop("pid", None)
-        _save_registry(reg)
+        with _registry_lock():
+            reg = _load_registry()
+            reg[name]["status"] = "Stopped"
+            reg[name].pop("port", None)
+            reg[name].pop("pid", None)
+            _save_registry(reg)
 
 
 def delete(name: str) -> None:

@@ -60,6 +60,7 @@ def main(argv: list[str] | None = None) -> None:
         print(json.dumps({"restored": names, "pid": os.getpid()}), flush=True)
         if args.watch:
             while signal.sigtimedwait(sigs, args.watch) is None:
+                serving.reconcile()  # honor stop()s issued elsewhere
                 serving.restore()
         else:
             signal.sigwait(sigs)

@@ -143,8 +143,15 @@ class RuntimeConfig:
 # Initialized through load() so the documented precedence applies from
 # the start: env (HOPS_TPU_PROJECT / HOPS_TPU_WORKSPACE, as exported to
 # job children and serving hosts) > field defaults; an explicit
-# configure(...) later still overrides either.
-_current = load(RuntimeConfig)
+# configure(...) later still overrides either. A malformed env var must
+# not make the package unimportable — warn and fall back to defaults.
+try:
+    _current = load(RuntimeConfig)
+except Exception as _env_err:  # noqa: BLE001
+    import warnings
+
+    warnings.warn(f"ignoring invalid HOPS_TPU_* environment: {_env_err}")
+    _current = RuntimeConfig()
 
 
 def runtime() -> RuntimeConfig:

@@ -345,3 +345,19 @@ class TestStandaloneServing:
             reg["phoenix2"]["status"] = "Stopped"
             reg["phoenix2"].pop("port", None)
             serving._save_registry(reg)
+
+    def test_reconcile_honors_external_stop(self, tmp_path, workspace):
+        """A stop() issued from another process can only flip the record;
+        the hosting supervisor's reconcile() must shut the server down."""
+        self._make(tmp_path, "super_hosted")
+        serving.start("super_hosted")  # in-process, as the supervisor hosts
+        port = serving._load_registry()["super_hosted"]["port"]
+        assert serving._port_alive(port)
+        # Another process stops it: record flips, server (ours) still up.
+        reg = serving._load_registry()
+        reg["super_hosted"]["status"] = "Stopped"
+        reg["super_hosted"].pop("port", None)
+        serving._save_registry(reg)
+        assert serving.reconcile() == ["super_hosted"]
+        assert not serving._port_alive(port)
+        assert serving.reconcile() == []  # idempotent
