@@ -58,6 +58,24 @@ class FeatureStore:
         self._conn = conn
         self.project = project
 
+    # -- Scala-builder ergonomics (featurestore/builders.py) ------------------
+
+    def createFeatureGroup(self):  # noqa: N802 — Scala client surface
+        from hops_tpu.featurestore.builders import FeatureGroupBuilder
+
+        return FeatureGroupBuilder(self)
+
+    def createTrainingDataset(self):  # noqa: N802
+        from hops_tpu.featurestore.builders import TrainingDatasetBuilder
+
+        return TrainingDatasetBuilder(self)
+
+    def getFeatureGroup(self, name: str, version: int | None = None):  # noqa: N802
+        return self.get_feature_group(name, version)
+
+    def getName(self) -> str:  # noqa: N802
+        return self.project
+
     # -- feature groups -------------------------------------------------------
 
     def create_feature_group(self, name: str, version: int | None = None, **kwargs) -> FeatureGroup:

@@ -636,3 +636,49 @@ class TestJDBCIngest:
         td.save(joined)
         out = td.read()
         assert set(out.columns) == {"region", "total"} and len(out) == 3
+
+
+class TestScalaBuilderErgonomics:
+    """The reference's JVM builder call shapes (ComputeFeatures.scala:
+    108-115, 312-327), line-for-line in Python (featurestore/builders.py)."""
+
+    def test_feature_group_builder_roundtrip(self, fs):
+        from hops_tpu.featurestore.builders import StatisticsConfig, TimeTravelFormat
+
+        fg = (fs.createFeatureGroup()
+                .name("games_features")
+                .version(1)
+                .description("Features of games")
+                .timeTravelFormat(TimeTravelFormat.HUDI)
+                .primaryKeys(["home_team_id"])
+                .partitionKeys(["score"])
+                .statisticsConfig(StatisticsConfig(True, True, True))
+                .build())
+        fg.save(pd.DataFrame({
+            "home_team_id": [1, 2], "score": [3, 4], "away_team_id": [5, 6],
+        }))
+        got = fs.getFeatureGroup("games_features", 1)
+        assert got.primary_key == ["home_team_id"]
+        assert got.time_travel_format == "COMMIT_LOG"
+        assert got.statistics_config.histograms
+        assert len(got.read()) == 2
+
+    def test_training_dataset_builder_saves_query(self, fs):
+        from hops_tpu.featurestore.builders import DataFormat
+
+        make_fg(fs)
+        td = (fs.createTrainingDataset()
+                .name("tour_td")
+                .version(1)
+                .description("tour TD")
+                .dataFormat(DataFormat.TFRECORD)
+                .build())
+        td.save(fs.get_feature_group("sales", 1).select_all())
+        assert td.data_format == "tfrecord"
+        assert len(td.read()) == 4
+
+    def test_connection_builder(self, fs):
+        from hops_tpu.featurestore.builders import HopsworksConnection
+
+        conn = HopsworksConnection.builder.build()
+        assert conn.get_feature_store().getName()
