@@ -53,21 +53,21 @@ class DataFormat:
 
 
 def _stats_arg(value: Any) -> Any:
-    # Accept StatisticsConfig, the Scala-positional tuple, or a dict.
-    if isinstance(value, StatisticsConfig):
-        return value.to_dict()
+    # The Scala-positional tuple form; StatisticsConfig/dict pass through
+    # (the entities' from_dict accepts both unchanged).
     if isinstance(value, (tuple, list)):
-        keys = ("enabled", "histograms", "correlations")
-        return dict(zip(keys, value))
+        return dict(zip(("enabled", "histograms", "correlations"), value))
     return value
 
 
 class _Builder:
-    """Chained-setter base: unknown setters map camelCase -> kwargs."""
+    """Chained-setter base: setters map camelCase -> snake_case kwargs,
+    with ``_renames`` only for names the mechanical mapping can't derive
+    (plural Scala setters -> singular kwargs)."""
 
     _renames: dict[str, str] = {}
 
-    def __init__(self, fs):
+    def __init__(self, fs=None):
         self._fs = fs
         self._kw: dict[str, Any] = {}
 
@@ -92,11 +92,6 @@ class FeatureGroupBuilder(_Builder):
     _renames = {
         "primaryKeys": "primary_key",
         "partitionKeys": "partition_key",
-        "timeTravelFormat": "time_travel_format",
-        "statisticsConfig": "statistics_config",
-        "onlineEnabled": "online_enabled",
-        "validationType": "validation_type",
-        "eventTime": "event_time",
     }
 
     def build(self):
@@ -111,11 +106,6 @@ class FeatureGroupBuilder(_Builder):
 class TrainingDatasetBuilder(_Builder):
     """`fs.createTrainingDataset()` — ComputeFeatures.scala:320-327."""
 
-    _renames = {
-        "dataFormat": "data_format",
-        "statisticsConfig": "statistics_config",
-        "storageConnector": "storage_connector",
-    }
 
     def build(self):
         kw = dict(self._kw)
@@ -126,34 +116,19 @@ class TrainingDatasetBuilder(_Builder):
         return self._fs.create_training_dataset(name, version=version, **kw)
 
 
+class _ConnBuilder(_Builder):
+    def build(self):
+        from hops_tpu.featurestore.connection import connection
+
+        return connection(**self._kw)
+
+
 class HopsworksConnection:
     """`HopsworksConnection.builder.build()` (Scala Main.scala usage)."""
-
-    class _ConnBuilder:
-        def __init__(self):
-            self._kw: dict[str, Any] = {}
-
-        def __getattr__(self, attr):
-            if attr.startswith("_"):
-                raise AttributeError(attr)
-
-            def setter(value):
-                self._kw[attr] = value
-                return self
-
-            return setter
-
-        def build(self):
-            # `hops_tpu.featurestore.connection` the ATTRIBUTE is the
-            # function re-exported by the package; import the module.
-            import importlib
-
-            conn_mod = importlib.import_module("hops_tpu.featurestore.connection")
-            return conn_mod.connection(**self._kw)
 
     # `.builder` is an attribute in the Scala API, not a call.
     class _BuilderDescriptor:
         def __get__(self, obj, objtype=None):
-            return HopsworksConnection._ConnBuilder()
+            return _ConnBuilder()
 
     builder = _BuilderDescriptor()
