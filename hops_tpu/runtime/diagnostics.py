@@ -151,6 +151,50 @@ def _find_trace_file(trace_dir: str) -> str:
     return files[-1]
 
 
+def _device_op_rows(trace_dir: str) -> tuple[str, list[dict]]:
+    """Parse a :func:`trace` capture into per-op rows for ONE device pid.
+
+    Shared by :func:`roofline_report` and :func:`top_ops` so the
+    load-bearing filters live in one place: one device pid only (in
+    SPMD every chip runs the same program — summing all pids would
+    multiply time and bytes by the chip count), program envelopes
+    (``jit_fn(...)``, bare step numbers) skipped, and the ``*-start``
+    halves of async pairs skipped (bytes live on the ``-done`` event).
+    """
+    import gzip
+    import json
+    import re
+
+    with gzip.open(_find_trace_file(trace_dir)) as f:
+        events = json.load(f)["traceEvents"]
+    pid_names = {
+        e["pid"]: e["args"].get("name", "")
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    device_pids = set(sorted(p for p, n in pid_names.items() if "TPU" in n or "GPU" in n)[:1])
+    device_name = next((pid_names[p] for p in device_pids), "")
+
+    per_op: dict[str, dict] = {}
+    for e in events:
+        args = e.get("args") or {}
+        if e.get("ph") != "X" or e["pid"] not in device_pids or "device_duration_ps" not in args:
+            continue
+        if re.match(r"^(jit_|\d+$)", e["name"]) or e["name"].split(".")[0].endswith("-start"):
+            continue
+        row = per_op.setdefault(
+            e["name"],
+            {"name": e["name"], "category": args.get("hlo_category", e["name"]),
+             "s": 0.0, "flops": 0.0, "bytes": 0.0,
+             "source": args.get("source", "?"), "count": 0},
+        )
+        row["s"] += int(args["device_duration_ps"]) / 1e12
+        row["flops"] += float(args.get("model_flops", 0) or 0)
+        row["bytes"] += float(args.get("raw_bytes_accessed", 0) or 0)
+        row["count"] += 1
+    return device_name, list(per_op.values())
+
+
 def roofline_report(
     trace_dir: str,
     peak_flops: float | None = None,
@@ -171,23 +215,8 @@ def roofline_report(
     is the best-case time at 100% of that roof.
     """
     import collections
-    import gzip
-    import json
-    import re
 
-    with gzip.open(_find_trace_file(trace_dir)) as f:
-        events = json.load(f)["traceEvents"]
-    pid_names = {
-        e["pid"]: e["args"].get("name", "")
-        for e in events
-        if e.get("ph") == "M" and e.get("name") == "process_name"
-    }
-    # One device pid only: in SPMD every chip runs the same program, so
-    # a single chip IS the per-chip roofline; summing all pids would
-    # multiply time and bytes by the chip count.
-    device_pids = sorted(p for p, n in pid_names.items() if "TPU" in n or "GPU" in n)[:1]
-    device_pids = set(device_pids)
-    device_name = next((pid_names[p] for p in device_pids), "")
+    device_name, rows = _device_op_rows(trace_dir)
 
     if peak_flops is None or peak_bw is None:
         # The chrome trace doesn't record the device *kind*, only
@@ -205,29 +234,12 @@ def roofline_report(
             match = _PEAKS["cpu"]
         peak_flops, peak_bw = peak_flops or match[0], peak_bw or match[1]
 
-    # one entry per op name (summed over repeated steps), then by category
-    per_op: dict[str, list] = {}
-    for e in events:
-        args = e.get("args") or {}
-        if e.get("ph") != "X" or e["pid"] not in device_pids or "device_duration_ps" not in args:
-            continue
-        # skip program envelopes (jit_fn(...), bare step numbers) and the
-        # *-start halves of async pairs (bytes live on the -done event)
-        if re.match(r"^(jit_|\d+$)", e["name"]) or e["name"].split(".")[0].endswith("-start"):
-            continue
-        row = per_op.setdefault(
-            e["name"], [args.get("hlo_category", e["name"]), 0.0, 0.0, 0.0]
-        )
-        row[1] += int(args["device_duration_ps"]) / 1e12
-        row[2] += float(args.get("model_flops", 0) or 0)
-        row[3] += float(args.get("raw_bytes_accessed", 0) or 0)
-
     by_cat = collections.defaultdict(lambda: [0.0, 0.0, 0.0])
-    for cat, dur, fl, by in per_op.values():
-        agg = by_cat[cat]
-        agg[0] += dur
-        agg[1] += fl
-        agg[2] += by
+    for r in rows:
+        agg = by_cat[r["category"]]
+        agg[0] += r["s"]
+        agg[1] += r["flops"]
+        agg[2] += r["bytes"]
 
     categories = []
     for cat, (dur, fl, by) in sorted(by_cat.items(), key=lambda kv: -kv[1][0]):
@@ -278,3 +290,27 @@ def print_roofline(report: dict) -> None:
         f"total {report['total_ms']:.1f} ms vs roofline best-case {report['roofline_ms']:.1f} ms "
         f"-> running at {report['roofline_fraction'] * 100:.0f}% of the roofline bound"
     )
+
+
+def top_ops(trace_dir: str, steps: int = 1, n: int = 15) -> list[dict]:
+    """Per-op (not per-category) view of a :func:`trace` capture: the n
+    heaviest device ops with duration, FLOP/s, bytes and source line —
+    for pinpointing which op a bound category's time lives in.
+    Durations/bytes are divided by ``steps``."""
+    _, rows = _device_op_rows(trace_dir)
+    out = sorted(rows, key=lambda r: -r["s"])[:n]
+    result = []
+    for r in out:
+        ms = r["s"] * 1e3 / steps
+        result.append(
+            {
+                "name": r["name"],
+                "category": r["category"],
+                "source": r["source"],
+                "count": r["count"],
+                "ms": ms,
+                "gb": r["bytes"] / 1e9 / steps,
+                "tflops_per_s": (r["flops"] / steps) / max(ms / 1e3, 1e-12) / 1e12,
+            }
+        )
+    return result

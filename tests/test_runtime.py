@@ -253,3 +253,23 @@ class TestRoofline:
         assert conv["tflops_per_s"] == pytest.approx(4e9 / 0.02 / 1e12)  # total fl / total dur
         assert conv["bound"] == "compute"
         print_roofline(r)  # must not raise
+
+    def test_top_ops_lists_heaviest_with_source(self, tmp_path):
+        import gzip, json
+        from hops_tpu.runtime.diagnostics import top_ops
+
+        d = tmp_path / "plugins" / "profile" / "x"
+        d.mkdir(parents=True)
+        events = [
+            {"ph": "M", "pid": 3, "name": "process_name", "args": {"name": "/device:TPU:0"}},
+            {"ph": "X", "pid": 3, "name": "fusion.9", "dur": 1,
+             "args": {"device_duration_ps": int(2e10), "hlo_category": "loop fusion",
+                      "model_flops": 1e9, "raw_bytes_accessed": 4e9, "source": "a.py:7"}},
+            {"ph": "X", "pid": 3, "name": "copy.1", "dur": 1,
+             "args": {"device_duration_ps": int(1e9), "hlo_category": "copy"}},
+        ]
+        with gzip.open(d / "h.trace.json.gz", "wt") as f:
+            json.dump({"traceEvents": events}, f)
+        rows = top_ops(str(tmp_path), steps=2, n=5)
+        assert rows[0]["name"] == "fusion.9" and rows[0]["ms"] == pytest.approx(10.0)
+        assert rows[0]["gb"] == pytest.approx(2.0) and rows[0]["source"] == "a.py:7"
