@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
+from hops_tpu.parallel.mesh import pvary as _pvary
+
 
 def stack_stage_params(per_stage_params: list[Any]) -> Any:
     """Stack S same-structure param trees along a new leading stage dim."""
@@ -60,8 +62,17 @@ def pipeline_apply(
     ingest_params: Any = None,
     emit_fn: Callable[[Any, jax.Array], jax.Array] | None = None,
     emit_params: Any = None,
-) -> jax.Array:
+    stage_aux: bool = False,
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run ``x`` through S pipelined stages; returns the final outputs.
+
+    ``stage_aux=True`` changes the stage contract to
+    ``stage_fn(params_s, h) -> (h, aux_scalar)`` and returns
+    ``(outputs, aux)`` where ``aux`` is the mean over microbatches of
+    the per-stage scalars, summed across stages (``psum``) — how
+    sown per-layer losses (MoE load balancing) ride the ring.
+    Fill/drain ticks (where a stage holds no real microbatch) are
+    masked out of the accumulation.
 
     ``stage_fn(params_s, h) -> h`` must preserve ``h``'s shape (a
     residual-block stack). ``stacked_params`` leaves have leading dim S
@@ -95,8 +106,6 @@ def pipeline_apply(
 
     def local_fn(params, ingest_p, emit_p, x):
         # params leaves arrive as (1, ...) slices of the stage stack.
-        from hops_tpu.parallel.mesh import pvary as _pvary
-
         params = jax.tree.map(lambda p: p[0], params)
         s = jax.lax.axis_index(axis)
         micro = x.reshape(m, batch // m, *x.shape[1:])
@@ -105,14 +114,22 @@ def pipeline_apply(
         h0 = ingest(ingest_p, micro[0])
         buf = _pvary(jnp.zeros_like(h0), (axis,))
         outputs = _pvary(jnp.zeros((m,) + h0.shape, h0.dtype), (axis,))
+        aux_sum = _pvary(jnp.zeros((), jnp.float32), (axis,))
 
         def tick(t, carry):
-            buf, outputs = carry
+            buf, outputs, aux_sum = carry
             # Stage 0 ingests microbatch t (while t < m); later stages
             # consume what the previous tick's ppermute delivered.
             feed = ingest(ingest_p, micro[jnp.clip(t, 0, m - 1)])
             h_in = jnp.where(s == 0, feed, buf)
-            h_out = stage_fn(params, h_in)
+            if stage_aux:
+                h_out, aux_t = stage_fn(params, h_in)
+                # Stage s holds real microbatch t-s only for 0 <= t-s < m;
+                # fill/drain ticks run on garbage and must not count.
+                valid = (t - s >= 0) & (t - s < m)
+                aux_sum = aux_sum + jnp.where(valid, aux_t.astype(jnp.float32), 0.0)
+            else:
+                h_out = stage_fn(params, h_in)
             # The last stage emits microbatch t-(S-1) once the pipe fills.
             out_idx = t - (n_stages - 1)
             emit = (s == n_stages - 1) & (out_idx >= 0)
@@ -122,16 +139,21 @@ def pipeline_apply(
             buf = jax.lax.ppermute(
                 h_out, axis, [(i, i + 1) for i in range(n_stages - 1)]
             )
-            return buf, outputs
+            return buf, outputs, aux_sum
 
-        _, outputs = jax.lax.fori_loop(0, m + n_stages - 1, tick, (buf, outputs))
+        _, outputs, aux_sum = jax.lax.fori_loop(
+            0, m + n_stages - 1, tick, (buf, outputs, aux_sum)
+        )
         # Only the last stage holds real outputs; broadcast to all so the
         # caller sees a replicated result (loss runs everywhere, SPMD).
         outputs = jax.lax.psum(
             jnp.where(s == n_stages - 1, outputs, jnp.zeros_like(outputs)), axis
         )
         outputs = outputs.reshape(batch, *h0.shape[1:])
-        return emit_fn(emit_p, outputs) if emit_fn else outputs
+        out = emit_fn(emit_p, outputs) if emit_fn else outputs
+        if stage_aux:
+            return out, jax.lax.psum(aux_sum, axis) / m
+        return out
 
     return shard_map(
         local_fn,
@@ -142,7 +164,7 @@ def pipeline_apply(
             P() if has_params[1] else None,
             P(),
         ),
-        out_specs=P(),
+        out_specs=(P(), P()) if stage_aux else P(),
     )(stacked_params, ingest_params, emit_params, x)
 
 
@@ -154,7 +176,8 @@ def pipelined_lm_apply(
     *,
     axis: str = "stage",
     num_microbatches: int | None = None,
-) -> jax.Array:
+    return_aux: bool = False,
+) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run a ``TransformerLM`` forward through the GPipe ring.
 
     Heterogeneous stage signatures via the ring-boundary hooks: embed is
@@ -164,15 +187,18 @@ def pipelined_lm_apply(
     Logits match ``model.apply`` exactly (tests/test_pipeline.py).
 
     MoE models (``moe_every > 0``) pipeline too: layers chunk into
-    uniform (moe_every-1 dense + 1 MoE) groups. Three semantic notes:
-    MoE routing (expert capacity, token drops) is computed per
-    microbatch — the batch a stage sees IS the microbatch, as in any
-    GPipe x MoE system — so whole-batch parity is exact only for
-    drop-free routing; expert weights run REPLICATED within each stage
-    (an ``expert`` mesh axis inside pp stages is not composed yet — use
-    ``models.moe.expert_specs`` on a flat mesh for true ep); and the
-    sown load-balancing aux losses are not threaded through the ring
-    (the pp train loss is the main loss).
+    uniform (moe_every-1 dense + 1 MoE) groups. Semantic notes: MoE
+    routing (expert capacity, token drops) is computed per microbatch —
+    the batch a stage sees IS the microbatch, as in any GPipe x MoE
+    system — so whole-batch parity is exact only for drop-free routing;
+    expert weights run REPLICATED within each stage (an ``expert`` mesh
+    axis inside pp stages is not composed yet — use
+    ``models.moe.expert_specs`` on a flat mesh for true ep).
+
+    ``return_aux=True`` returns ``(logits, aux)`` where ``aux`` is the
+    sown load-balancing loss accumulated through the ring (mean over
+    microbatches, summed over layers/stages) — feed it into the train
+    loss exactly like ``make_lm_train_step`` does for the dense path.
     """
     from hops_tpu.models.moe import MoEBlock
     from hops_tpu.models.transformer import Block, RMSNorm
@@ -196,8 +222,8 @@ def pipelined_lm_apply(
         # 1 MoE) params: groups stack/scan exactly like layers do in the
         # dense path. Router/expert shapes repeat per MoE layer, so the
         # group trees all share structure. Load-balancing aux losses are
-        # sown inside MoEMLP and dropped here (forward logits are exact;
-        # pp training sees the main loss only — PARITY.md).
+        # collected per group via mutable apply and accumulated through
+        # the ring (stage_aux); return_aux exposes them to the caller.
         g = model.moe_every
         if model.num_layers % g:
             raise ValueError(
@@ -223,16 +249,28 @@ def pipelined_lm_apply(
         stacked = chunk_stage_params(groups, n_stages)
 
         def stage_fn(stage_params, h):
-            def group_body(h, gp):
+            def group_body(carry, gp):
+                h, aux = carry
                 if g > 1:
                     def dense_body(h, lp):
                         return block.apply({"params": lp}, h), None
 
                     h, _ = jax.lax.scan(dense_body, h, gp["dense"])
-                return moe_block.apply({"params": gp["moe"]}, h), None
+                h, mods = moe_block.apply(
+                    {"params": gp["moe"]}, h, mutable=["losses"]
+                )
+                aux = aux + sum(
+                    jnp.sum(jnp.stack(v))
+                    for v in jax.tree.leaves(
+                        mods.get("losses", {}),
+                        is_leaf=lambda x: isinstance(x, tuple),
+                    )
+                )
+                return (h, aux), None
 
-            h, _ = jax.lax.scan(group_body, h, stage_params)
-            return h
+            aux0 = _pvary(jnp.zeros((), jnp.float32), (axis,))
+            (h, aux), _ = jax.lax.scan(group_body, (h, aux0), stage_params)
+            return h, aux
 
     else:
         stacked = chunk_stage_params(
@@ -244,7 +282,7 @@ def pipelined_lm_apply(
                 return block.apply({"params": layer_params}, h), None
 
             h, _ = jax.lax.scan(body, h, stage_params)
-            return h
+            return h, _pvary(jnp.zeros((), jnp.float32), (axis,))
 
     def ingest_fn(p, micro_tokens):
         return embed.apply({"params": p}, micro_tokens)
@@ -255,7 +293,7 @@ def pipelined_lm_apply(
         )
         return logits.astype(jnp.float32)
 
-    return pipeline_apply(
+    logits, aux = pipeline_apply(
         stage_fn,
         stacked,
         tokens,
@@ -266,4 +304,6 @@ def pipelined_lm_apply(
         ingest_params=params["embed"],
         emit_fn=emit_fn,
         emit_params={"final_norm": params["final_norm"], "unembed": params["unembed"]},
+        stage_aux=True,
     )
+    return (logits, aux) if return_aux else logits
