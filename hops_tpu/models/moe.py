@@ -97,6 +97,24 @@ class MoEMLP(nn.Module):
         return out.reshape(b, s, dm)
 
 
+
+def sum_sown_losses(variables: Any) -> jax.Array | float:
+    """Reduce the ``"losses"`` collection of a ``mutable=["losses"]``
+    apply's variables to one scalar (0.0 when nothing was sown).
+
+    Flax ``sow`` accumulates each loss as a tuple of arrays; this is
+    the single definition of "total sown aux" shared by the dense
+    train step (``make_lm_train_step``) and the pipeline ring
+    (``pipeline.pipelined_lm_apply``) so the two can never diverge.
+    Takes the whole variables mapping, not the collection itself.
+    """
+    leaves = jax.tree.leaves(
+        variables.get("losses", {}), is_leaf=lambda x: isinstance(x, tuple)
+    )
+    if not leaves:
+        return 0.0
+    return sum(jnp.sum(jnp.stack(v)) for v in leaves)
+
 def expert_specs(params: Any, axis: str = "expert") -> Any:
     """PartitionSpec tree sharding every expert-stacked weight (leading
     dim == num_experts, named ``w_in``/``w_out``) on ``axis``; the rest

@@ -21,14 +21,17 @@ by ``parallel.sharding.infer_param_spec``; activations shard
 
 from __future__ import annotations
 
-import math
 from typing import Any
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from hops_tpu.ops.attention import attention_reference, flash_attention
+from hops_tpu.ops.attention import (
+    attention_reference,
+    decode_attention,
+    flash_attention,
+)
 
 
 def rotary_embedding(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
@@ -113,9 +116,10 @@ class Attention(nn.Module):
         (3.1× end-to-end on an 8k prompt, BENCHMARKS.md
         "generation-path prefill"). Single-token steps — and multi-token
         appends to a warm cache (chunked prefill), whose offset is a
-        traced value the kernel can't take — score against the full
-        static-shape cache with unwritten slots masked, so jit sees one
-        shape for every decode step.
+        traced value — stream the static-shape cache through the
+        ``decode_attention`` kernel (one near-bandwidth HBM pass with
+        the validity mask applied as a bias), so jit sees one shape
+        for every decode step.
         """
         fresh_cache = not self.has_variable("cache", "k")
         cache_shape = (b, self.num_heads, self.max_decode_len, head_dim)
@@ -136,14 +140,13 @@ class Attention(nn.Module):
             # to, so the chunk's own k/v are the whole visible history.
             o = flash_attention(q, k, v, causal=True)
         else:
-            scores = jnp.einsum(
-                "bhqd,bhkd->bhqk", q, ck.value, preferred_element_type=jnp.float32
-            ) / math.sqrt(head_dim)
-            k_pos = jnp.arange(self.max_decode_len)[None, :]
-            visible = k_pos <= pos[:, None]  # causal + excludes unwritten slots
-            scores = jnp.where(visible[None, None], scores, float("-inf"))
-            probs = jax.nn.softmax(scores, axis=-1)
-            o = jnp.einsum("bhqk,bhkd->bhqd", probs.astype(cv.value.dtype), cv.value)
+            # Token steps (and warm-cache chunk appends) stream the
+            # cache through the Pallas decode kernel — one
+            # near-bandwidth HBM pass instead of the ~90 GB/s masked
+            # matvec fusion XLA makes of the einsum formulation, which
+            # was 85% of decode step time (BENCHMARKS.md "KV-cached
+            # decoding").
+            o = decode_attention(q, ck.value, cv.value, idx.value)
         o = jnp.moveaxis(o, 1, 2).reshape(b, s, dm)
         return nn.DenseGeneral(dm, dtype=self.dtype, name="out", use_bias=False)(o)
 
@@ -265,6 +268,8 @@ def make_lm_train_step(aux_loss_weight: float = 0.01):
     """
     import optax
 
+    from hops_tpu.models.moe import sum_sown_losses
+
     def train_step(state, batch):
         tokens = batch["tokens"]
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
@@ -279,11 +284,7 @@ def make_lm_train_step(aux_loss_weight: float = 0.01):
                 mutable=["losses"],
             )
             loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
-            aux = sum(
-                jnp.sum(jnp.stack(v)) for v in jax.tree.leaves(
-                    mods.get("losses", {}), is_leaf=lambda x: isinstance(x, tuple)
-                )
-            ) if mods.get("losses") else 0.0
+            aux = sum_sown_losses(mods)
             return loss + aux_loss_weight * aux, loss
 
         (_, loss), grads = jax.value_and_grad(compute_loss, has_aux=True)(state.params)

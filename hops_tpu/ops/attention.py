@@ -82,6 +82,29 @@ def _causal_mask(s, qi, kj, block_q, block_k, q_offset):
 # ---------------------------------------------------------------------------
 
 
+def _online_softmax_update(sc, v_ref, m_scr, l_scr, acc_scr):
+    """Fold one masked score block ``sc`` (fp32, -inf at masked entries)
+    into the running (m, l, acc) online-softmax scratch. The NEG_INF
+    guards keep fully-masked rows at l == 0 (finalize substitutes 1)
+    instead of NaN. Shared by the training forward kernel and the
+    decode kernel — this rescaling is the subtlest numerics in the
+    file and must exist exactly once."""
+    m = m_scr[:, :1]  # (rows, 1), broadcast across lanes
+    l = l_scr[:, :1]
+    m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
+    m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
+    p = jnp.exp(sc - m_safe)
+    alpha = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - m_safe))
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0],
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
+    )
+    acc_scr[...] = acc_scr[...] * alpha + pv
+    m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     *, sm_scale, causal, block_q, block_k, q_offset,
@@ -108,20 +131,7 @@ def _fwd_kernel(
         s = s * sm_scale
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, q_offset)
-        m = m_scr[:, :1]  # (bq, 1), broadcast across lanes
-        l = l_scr[:, :1]
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        m_safe = jnp.where(m_new == NEG_INF, 0.0, m_new)
-        p = jnp.exp(s - m_safe)
-        alpha = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - m_safe))
-        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(
-            p.astype(v_ref.dtype), v_ref[0],
-            (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
-        )
-        acc_scr[...] = acc_scr[...] * alpha + pv
-        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+        _online_softmax_update(s, v_ref, m_scr, l_scr, acc_scr)
 
     @pl.when(kj == nk - 1)
     def _finalize():
@@ -439,3 +449,146 @@ def flash_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     return _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset, interpret)
+
+
+# ---------------------------------------------------------------------------
+# Decode attention: stream a fixed-capacity KV cache once per step
+# ---------------------------------------------------------------------------
+
+
+def decode_attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    valid_len: jax.Array,
+    sm_scale: float | None = None,
+) -> jax.Array:
+    """XLA ground truth for :func:`decode_attention`.
+
+    ``q`` is ``(b, h, s, d)`` — the last ``s`` tokens, already RoPE'd,
+    occupying absolute positions ``valid_len - s .. valid_len - 1``
+    of the ``(b, h, capacity, d)`` caches. Exactly causal attention
+    with the query chunk placed at offset ``valid_len - s``, so it
+    delegates to :func:`attention_reference` (whose masking is pure
+    traced arithmetic, hence a traced ``valid_len`` works). XLA lowers
+    this to a badly-tiled matvec fusion at s=1 (~90 GB/s measured;
+    BENCHMARKS.md "KV-cached decoding") — kept only as ground truth
+    and shape fallback.
+    """
+    return attention_reference(
+        q, k, v, causal=True, sm_scale=sm_scale,
+        q_offset=valid_len - q.shape[2],
+    )
+
+
+def _decode_kernel(
+    q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale,
+):
+    """One (bh, kj) grid step of cache attention.
+
+    Deliberately uses only the features of the proven ``_fwd_kernel``
+    (static grid, program-id conditions, VMEM scratch): the
+    causal/validity mask arrives as an additive fp32 bias computed by
+    XLA from the traced ``valid_len``, so the kernel itself is fully
+    static — no scalar prefetch, no data-dependent predication.
+    """
+    kj = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    sc = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    sc = sc * sm_scale + bias_ref[0]
+    _online_softmax_update(sc, v_ref, m_scr, l_scr, acc_scr)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    valid_len: jax.Array,
+    *,
+    sm_scale: float | None = None,
+    block_k: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Attention for KV-cached decoding: ``q`` (b, h, s, d) against
+    fixed-capacity caches (b, h, capacity, d) of which the first
+    ``valid_len`` positions are written (``valid_len`` is a traced
+    scalar — the cache index AFTER the current chunk was stored; query
+    row i sits at absolute position ``valid_len - s + i``).
+
+    The XLA formulation (:func:`decode_attention_reference`) lowers the
+    s=1 matvec + mask + softmax chain to a fusion that sustains only
+    ~90 GB/s on v5e (BENCHMARKS.md "KV-cached decoding" — 85% of decode
+    step time). Here K/V stream through the MXU in ``block_k`` tiles
+    with fp32 online-softmax scratch, one HBM pass at near-bandwidth.
+    The causal/validity mask is an additive bias computed by XLA from
+    ``valid_len`` (~``q_rows*capacity*4`` bytes, <2% of the K/V
+    traffic) so the kernel needs no dynamic features beyond those of
+    the proven training kernel. Query rows are padded to the sublane
+    tile; pad rows are fully masked and sliced off. No VJP — this is
+    an inference op.
+    """
+    b, h, s, d = q.shape
+    cap = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if block_k is None:
+        block_k = _fit_block(cap, 512)
+    else:
+        block_k = min(block_k, cap)
+    q_rows = max(8, -(-s // 8) * 8)
+    # An explicit block_k that doesn't divide the capacity would floor
+    # out of the grid and silently skip the cache tail — fall back.
+    if not block_k or cap % block_k or s > 64 or q_rows > cap:
+        return decode_attention_reference(q, k, v, valid_len, sm_scale)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    qf = _flat(q)
+    if q_rows != s:
+        qf = jnp.pad(qf, ((0, 0), (0, q_rows - s), (0, 0)))
+    # (q_rows, cap) additive mask: 0 where row i sees k_pos, -inf
+    # elsewhere (pad rows i >= s see nothing; finalize guards l == 0).
+    row = jnp.arange(q_rows)[:, None]
+    k_pos = jnp.arange(cap)[None, :]
+    visible = (row < s) & (k_pos <= valid_len - s + row)
+    bias = jnp.where(visible, 0.0, NEG_INF).astype(jnp.float32)[None]
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, sm_scale=sm_scale),
+        grid=(b * h, cap // block_k),
+        in_specs=[
+            pl.BlockSpec((1, q_rows, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, q_rows, block_k), lambda bh, j: (0, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, q_rows, d), lambda bh, j: (bh, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, q_rows, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((q_rows, _LANES), jnp.float32),
+            pltpu.VMEM((q_rows, _LANES), jnp.float32),
+            pltpu.VMEM((q_rows, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+        interpret=interpret,
+    )(qf, _flat(k), _flat(v), bias)
+    return out[:, :s].reshape(b, h, s, d)

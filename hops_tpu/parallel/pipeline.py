@@ -200,7 +200,7 @@ def pipelined_lm_apply(
     microbatches, summed over layers/stages) — feed it into the train
     loss exactly like ``make_lm_train_step`` does for the dense path.
     """
-    from hops_tpu.models.moe import MoEBlock
+    from hops_tpu.models.moe import MoEBlock, sum_sown_losses
     from hops_tpu.models.transformer import Block, RMSNorm
     from flax import linen as nn
 
@@ -259,13 +259,7 @@ def pipelined_lm_apply(
                 h, mods = moe_block.apply(
                     {"params": gp["moe"]}, h, mutable=["losses"]
                 )
-                aux = aux + sum(
-                    jnp.sum(jnp.stack(v))
-                    for v in jax.tree.leaves(
-                        mods.get("losses", {}),
-                        is_leaf=lambda x: isinstance(x, tuple),
-                    )
-                )
+                aux = aux + sum_sown_losses(mods)
                 return (h, aux), None
 
             aux0 = _pvary(jnp.zeros((), jnp.float32), (axis,))

@@ -148,3 +148,96 @@ def test_short_seq_routes_to_xla(monkeypatch):
     calls.clear()
     A.flash_attention(q, k, v, causal=True, block_q=128, block_k=128)
     assert not calls  # explicit blocks force the kernel
+
+
+# -- decode attention (KV-cache token steps) ---------------------------------
+
+
+def _cache_inputs(batch=2, heads=4, cap=512, d=64, dtype=jnp.float32):
+    _, k, v = _inputs(batch=batch, heads=heads, seq=cap, d=d, dtype=dtype, seed=1)
+    return k, v
+
+
+@pytest.mark.parametrize(
+    "s,valid", [(1, 1), (1, 7), (1, 128), (1, 300), (4, 132), (16, 512), (5, 5)]
+)
+def test_decode_attention_matches_reference(s, valid):
+    from hops_tpu.ops.attention import decode_attention, decode_attention_reference
+
+    k, v = _cache_inputs()
+    q, _, _ = _inputs(batch=2, heads=4, seq=s, d=64, seed=2)
+    out = decode_attention(q, k, v, jnp.int32(valid), block_k=128)
+    ref = decode_attention_reference(q, k, v, jnp.int32(valid))
+    np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+
+
+def test_decode_attention_traced_valid_len_under_scan():
+    """One compiled program serves every step: valid_len is a traced
+    scalar riding the scan carry, the shapes never change."""
+    from hops_tpu.ops.attention import decode_attention, decode_attention_reference
+
+    k, v = _cache_inputs(batch=1, heads=2, cap=256)
+    q, _, _ = _inputs(batch=1, heads=2, seq=1, d=64, seed=2)
+
+    def run(fn):
+        def step(_, vl):
+            return None, fn(q, k, v, vl)
+
+        _, outs = jax.lax.scan(step, None, jnp.arange(1, 40, dtype=jnp.int32))
+        return outs
+
+    outs = run(lambda q, k, v, vl: decode_attention(q, k, v, vl, block_k=128))
+    refs = run(decode_attention_reference)
+    np.testing.assert_allclose(outs, refs, atol=2e-6, rtol=2e-6)
+
+
+def test_decode_attention_ignores_garbage_past_valid_len():
+    """Slots past valid_len hold arbitrary finite data (stale
+    generations, zeros) and must not leak into the output. (NaN
+    garbage is out of scope: masked probabilities are exactly 0 but
+    0*NaN propagates through the p@V contraction — identically true
+    of the XLA reference path; caches are zero-initialized.)"""
+    from hops_tpu.ops.attention import decode_attention
+
+    k, v = _cache_inputs(batch=1, heads=1, cap=256)
+    q, _, _ = _inputs(batch=1, heads=1, seq=1, d=64, seed=2)
+    clean = decode_attention(q, k, v, jnp.int32(100), block_k=128)
+    k = k.at[:, :, 100:].set(1e30)
+    v = v.at[:, :, 100:].set(-1e30)
+    dirty = decode_attention(q, k, v, jnp.int32(100), block_k=128)
+    np.testing.assert_array_equal(clean, dirty)
+
+
+def test_decode_attention_odd_capacity_falls_back():
+    """A capacity no 128-multiple divides routes to the XLA reference."""
+    from hops_tpu.ops.attention import decode_attention, decode_attention_reference
+
+    k, v = _cache_inputs(batch=1, heads=1, cap=100)
+    q, _, _ = _inputs(batch=1, heads=1, seq=1, d=64, seed=2)
+    out = decode_attention(q, k, v, jnp.int32(60))
+    ref = decode_attention_reference(q, k, v, jnp.int32(60))
+    np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+
+
+def test_decode_attention_bf16():
+    from hops_tpu.ops.attention import decode_attention, decode_attention_reference
+
+    k, v = _cache_inputs(batch=1, heads=2, cap=256, dtype=jnp.bfloat16)
+    q, _, _ = _inputs(batch=1, heads=2, seq=1, d=64, dtype=jnp.bfloat16, seed=2)
+    out = decode_attention(q, k, v, jnp.int32(200), block_k=128)
+    ref = decode_attention_reference(q, k, v, jnp.int32(200))
+    np.testing.assert_allclose(
+        out.astype(jnp.float32), ref.astype(jnp.float32), atol=2e-2, rtol=2e-2
+    )
+
+
+def test_decode_attention_non_dividing_block_k_falls_back():
+    """An explicit block_k that doesn't divide the capacity must not
+    silently skip the cache tail (review finding: grid floor-division)."""
+    from hops_tpu.ops.attention import decode_attention, decode_attention_reference
+
+    k, v = _cache_inputs(batch=1, heads=1, cap=384)
+    q, _, _ = _inputs(batch=1, heads=1, seq=1, d=64, seed=2)
+    out = decode_attention(q, k, v, jnp.int32(300), block_k=256)  # 384 % 256 != 0
+    ref = decode_attention_reference(q, k, v, jnp.int32(300))
+    np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)

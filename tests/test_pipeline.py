@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from hops_tpu.models.moe import sum_sown_losses
 from hops_tpu.parallel import mesh as mesh_lib
 from hops_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
@@ -252,10 +253,7 @@ def test_pipelined_moe_aux_loss_matches_dense(stage_mesh):
     params = model.init(jax.random.PRNGKey(13), tokens)["params"]
 
     _, mods = model.apply({"params": params}, tokens, mutable=["losses"])
-    dense_aux = sum(
-        jnp.sum(jnp.stack(v)) for v in jax.tree.leaves(
-            mods["losses"], is_leaf=lambda x: isinstance(x, tuple))
-    )
+    dense_aux = sum_sown_losses(mods)
     logits, pp_aux = pipelined_lm_apply(
         model, params, tokens, stage_mesh, return_aux=True)
     assert logits.shape == (8, 16, 64)
