@@ -29,13 +29,29 @@ from flax import linen as nn
 
 
 class MoEMLP(nn.Module):
-    """Top-k routed expert FFN over ``(batch, seq, d_model)``."""
+    """Top-k routed expert FFN over ``(batch, seq, d_model)``.
+
+    Two expert-parallel modes:
+
+    - GSPMD (default): params are full ``(num_experts, ...)`` arrays and
+      ep comes from placing them ``P("expert", ...)`` (see
+      :func:`expert_specs`) — XLA partitions the einsums.
+    - Explicit (``expert_axis`` set): for use under an ENCLOSING
+      ``shard_map`` that carries an ``expert``-named mesh axis (ep
+      inside pipeline stages). Params hold only the local
+      ``num_experts // expert_shards`` experts; routing still spans all
+      ``num_experts`` (the router is replicated), each device computes
+      its local experts' contribution and a ``psum`` over
+      ``expert_axis`` combines — exact same math as the dense dispatch.
+    """
 
     num_experts: int = 8
     top_k: int = 2
     hidden_mult: int = 4
     capacity_factor: float = 1.25
     dtype: Any = jnp.bfloat16
+    expert_axis: str | None = None
+    expert_shards: int = 1
 
     @nn.compact
     def __call__(self, x):
@@ -68,23 +84,39 @@ class MoEMLP(nn.Module):
         dispatch = dispatch.astype(self.dtype)
         combine = dispatch * gates[..., None].astype(self.dtype)
 
-        # Expert buffers: (E, C, dm).
-        expert_in = jnp.einsum("td,tec->ecd", tokens.astype(self.dtype), dispatch)
-
-        # Plain (unboxed) params; expert parallelism comes from placing
-        # them P("expert", None, None) — see expert_specs() below.
+        if self.num_experts % self.expert_shards:
+            raise ValueError(
+                f"{self.num_experts} experts not divisible by "
+                f"expert_shards={self.expert_shards}"
+            )
+        e_local = self.num_experts // self.expert_shards
+        # Plain (unboxed) params; under expert_axis they hold only this
+        # shard's experts, otherwise parallelism comes from placing the
+        # full stack P("expert", None, None) — see expert_specs() below.
         w_in = self.param(
-            "w_in", nn.initializers.lecun_normal(), (self.num_experts, dm, hidden)
+            "w_in", nn.initializers.lecun_normal(), (e_local, dm, hidden)
         ).astype(self.dtype)
         w_out = self.param(
-            "w_out", nn.initializers.lecun_normal(), (self.num_experts, hidden, dm)
+            "w_out", nn.initializers.lecun_normal(), (e_local, hidden, dm)
         ).astype(self.dtype)
 
+        if self.expert_axis is not None:
+            start = jax.lax.axis_index(self.expert_axis) * e_local
+            dispatch = jax.lax.dynamic_slice_in_dim(dispatch, start, e_local, axis=1)
+            combine = jax.lax.dynamic_slice_in_dim(combine, start, e_local, axis=1)
+
+        # Expert buffers: (E_local, C, dm).
+        expert_in = jnp.einsum("td,tec->ecd", tokens.astype(self.dtype), dispatch)
         h = jnp.einsum("ecd,edh->ech", expert_in, w_in)
         h = nn.gelu(h)
         expert_out = jnp.einsum("ech,ehd->ecd", h, w_out)
 
         out = jnp.einsum("ecd,tec->td", expert_out, combine)
+        if self.expert_axis is not None:
+            # Each shard contributed its local experts' weighted outputs;
+            # the top-k combine is a linear sum over experts, so psum
+            # over the expert axis reproduces the dense dispatch exactly.
+            out = jax.lax.psum(out, self.expert_axis)
 
         # Load-balancing auxiliary loss (Switch-style): mean gate prob ×
         # fraction of tokens routed, per expert. Stored for the train
@@ -145,6 +177,8 @@ class MoEBlock(nn.Module):
     batch_axis: Any = None
     dropout_rate: float = 0.0
     max_decode_len: int = 2048
+    expert_axis: str | None = None
+    expert_shards: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -167,6 +201,8 @@ class MoEBlock(nn.Module):
             num_experts=self.num_experts,
             top_k=self.top_k,
             dtype=self.dtype,
+            expert_axis=self.expert_axis,
+            expert_shards=self.expert_shards,
             name="moe",
         )(RMSNorm(dtype=self.dtype)(x))
         if self.dropout_rate:

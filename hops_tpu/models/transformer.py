@@ -80,12 +80,27 @@ class Attention(nn.Module):
             return self._decode_attend(q, k, v, b, s, dm, head_dim)
 
         pos = jnp.arange(s)
+        if self.attention_impl == "ring_local":
+            # Inside a seq-sharded shard_map x is the LOCAL chunk:
+            # absolute positions start at this shard's offset.
+            pos = pos + jax.lax.axis_index(self.seq_axis) * s
         q, k = rotary_embedding(q, pos), rotary_embedding(k, pos)
 
         if self.attention_impl == "flash":
             o = flash_attention(q, k, v, causal=True)
         elif self.attention_impl == "reference":
             o = attention_reference(q, k, v, causal=True)
+        elif self.attention_impl == "ring_local":
+            # Already inside a shard_map carrying a seq-named mesh axis
+            # (sp inside pp stages): run the per-device ring body with
+            # named-axis collectives only.
+            from hops_tpu.parallel import ringattention
+
+            o = ringattention.ring_attention_local(
+                q, k, v,
+                axis=self.seq_axis, batch_axis=self.batch_axis, causal=True,
+                ring_size=self.mesh.shape[self.seq_axis],
+            )
         elif self.attention_impl in ("ring", "ulysses"):
             from hops_tpu.parallel import ringattention
 

@@ -105,8 +105,18 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def pvary(x: Any, axes: Sequence[str | None]) -> Any:
     """Mark a broadcast constant as device-varying on ``axes`` (shard_map
     loop-carry typing); shared by the ring-attention and pipeline
-    collectives."""
+    collectives. Axes the value already varies over are skipped —
+    ``pcast`` rejects mixed invarying/varying requests (e.g. zeros_like
+    of a seq-sharded activation is already seq-varying and only needs
+    the stage axis added)."""
     axes = tuple(a for a in axes if a is not None)
+    try:
+        current = jax.typeof(x).vma
+    except (AttributeError, TypeError):
+        current = frozenset()
+    axes = tuple(a for a in axes if a not in current)
+    if not axes:
+        return x
     if hasattr(jax.lax, "pcast"):  # current API; pvary is its deprecated alias
         return jax.lax.pcast(x, axes, to="varying")
     return jax.lax.pvary(x, axes)

@@ -63,6 +63,10 @@ def pipeline_apply(
     emit_fn: Callable[[Any, jax.Array], jax.Array] | None = None,
     emit_params: Any = None,
     stage_aux: bool = False,
+    x_spec: P | None = None,
+    out_spec: P | None = None,
+    param_specs: Any = None,
+    extra_vary: tuple[str, ...] = (),
 ) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run ``x`` through S pipelined stages; returns the final outputs.
 
@@ -79,6 +83,17 @@ def pipeline_apply(
     and are consumed sharded ``P(axis)``; ``x`` is ``(batch, ...)``,
     replicated over the stage axis, split into ``num_microbatches``
     (default S) equal microbatches.
+
+    Inner mesh axes compose through four knobs (used by
+    ``pipelined_lm_apply`` for sp/ep inside pp): ``x_spec``/``out_spec``
+    shard the input/output over an inner axis (e.g. ``P(None, "seq")``),
+    ``param_specs`` optionally shards stage-param leaves beyond
+    ``P(axis)`` (e.g. expert stacks over ``"expert"``), and
+    ``extra_vary`` names inner axes the carried activations are
+    device-varying over (sequence shards vary; an ep stage's psum'd
+    activations do not). The stage_fn must then use named-axis
+    collectives for the inner axis (``ring_attention_local``,
+    ``MoEMLP(expert_axis=...)``).
 
     Heterogeneous models (embed → blocks → head) hang their non-shape-
     preserving ends on the ring boundary:
@@ -112,8 +127,9 @@ def pipeline_apply(
         # Carries start as broadcast constants; mark them device-varying
         # on the stage axis so the fori_loop carry types stay stable.
         h0 = ingest(ingest_p, micro[0])
-        buf = _pvary(jnp.zeros_like(h0), (axis,))
-        outputs = _pvary(jnp.zeros((m,) + h0.shape, h0.dtype), (axis,))
+        vary = (axis,) + extra_vary
+        buf = _pvary(jnp.zeros_like(h0), vary)
+        outputs = _pvary(jnp.zeros((m,) + h0.shape, h0.dtype), vary)
         aux_sum = _pvary(jnp.zeros((), jnp.float32), (axis,))
 
         def tick(t, carry):
@@ -155,16 +171,19 @@ def pipeline_apply(
             return out, jax.lax.psum(aux_sum, axis) / m
         return out
 
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis), stacked_params)
+    main_out = out_spec if out_spec is not None else P()
     return shard_map(
         local_fn,
         mesh=mesh,
         in_specs=(
-            P(axis),
+            param_specs,
             P() if has_params[0] else None,
             P() if has_params[1] else None,
-            P(),
+            x_spec if x_spec is not None else P(),
         ),
-        out_specs=(P(), P()) if stage_aux else P(),
+        out_specs=(main_out, P()) if stage_aux else main_out,
     )(stacked_params, ingest_params, emit_params, x)
 
 
@@ -177,6 +196,8 @@ def pipelined_lm_apply(
     axis: str = "stage",
     num_microbatches: int | None = None,
     return_aux: bool = False,
+    seq_axis: str | None = None,
+    expert_axis: str | None = None,
 ) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run a ``TransformerLM`` forward through the GPipe ring.
 
@@ -190,10 +211,21 @@ def pipelined_lm_apply(
     uniform (moe_every-1 dense + 1 MoE) groups. Semantic notes: MoE
     routing (expert capacity, token drops) is computed per microbatch —
     the batch a stage sees IS the microbatch, as in any GPipe x MoE
-    system — so whole-batch parity is exact only for drop-free routing;
-    expert weights run REPLICATED within each stage (an ``expert`` mesh
-    axis inside pp stages is not composed yet — use
-    ``models.moe.expert_specs`` on a flat mesh for true ep).
+    system — so whole-batch parity is exact only for drop-free routing.
+
+    Inner parallelism composes (round 3):
+
+    - ``seq_axis``: sequence parallelism INSIDE each pipeline stage —
+      tokens/logits shard ``P(None, seq_axis)`` and attention runs the
+      ring-attention body over that axis (``ring_attention_local``),
+      so pp bounds layer memory while sp bounds activation memory for
+      long sequences. Dense models only (MoE routing under a sharded
+      sequence would change drop semantics — use ``expert_axis``).
+    - ``expert_axis``: expert parallelism INSIDE each pipeline stage —
+      ``w_in``/``w_out`` stacks shard over the axis, each device runs
+      its local experts and a per-layer ``psum`` combines
+      (``MoEMLP(expert_axis=...)``); routing/capacity math is
+      unchanged, so logits still match the dense apply exactly.
 
     ``return_aux=True`` returns ``(logits, aux)`` where ``aux`` is the
     sown load-balancing loss accumulated through the ring (mean over
@@ -204,12 +236,22 @@ def pipelined_lm_apply(
     from hops_tpu.models.transformer import Block, RMSNorm
     from flax import linen as nn
 
+    if seq_axis and model.moe_every:
+        raise NotImplementedError(
+            "seq_axis inside pp is supported for dense LMs; MoE models "
+            "compose pp with expert_axis instead (per-microbatch routing "
+            "over a sharded sequence would change drop semantics)"
+        )
+    if expert_axis and not model.moe_every:
+        raise ValueError("expert_axis requires a MoE model (moe_every > 0)")
+
     n_stages = mesh.shape[axis]
     block = Block(
         model.num_heads,
         dtype=model.dtype,
-        attention_impl=model.attention_impl,
-        mesh=None,  # sp inside pp stages would need a second mesh axis
+        attention_impl="ring_local" if seq_axis else model.attention_impl,
+        mesh=mesh if seq_axis else None,
+        seq_axis=seq_axis or "seq",
         dropout_rate=0.0,
     )
     embed = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
@@ -236,6 +278,8 @@ def pipelined_lm_apply(
             attention_impl=model.attention_impl,
             mesh=None,
             dropout_rate=0.0,
+            expert_axis=expert_axis,
+            expert_shards=mesh.shape[expert_axis] if expert_axis else 1,
         )
         groups = []
         for start in range(0, model.num_layers, g):
@@ -287,6 +331,20 @@ def pipelined_lm_apply(
         )
         return logits.astype(jnp.float32)
 
+    param_specs = None
+    if expert_axis:
+        # Expert stacks shard over the inner axis on top of the stage
+        # dim: (S, K, E, dm, hidden) -> P(stage, None, expert). All
+        # other stage params stay stage-sharded only (replicated over
+        # the expert axis).
+        def leaf_spec(path, _):
+            name = str(path[-1].key) if hasattr(path[-1], "key") else ""
+            if name in ("w_in", "w_out"):
+                return P(axis, None, expert_axis)
+            return P(axis)
+
+        param_specs = jax.tree_util.tree_map_with_path(leaf_spec, stacked)
+
     logits, aux = pipeline_apply(
         stage_fn,
         stacked,
@@ -299,5 +357,9 @@ def pipelined_lm_apply(
         emit_fn=emit_fn,
         emit_params={"final_norm": params["final_norm"], "unembed": params["unembed"]},
         stage_aux=True,
+        x_spec=P(None, seq_axis) if seq_axis else None,
+        out_spec=P(None, seq_axis) if seq_axis else None,
+        param_specs=param_specs,
+        extra_vary=(seq_axis,) if seq_axis else (),
     )
     return (logits, aux) if return_aux else logits

@@ -65,6 +65,67 @@ def _fold(carry, s, v):
     return m_new, l, acc
 
 
+def ring_attention_local(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str = "seq",
+    batch_axis: str | None = None,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    ring_size: int,
+) -> jax.Array:
+    """The per-device body of ring attention, for use under an
+    ENCLOSING ``shard_map`` that carries a ``axis``-named mesh axis
+    (e.g. sequence parallelism inside a pipeline stage —
+    ``pipeline.pipelined_lm_apply(seq_axis=...)``). ``q``/``k``/``v``
+    are the local ``(batch, heads, seq/ring_size, d)`` shards; only
+    named-axis collectives (``ppermute``/``axis_index``) are used, so
+    it composes with any outer axes.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    n = ring_size
+    seq_local = q.shape[2]
+    my_idx = jax.lax.axis_index(axis)
+    q32 = q.astype(jnp.float32)
+    bh_shape = q.shape[:2] + (q.shape[2],)
+    # The accumulators start as broadcast constants; mark them as
+    # device-varying on the ring (and data, if combined) axes so the
+    # fori_loop carry types match its (varying) outputs under
+    # shard_map. Under an ENCLOSING shard_map (sp inside pp) q also
+    # varies over ambient axes (e.g. "stage") which the step outputs
+    # inherit — the carries must start varying over those too.
+    try:
+        ambient = tuple(jax.typeof(q).vma)
+    except (AttributeError, TypeError):
+        ambient = ()
+    vary = (axis, batch_axis) + ambient
+    m0 = _pvary(jnp.full(bh_shape, NEG_INF, jnp.float32), vary)
+    l0 = _pvary(jnp.zeros(bh_shape, jnp.float32), vary)
+    acc0 = _pvary(jnp.zeros(q.shape, jnp.float32), vary)
+    q_offset = my_idx * seq_local
+
+    def step(t, carry):
+        m, l, acc, k_cur, v_cur = carry
+        src_idx = (my_idx - t) % n
+        s = _local_scores(q32, k_cur, sm_scale, q_offset, src_idx * seq_local, causal)
+        m, l, acc = _fold((m, l, acc), s, v_cur)
+        # Rotate K/V one hop (device i sends to i+1) so that at
+        # step t every device holds the chunk that originated at
+        # (my_idx - t) mod n. The permute overlaps the next step's
+        # compute under XLA's async collectives.
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+        return m, l, acc, k_nxt, v_nxt
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l_safe[..., None]).astype(q.dtype)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -81,48 +142,19 @@ def ring_attention(
     Inputs/outputs are sharded ``P(batch_axis, None, axis, None)`` on
     ``mesh`` (``batch_axis`` combines data parallelism with the ring);
     internally K/V rotate via ``ppermute`` so every device sees every
-    chunk with only neighbor-to-neighbor ICI traffic.
+    chunk with only neighbor-to-neighbor ICI traffic. The per-device
+    body is :func:`ring_attention_local`, reusable under an enclosing
+    ``shard_map``.
     """
-    if sm_scale is None:
-        sm_scale = 1.0 / math.sqrt(q.shape[-1])
     n = mesh.shape[axis]
-    seq_local = q.shape[2] // n
-
-    def local_fn(q, k, v):
-        my_idx = jax.lax.axis_index(axis)
-        q32 = q.astype(jnp.float32)
-        bh_shape = q.shape[:2] + (q.shape[2],)
-        # The accumulators start as broadcast constants; mark them as
-        # device-varying on the ring (and data, if combined) axes so the
-        # fori_loop carry types match its (varying) outputs under
-        # shard_map.
-        vary = (axis, batch_axis)
-        m0 = _pvary(jnp.full(bh_shape, NEG_INF, jnp.float32), vary)
-        l0 = _pvary(jnp.zeros(bh_shape, jnp.float32), vary)
-        acc0 = _pvary(jnp.zeros(q.shape, jnp.float32), vary)
-        q_offset = my_idx * seq_local
-
-        def step(t, carry):
-            m, l, acc, k_cur, v_cur = carry
-            src_idx = (my_idx - t) % n
-            s = _local_scores(q32, k_cur, sm_scale, q_offset, src_idx * seq_local, causal)
-            m, l, acc = _fold((m, l, acc), s, v_cur)
-            # Rotate K/V one hop (device i sends to i+1) so that at
-            # step t every device holds the chunk that originated at
-            # (my_idx - t) mod n. The permute overlaps the next step's
-            # compute under XLA's async collectives.
-            perm = [(i, (i + 1) % n) for i in range(n)]
-            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
-            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-            return m, l, acc, k_nxt, v_nxt
-
-        m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
-        l_safe = jnp.where(l == 0.0, 1.0, l)
-        return (acc / l_safe[..., None]).astype(q.dtype)
-
+    local = functools.partial(
+        ring_attention_local,
+        axis=axis, batch_axis=batch_axis, causal=causal,
+        sm_scale=sm_scale, ring_size=n,
+    )
     spec = P(batch_axis, None, axis, None)
     return shard_map(
-        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
     )(q, k, v)
 
 
