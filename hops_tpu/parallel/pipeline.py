@@ -363,3 +363,52 @@ def pipelined_lm_apply(
         extra_vary=(seq_axis,) if seq_axis else (),
     )
     return (logits, aux) if return_aux else logits
+
+
+def make_pp_lm_train_step(
+    model: Any,
+    mesh: Mesh,
+    *,
+    axis: str = "stage",
+    seq_axis: str | None = None,
+    expert_axis: str | None = None,
+    num_microbatches: int | None = None,
+    aux_loss_weight: float = 0.01,
+) -> Callable[[Any, dict[str, jax.Array]], tuple[Any, dict[str, jax.Array]]]:
+    """Pipelined next-token-prediction train step for a ``TransformerLM``.
+
+    Same ``step(state, batch) -> (state, metrics)`` contract as
+    ``models.transformer.make_lm_train_step`` (so the experiment
+    launchers accept it unchanged), but the forward/backward runs
+    through the GPipe ring — optionally with sp (``seq_axis``) or ep
+    (``expert_axis``) composed inside the stages. Gradients flow
+    through ``ppermute``/``psum`` back to the caller's dense param
+    tree; the optimizer update itself runs on that replicated tree
+    (stage-sharded optimizer state — true ZeRO-style pp memory for the
+    update — is flat-mesh ``ShardedStrategy`` territory).
+    """
+    import optax
+
+    def train_step(state, batch):
+        tokens = batch["tokens"]
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+        def compute_loss(params):
+            logits, aux = pipelined_lm_apply(
+                model, params, inputs, mesh,
+                axis=axis,
+                num_microbatches=num_microbatches,
+                return_aux=True,
+                seq_axis=seq_axis,
+                expert_axis=expert_axis,
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            ).mean()
+            return loss + aux_loss_weight * aux, loss
+
+        (_, loss), grads = jax.value_and_grad(compute_loss, has_aux=True)(state.params)
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss, "perplexity": jnp.exp(loss)}
+
+    return train_step
