@@ -20,7 +20,8 @@ import jax.numpy as jnp
 
 
 @functools.partial(
-    jax.jit, static_argnames=("model", "max_new_tokens", "top_k", "temperature")
+    jax.jit,
+    static_argnames=("model", "max_new_tokens", "top_k", "temperature", "eos_id", "pad_id"),
 )
 def generate(
     model: Any,
@@ -30,12 +31,19 @@ def generate(
     max_new_tokens: int = 32,
     temperature: float = 1.0,
     top_k: int | None = None,
+    eos_id: int | None = None,
+    pad_id: int = 0,
 ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` (b, L).
 
     ``temperature=0`` (or ``top_k=1``) is greedy decoding. Returns
     ``(b, L + max_new_tokens)`` token ids. ``model.max_decode_len`` must
-    cover the full final length.
+    cover the full final length — size it to the final length, not
+    "big enough": decode cost scales with cache capacity (BENCHMARKS.md
+    "KV-cached decoding"). With ``eos_id`` set, rows that have emitted
+    it produce ``pad_id`` from the next step on (shapes stay static —
+    the scan still runs ``max_new_tokens`` steps, the TPU-idiomatic
+    trade for per-row early exit).
     """
     b, prompt_len = prompt.shape
     if max_new_tokens < 1:
@@ -63,9 +71,12 @@ def generate(
 
     rng, key = jax.random.split(rng)
     first = sample(logits[:, -1], key)
+    done = (
+        first == eos_id if eos_id is not None else jnp.zeros((b,), jnp.bool_)
+    )
 
     def step(carry, _):
-        cache, tok, rng = carry
+        cache, tok, done, rng = carry
         rng, key = jax.random.split(rng)
         logits, variables = model.apply(
             {"params": params, "cache": cache},
@@ -74,10 +85,13 @@ def generate(
             mutable=["cache"],
         )
         nxt = sample(logits[:, -1], key)
-        return (variables["cache"], nxt, rng), nxt
+        if eos_id is not None:
+            nxt = jnp.where(done, pad_id, nxt)
+            done = done | (nxt == eos_id)
+        return (variables["cache"], nxt, done, rng), nxt
 
-    (_, _, _), rest = jax.lax.scan(
-        step, (cache, first, rng), None, length=max_new_tokens - 1
+    (_, _, _, _), rest = jax.lax.scan(
+        step, (cache, first, done, rng), None, length=max_new_tokens - 1
     )
     new_tokens = jnp.concatenate([first[None], rest], axis=0).T  # (b, new)
     return jnp.concatenate([prompt, new_tokens], axis=1)

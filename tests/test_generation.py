@@ -115,3 +115,45 @@ def test_long_prefill_kernel_path_matches_full_forward():
     )
     assert step_logits.shape == (1, 1, 64)
     assert bool(jnp.all(jnp.isfinite(step_logits)))
+
+
+def test_eos_masks_following_tokens_to_pad():
+    """Once a row emits eos_id, every later position is pad_id; rows
+    that never emit it are untouched (static shapes throughout)."""
+    model, params = _model_and_params()
+    prompt = jnp.asarray([[1, 2, 3, 4], [5, 6, 7, 8]], jnp.int32)
+
+    base = generate(
+        model, params, prompt, jax.random.PRNGKey(0),
+        max_new_tokens=12, temperature=0.0,
+    )
+    new = np.asarray(base[:, 4:])
+    # Pick an eos that the greedy run actually emits mid-stream for row 0.
+    eos = int(new[0, 3])
+    out = np.asarray(generate(
+        model, params, prompt, jax.random.PRNGKey(0),
+        max_new_tokens=12, temperature=0.0, eos_id=eos, pad_id=63,
+    ))
+    assert out.shape == base.shape
+    for r in range(2):
+        row = out[r, 4:]
+        hits = np.where(row == eos)[0]
+        if hits.size:
+            after = row[hits[0] + 1:]
+            assert (after == 63).all() or after.size == 0
+    # Row 0 emits eos at its first occurrence in the unmasked run, and
+    # everything after is pad.
+    first_hit = np.where(new[0] == eos)[0][0]
+    assert (out[0, 4 + first_hit + 1:] == 63).all()
+    # Prefix up to and including eos is unchanged by the masking.
+    np.testing.assert_array_equal(out[0, :4 + first_hit + 1], base[0, :4 + first_hit + 1])
+
+
+def test_eos_none_keeps_previous_behavior():
+    model, params = _model_and_params()
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    a = generate(model, params, prompt, jax.random.PRNGKey(0),
+                 max_new_tokens=6, temperature=0.0)
+    b = generate(model, params, prompt, jax.random.PRNGKey(0),
+                 max_new_tokens=6, temperature=0.0, eos_id=None)
+    np.testing.assert_array_equal(a, b)
