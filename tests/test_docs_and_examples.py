@@ -105,3 +105,16 @@ def test_pi_job_with_staged_workspace(tmp_path):
     done = jobs.wait_for_completion("pi_job", ex.execution_id, timeout_s=120)
     assert done.state == "FINISHED", done.stdout()
     assert "pi is roughly 3.1" in done.stdout()
+
+
+def test_lm_generation_serving():
+    """The framework's own model family behind the serving lifecycle:
+    export a trained TransformerLM, serve it through the Python
+    predictor, and the generated continuation follows the training
+    pattern (greedy decode over the learned cycle)."""
+    from examples import lm_serving
+
+    result = lm_serving.main()
+    assert result["accuracy"] > 0.9
+    expected = [lm_serving.CYCLE[(4 + i) % len(lm_serving.CYCLE)] for i in range(8)]
+    assert result["continuation"][:8] == expected
