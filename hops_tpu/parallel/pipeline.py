@@ -114,8 +114,19 @@ def pipeline_apply(
     n_stages = mesh.shape[axis]
     m = num_microbatches or n_stages
     batch = x.shape[0]
-    if batch % m:
-        raise ValueError(f"batch {batch} not divisible by {m} microbatches")
+    # x_spec may shard the batch dim (dp outside pp): each data
+    # coordinate runs its own m-microbatch ring over its local shard.
+    batch_axes: tuple[str, ...] = ()
+    if x_spec is not None and len(x_spec) and x_spec[0] is not None:
+        batch_axes = x_spec[0] if isinstance(x_spec[0], tuple) else (x_spec[0],)
+    n_data = 1
+    for name in batch_axes:
+        n_data *= mesh.shape[name]
+    if batch % (m * n_data):
+        raise ValueError(
+            f"batch {batch} not divisible by {m} microbatches x {n_data} "
+            f"batch shards"
+        )
     ingest = ingest_fn or (lambda _, v: v)
     has_params = (ingest_params is not None, emit_params is not None)
 
@@ -123,7 +134,10 @@ def pipeline_apply(
         # params leaves arrive as (1, ...) slices of the stage stack.
         params = jax.tree.map(lambda p: p[0], params)
         s = jax.lax.axis_index(axis)
-        micro = x.reshape(m, batch // m, *x.shape[1:])
+        # Under a data-sharded x_spec this is the LOCAL batch shard;
+        # each data coordinate runs its own m-microbatch ring.
+        lb = x.shape[0]
+        micro = x.reshape(m, lb // m, *x.shape[1:])
         # Carries start as broadcast constants; mark them device-varying
         # on the stage axis so the fori_loop carry types stay stable.
         h0 = ingest(ingest_p, micro[0])
@@ -165,10 +179,16 @@ def pipeline_apply(
         outputs = jax.lax.psum(
             jnp.where(s == n_stages - 1, outputs, jnp.zeros_like(outputs)), axis
         )
-        outputs = outputs.reshape(batch, *h0.shape[1:])
+        outputs = outputs.reshape(lb, *h0.shape[1:])
         out = emit_fn(emit_p, outputs) if emit_fn else outputs
         if stage_aux:
-            return out, jax.lax.psum(aux_sum, axis) / m
+            # Sum over stages; under dp also average the per-data-shard
+            # aux (it's a mean-style loss) so the scalar comes back
+            # replicated everywhere.
+            aux = jax.lax.psum(aux_sum, axis) / m
+            if batch_axes:
+                aux = jax.lax.psum(aux, batch_axes) / n_data
+            return out, aux
         return out
 
     if param_specs is None:
@@ -198,6 +218,7 @@ def pipelined_lm_apply(
     return_aux: bool = False,
     seq_axis: str | None = None,
     expert_axis: str | None = None,
+    batch_axis: str | None = None,
 ) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run a ``TransformerLM`` forward through the GPipe ring.
 
@@ -226,6 +247,11 @@ def pipelined_lm_apply(
       its local experts and a per-layer ``psum`` combines
       (``MoEMLP(expert_axis=...)``); routing/capacity math is
       unchanged, so logits still match the dense apply exactly.
+    - ``batch_axis``: data parallelism OUTSIDE the ring — tokens and
+      logits shard ``P(batch_axis, ...)`` and every data coordinate
+      runs its own microbatch ring; gradient summation over the data
+      axis falls out of shard_map's transpose of the replicated
+      params. Composes with either inner axis (dp x pp x sp/ep).
 
     ``return_aux=True`` returns ``(logits, aux)`` where ``aux`` is the
     sown load-balancing loss accumulated through the ring (mean over
@@ -252,6 +278,7 @@ def pipelined_lm_apply(
         attention_impl="ring_local" if seq_axis else model.attention_impl,
         mesh=mesh if seq_axis else None,
         seq_axis=seq_axis or "seq",
+        batch_axis=batch_axis,
         dropout_rate=0.0,
     )
     embed = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
@@ -357,10 +384,10 @@ def pipelined_lm_apply(
         emit_fn=emit_fn,
         emit_params={"final_norm": params["final_norm"], "unembed": params["unembed"]},
         stage_aux=True,
-        x_spec=P(None, seq_axis) if seq_axis else None,
-        out_spec=P(None, seq_axis) if seq_axis else None,
+        x_spec=P(batch_axis, seq_axis) if (seq_axis or batch_axis) else None,
+        out_spec=P(batch_axis, seq_axis) if (seq_axis or batch_axis) else None,
         param_specs=param_specs,
-        extra_vary=(seq_axis,) if seq_axis else (),
+        extra_vary=tuple(a for a in (batch_axis, seq_axis) if a),
     )
     return (logits, aux) if return_aux else logits
 
@@ -372,6 +399,7 @@ def make_pp_lm_train_step(
     axis: str = "stage",
     seq_axis: str | None = None,
     expert_axis: str | None = None,
+    batch_axis: str | None = None,
     num_microbatches: int | None = None,
     aux_loss_weight: float = 0.01,
 ) -> Callable[[Any, dict[str, jax.Array]], tuple[Any, dict[str, jax.Array]]]:
@@ -401,6 +429,7 @@ def make_pp_lm_train_step(
                 return_aux=True,
                 seq_axis=seq_axis,
                 expert_axis=expert_axis,
+                batch_axis=batch_axis,
             )
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets
