@@ -61,18 +61,29 @@ class RMSNorm(nn.Module):
 class Attention(nn.Module):
     num_heads: int
     dtype: Any = jnp.bfloat16
-    attention_impl: str = "flash"  # flash | reference | ring | ulysses
+    attention_impl: str = "flash"  # flash | reference | ring | ulysses | ring_local
     mesh: Any = None
     seq_axis: str = "seq"
     batch_axis: Any = None  # data axis name when dp combines with sp
     max_decode_len: int = 2048  # KV-cache capacity in decode mode
+    # Megatron tensor parallelism under an ENCLOSING shard_map (tp
+    # inside pp stages): params hold num_heads/tp_shards heads, each
+    # device attends its local heads, and the out-projection's partial
+    # sums combine with one psum over tp_axis.
+    tp_axis: str | None = None
+    tp_shards: int = 1
 
     @nn.compact
     def __call__(self, x, decode: bool = False):
         b, s, dm = x.shape
+        if self.num_heads % self.tp_shards:
+            raise ValueError(
+                f"{self.num_heads} heads not divisible by tp_shards={self.tp_shards}"
+            )
+        heads = self.num_heads // self.tp_shards
         head_dim = dm // self.num_heads
         qkv = nn.DenseGeneral(
-            (3, self.num_heads, head_dim), dtype=self.dtype, name="qkv", use_bias=False
+            (3, heads, head_dim), dtype=self.dtype, name="qkv", use_bias=False
         )(x)
         q, k, v = [jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3)]  # (b, h, s, d)
 
@@ -116,8 +127,16 @@ class Attention(nn.Module):
         else:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
 
-        o = jnp.moveaxis(o, 1, 2).reshape(b, s, dm)
-        return nn.DenseGeneral(dm, dtype=self.dtype, name="out", use_bias=False)(o)
+        return self._project_out(o, b, s, dm)
+
+    def _project_out(self, o, b, s, dm):
+        """(b, h_local, s, d) -> out projection; under tp the local
+        heads produce a partial sum combined by one psum."""
+        o = jnp.moveaxis(o, 1, 2).reshape(b, s, -1)
+        o = nn.DenseGeneral(dm, dtype=self.dtype, name="out", use_bias=False)(o)
+        if self.tp_axis is not None:
+            o = jax.lax.psum(o, self.tp_axis)
+        return o
 
     def _decode_attend(self, q, k, v, b, s, dm, head_dim):
         """Autoregressive attention against a fixed-capacity KV cache.
@@ -137,7 +156,7 @@ class Attention(nn.Module):
         for every decode step.
         """
         fresh_cache = not self.has_variable("cache", "k")
-        cache_shape = (b, self.num_heads, self.max_decode_len, head_dim)
+        cache_shape = (b, q.shape[1], self.max_decode_len, head_dim)
         ck = self.variable("cache", "k", jnp.zeros, cache_shape, self.dtype)
         cv = self.variable("cache", "v", jnp.zeros, cache_shape, self.dtype)
         idx = self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
@@ -162,26 +181,41 @@ class Attention(nn.Module):
             # was 85% of decode step time (BENCHMARKS.md "KV-cached
             # decoding").
             o = decode_attention(q, ck.value, cv.value, idx.value)
-        o = jnp.moveaxis(o, 1, 2).reshape(b, s, dm)
-        return nn.DenseGeneral(dm, dtype=self.dtype, name="out", use_bias=False)(o)
+        return self._project_out(o, b, s, dm)
 
 
 class MLP(nn.Module):
-    """SwiGLU: two fused up-projections + gated down-projection."""
+    """SwiGLU: two fused up-projections + gated down-projection.
+
+    ``tp_axis``/``tp_shards``: Megatron split under an enclosing
+    shard_map — gate/up are column-sharded (each device holds
+    hidden/tp_shards columns), down is row-sharded, and one psum
+    combines the partial down-projections.
+    """
 
     hidden_mult: int = 4
     dtype: Any = jnp.bfloat16
+    tp_axis: str | None = None
+    tp_shards: int = 1
 
     @nn.compact
     def __call__(self, x):
         dm = x.shape[-1]
         hidden = int(dm * self.hidden_mult * 2 / 3)
         hidden = max(128, (hidden // 128) * 128)  # MXU-aligned
+        if hidden % self.tp_shards:
+            raise ValueError(
+                f"hidden {hidden} not divisible by tp_shards={self.tp_shards}"
+            )
+        hidden //= self.tp_shards
         gate = nn.Dense(hidden, dtype=self.dtype, use_bias=False, name="gate")(x)
         up = nn.Dense(hidden, dtype=self.dtype, use_bias=False, name="up")(x)
-        return nn.Dense(dm, dtype=self.dtype, use_bias=False, name="down")(
+        out = nn.Dense(dm, dtype=self.dtype, use_bias=False, name="down")(
             nn.silu(gate) * up
         )
+        if self.tp_axis is not None:
+            out = jax.lax.psum(out, self.tp_axis)
+        return out
 
 
 class Block(nn.Module):
@@ -193,6 +227,8 @@ class Block(nn.Module):
     batch_axis: Any = None
     dropout_rate: float = 0.0
     max_decode_len: int = 2048
+    tp_axis: str | None = None
+    tp_shards: int = 1
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -204,12 +240,19 @@ class Block(nn.Module):
             seq_axis=self.seq_axis,
             batch_axis=self.batch_axis,
             max_decode_len=self.max_decode_len,
+            tp_axis=self.tp_axis,
+            tp_shards=self.tp_shards,
             name="attn",
         )(RMSNorm(dtype=self.dtype)(x), decode=decode)
         if self.dropout_rate:
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         x = x + h
-        h = MLP(dtype=self.dtype, name="mlp")(RMSNorm(dtype=self.dtype)(x))
+        h = MLP(
+            dtype=self.dtype,
+            tp_axis=self.tp_axis,
+            tp_shards=self.tp_shards,
+            name="mlp",
+        )(RMSNorm(dtype=self.dtype)(x))
         if self.dropout_rate:
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         return x + h

@@ -144,7 +144,9 @@ def pipeline_apply(
         vary = (axis,) + extra_vary
         buf = _pvary(jnp.zeros_like(h0), vary)
         outputs = _pvary(jnp.zeros((m,) + h0.shape, h0.dtype), vary)
-        aux_sum = _pvary(jnp.zeros((), jnp.float32), (axis,))
+        # Per-stage aux derives from data-sharded activations under dp,
+        # so its carry must vary over the batch axes too.
+        aux_sum = _pvary(jnp.zeros((), jnp.float32), (axis,) + batch_axes)
 
         def tick(t, carry):
             buf, outputs, aux_sum = carry
@@ -219,6 +221,7 @@ def pipelined_lm_apply(
     seq_axis: str | None = None,
     expert_axis: str | None = None,
     batch_axis: str | None = None,
+    tp_axis: str | None = None,
 ) -> jax.Array | tuple[jax.Array, jax.Array]:
     """Run a ``TransformerLM`` forward through the GPipe ring.
 
@@ -247,6 +250,11 @@ def pipelined_lm_apply(
       its local experts and a per-layer ``psum`` combines
       (``MoEMLP(expert_axis=...)``); routing/capacity math is
       unchanged, so logits still match the dense apply exactly.
+    - ``tp_axis``: Megatron tensor parallelism INSIDE each pipeline
+      stage — qkv/gate/up kernels column-shard (local heads / local
+      hidden columns), out/down kernels row-shard, and one psum per
+      projection combines the partials (``Attention``/``MLP``
+      ``tp_axis``/``tp_shards``). Dense models only for now.
     - ``batch_axis``: data parallelism OUTSIDE the ring — tokens and
       logits shard ``P(batch_axis, ...)`` and every data coordinate
       runs its own microbatch ring; gradient summation over the data
@@ -270,6 +278,11 @@ def pipelined_lm_apply(
         )
     if expert_axis and not model.moe_every:
         raise ValueError("expert_axis requires a MoE model (moe_every > 0)")
+    if tp_axis and model.moe_every:
+        raise NotImplementedError(
+            "tp_axis inside pp is supported for dense LMs; MoE models "
+            "compose pp with expert_axis instead"
+        )
 
     n_stages = mesh.shape[axis]
     block = Block(
@@ -280,6 +293,8 @@ def pipelined_lm_apply(
         seq_axis=seq_axis or "seq",
         batch_axis=batch_axis,
         dropout_rate=0.0,
+        tp_axis=tp_axis,
+        tp_shards=mesh.shape[tp_axis] if tp_axis else 1,
     )
     embed = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
     norm = RMSNorm(dtype=model.dtype)
@@ -333,7 +348,10 @@ def pipelined_lm_apply(
                 aux = aux + sum_sown_losses(mods)
                 return (h, aux), None
 
-            aux0 = _pvary(jnp.zeros((), jnp.float32), (axis,))
+            # Under dp the sown aux derives from data-sharded
+            # activations — seed the scan carry varying over that axis
+            # too or the carry types won't match.
+            aux0 = _pvary(jnp.zeros((), jnp.float32), (axis, batch_axis))
             (h, aux), _ = jax.lax.scan(group_body, (h, aux0), stage_params)
             return h, aux
 
@@ -347,7 +365,7 @@ def pipelined_lm_apply(
                 return block.apply({"params": layer_params}, h), None
 
             h, _ = jax.lax.scan(body, h, stage_params)
-            return h, _pvary(jnp.zeros((), jnp.float32), (axis,))
+            return h, _pvary(jnp.zeros((), jnp.float32), (axis, batch_axis))
 
     def ingest_fn(p, micro_tokens):
         return embed.apply({"params": p}, micro_tokens)
@@ -359,6 +377,27 @@ def pipelined_lm_apply(
         return logits.astype(jnp.float32)
 
     param_specs = None
+    if tp_axis:
+        # Megatron leaf shardings on top of the stage dim. Stacked
+        # leaves are (S, K, *param.shape): qkv (S,K,dm,3,H,hd) shards
+        # heads; attn-out (S,K,dm,dm) and mlp-down (S,K,hidden,dm)
+        # shard input rows; gate/up (S,K,dm,hidden) shard output
+        # columns. Everything else stays stage-sharded (replicated
+        # over tp).
+        def tp_leaf_spec(path, _):
+            names = [str(k.key) for k in path if hasattr(k, "key")]
+            leaf = names[-1] if names else ""
+            if "qkv" in names and leaf == "kernel":
+                return P(axis, None, None, None, tp_axis, None)
+            if "out" in names and leaf == "kernel":
+                return P(axis, None, tp_axis, None)
+            if leaf == "kernel" and ("gate" in names or "up" in names):
+                return P(axis, None, None, tp_axis)
+            if "down" in names and leaf == "kernel":
+                return P(axis, None, tp_axis, None)
+            return P(axis)
+
+        param_specs = jax.tree_util.tree_map_with_path(tp_leaf_spec, stacked)
     if expert_axis:
         # Expert stacks shard over the inner axis on top of the stage
         # dim: (S, K, E, dm, hidden) -> P(stage, None, expert). All
@@ -400,6 +439,7 @@ def make_pp_lm_train_step(
     seq_axis: str | None = None,
     expert_axis: str | None = None,
     batch_axis: str | None = None,
+    tp_axis: str | None = None,
     num_microbatches: int | None = None,
     aux_loss_weight: float = 0.01,
 ) -> Callable[[Any, dict[str, jax.Array]], tuple[Any, dict[str, jax.Array]]]:
@@ -430,6 +470,7 @@ def make_pp_lm_train_step(
                 seq_axis=seq_axis,
                 expert_axis=expert_axis,
                 batch_axis=batch_axis,
+                tp_axis=tp_axis,
             )
             loss = optax.softmax_cross_entropy_with_integer_labels(
                 logits, targets
