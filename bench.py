@@ -139,6 +139,13 @@ def main() -> None:
     )
     args = parser.parse_args()
 
+    if args.smoke:
+        # The smoke run is documented CPU-safe; pin it there so it
+        # never touches (or waits on) the single-tenant TPU relay.
+        # Env alone is not enough when a sitecustomize pre-imported
+        # jax — same trick as tests/conftest.py.
+        jax.config.update("jax_platforms", "cpu")
+
     result = run_bench(
         per_chip_batch=args.batch,
         steps=args.steps,
