@@ -95,3 +95,155 @@ def generate(
     )
     new_tokens = jnp.concatenate([first[None], rest], axis=0).T  # (b, new)
     return jnp.concatenate([prompt, new_tokens], axis=1)
+
+
+def _rewind(cache: Any, valid: jax.Array) -> Any:
+    """Set every layer's cache index to ``valid``. The k/v slots past
+    it keep stale data — decode_attention masks them out (tested:
+    test_decode_attention_ignores_garbage_past_valid_len), so a
+    rejection rollback is one scalar write per layer."""
+    import jax.tree_util as jtu
+
+    hits = 0
+
+    def fix(path, leaf):
+        nonlocal hits
+        name = str(path[-1].key) if hasattr(path[-1], "key") else ""
+        if name != "idx":
+            return leaf
+        hits += 1
+        return jnp.asarray(valid, leaf.dtype)
+
+    out = jtu.tree_map_with_path(fix, cache)
+    if not hits:
+        # A silent no-op here would emit non-greedy garbage; fail loud.
+        raise ValueError(
+            "cache has no 'idx' leaves to rewind — generate_speculative "
+            "requires the transformer KV-cache layout (transformer.py "
+            "_decode_attend)"
+        )
+    return out
+
+
+@functools.partial(
+    jax.jit, static_argnames=("model", "draft_model", "max_new_tokens", "k")
+)
+def generate_speculative(
+    model: Any,
+    params: Any,
+    draft_model: Any,
+    draft_params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int = 32,
+    k: int = 4,
+) -> jax.Array:
+    """Greedy speculative decoding: ``draft_model`` proposes ``k - 1``
+    tokens autoregressively, ``model`` scores the whole chunk in ONE
+    warm-cache append (the ``decode_attention`` s>1 path), and the
+    longest matching prefix plus the target's own next token are
+    accepted — each target pass yields 1..k tokens while the output is
+    EXACTLY the target's greedy decoding
+    (tests/test_generation.py::test_speculative_matches_greedy).
+
+    TPU-shaped throughout: the accept count is data-dependent, so the
+    loop is a ``lax.while_loop`` over static-shape state — both KV
+    caches ride the carry, and a rejection "rollback" is one scalar
+    index rewind per layer (stale slots stay in HBM, masked by the
+    kernel). Acceptance is the minimum across batch rows (a scalar
+    cache index serves the whole batch). Both models must share the
+    tokenizer/vocab; ``max_decode_len`` of each must cover the final
+    length (+k slack for the target).
+    """
+    b, prompt_len = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if k < 2:
+        raise ValueError(f"speculation depth k must be >= 2, got {k}")
+    total = prompt_len + max_new_tokens
+    if total + k > model.max_decode_len or total + k > draft_model.max_decode_len:
+        raise ValueError(
+            f"prompt {prompt_len} + {max_new_tokens} new tokens (+{k} "
+            f"speculation slack) exceeds a max_decode_len "
+            f"({model.max_decode_len}, {draft_model.max_decode_len})"
+        )
+
+    # Prefill both caches on the prompt; invariant from here on: each
+    # cache holds tokens[0 .. its idx - 1] and `cur` is the last known
+    # token, not yet written.
+    _, t_vars = model.apply(
+        {"params": params}, prompt, decode=True, mutable=["cache"]
+    )
+    _, d_vars = draft_model.apply(
+        {"params": draft_params}, prompt, decode=True, mutable=["cache"]
+    )
+    t_cache, d_cache = t_vars["cache"], d_vars["cache"]
+    # Caches hold 0..prompt_len-1; rewind to prompt_len-1 so `cur` (the
+    # prompt's last token) is the not-yet-written one.
+    t_cache = _rewind(t_cache, prompt_len - 1)
+    d_cache = _rewind(d_cache, prompt_len - 1)
+    cur = prompt[:, -1]
+
+    out = jnp.zeros((b, total + k), prompt.dtype)
+    out = jax.lax.dynamic_update_slice(out, prompt, (0, 0))
+    # n = number of tokens known beyond the prompt (cur is out[:, pos-1]
+    # where pos = prompt_len + n).
+    n0 = jnp.zeros((), jnp.int32)
+
+    def draft_step(carry, _):
+        cache, tok = carry
+        logits, variables = draft_model.apply(
+            {"params": draft_params, "cache": cache},
+            tok[:, None],
+            decode=True,
+            mutable=["cache"],
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+        return (variables["cache"], nxt), nxt
+
+    def round_(state):
+        out, n, cur, t_cache, d_cache = state
+        # 1) draft proposes d_1..d_{k-1}. The scan runs k steps: the
+        #    k-th step's proposal is discarded, but running it WRITES
+        #    d_{k-1} into the draft cache — needed when all k-1
+        #    proposals are accepted and the next round starts after
+        #    them.
+        (d_cache, _), drafts = jax.lax.scan(draft_step, (d_cache, cur), None, length=k)
+        drafts = jnp.moveaxis(drafts, 0, 1)[:, : k - 1]  # (b, k-1)
+        # 2) target scores the whole chunk [cur, d_1..d_{k-1}] in one
+        #    warm append of k tokens; every logit row is usable (row i
+        #    predicts position pos+i, the last being the bonus slot).
+        chunk = jnp.concatenate([cur[:, None], drafts], axis=1)  # (b, k)
+        logits, t_vars = model.apply(
+            {"params": params, "cache": t_cache}, chunk, decode=True, mutable=["cache"]
+        )
+        t_cache = t_vars["cache"]
+        preds = jnp.argmax(logits, axis=-1).astype(prompt.dtype)  # (b, k)
+        # 3) longest prefix where the draft agrees with the target,
+        #    uniform across the batch (scalar cache index): a in
+        #    [0, k-1].
+        match = drafts == preds[:, : k - 1]  # d_{i+1} vs target pred i
+        a_rows = jnp.argmin(
+            jnp.concatenate([match, jnp.zeros((b, 1), bool)], axis=1), axis=1
+        )
+        a = jnp.min(a_rows).astype(jnp.int32)
+        bonus = preds[:, a]
+        # 4) emit d_1..d_a then the bonus: write all k candidates
+        #    (static shape) — positions past a+1 are garbage that the
+        #    next round overwrites — then splice the bonus at a.
+        emitted = jnp.concatenate([drafts, jnp.zeros((b, 1), prompt.dtype)], axis=1)
+        emitted = jax.lax.dynamic_update_slice(
+            emitted, bonus[:, None], (jnp.zeros((), jnp.int32), a)
+        )
+        pos = prompt_len + n
+        out = jax.lax.dynamic_update_slice(out, emitted, (jnp.zeros((), jnp.int32), pos))
+        # 5) advance: caches hold 0..pos+a-1 (rewind the target's k and
+        #    the draft's k-1 writes back to the accepted prefix).
+        t_cache = _rewind(t_cache, pos + a)
+        d_cache = _rewind(d_cache, pos + a)
+        return out, n + a + 1, bonus, t_cache, d_cache
+
+    def cond(state):
+        return state[1] < max_new_tokens
+
+    out, n, _, _, _ = jax.lax.while_loop(cond, round_, (out, n0, cur, t_cache, d_cache))
+    return out[:, :total]

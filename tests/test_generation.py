@@ -157,3 +157,57 @@ def test_eos_none_keeps_previous_behavior():
     b = generate(model, params, prompt, jax.random.PRNGKey(0),
                  max_new_tokens=6, temperature=0.0, eos_id=None)
     np.testing.assert_array_equal(a, b)
+
+
+def test_speculative_matches_greedy():
+    """Speculative decoding is lossless: with any draft model the
+    output equals the target's own greedy decoding, token for token."""
+    from hops_tpu.models.generation import generate_speculative
+
+    model, params = _model_and_params()
+    draft = TransformerLM(
+        vocab_size=64, d_model=16, num_heads=2, num_layers=1,
+        dtype=jnp.float32, attention_impl="reference", max_decode_len=64,
+    )
+    draft_params = draft.init(
+        jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompt = jnp.asarray([[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]], jnp.int32)
+
+    ref = generate(model, params, prompt, jax.random.PRNGKey(0),
+                   max_new_tokens=17, temperature=0.0)
+    for k in (2, 3, 4):
+        out = generate_speculative(
+            model, params, draft, draft_params, prompt,
+            max_new_tokens=17, k=k,
+        )
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_with_perfect_draft():
+    """Draft == target: every round accepts the cap (k-1 drafts +
+    bonus) and the output still matches greedy exactly."""
+    from hops_tpu.models.generation import generate_speculative
+
+    model, params = _model_and_params()
+    prompt = jnp.asarray([[7, 8, 9, 10]], jnp.int32)
+    ref = generate(model, params, prompt, jax.random.PRNGKey(0),
+                   max_new_tokens=12, temperature=0.0)
+    out = generate_speculative(
+        model, params, model, params, prompt, max_new_tokens=12, k=4,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_speculative_rejects_bad_args():
+    from hops_tpu.models.generation import generate_speculative
+
+    model, params = _model_and_params()
+    prompt = jnp.zeros((1, 60), jnp.int32)
+    with np.testing.assert_raises(ValueError):
+        generate_speculative(model, params, model, params, prompt,
+                             max_new_tokens=8, k=4)  # 60+8+4 > 64
+    with np.testing.assert_raises(ValueError):
+        generate_speculative(model, params, model, params,
+                             jnp.zeros((1, 4), jnp.int32),
+                             max_new_tokens=8, k=1)
