@@ -179,6 +179,7 @@ class MoEBlock(nn.Module):
     max_decode_len: int = 2048
     expert_axis: str | None = None
     expert_shards: int = 1
+    kv_cache_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -192,6 +193,7 @@ class MoEBlock(nn.Module):
             seq_axis=self.seq_axis,
             batch_axis=self.batch_axis,
             max_decode_len=self.max_decode_len,
+            kv_cache_dtype=self.kv_cache_dtype,
             name="attn",
         )(RMSNorm(dtype=self.dtype)(x), decode=decode)
         if self.dropout_rate:

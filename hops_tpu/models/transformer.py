@@ -30,7 +30,9 @@ from flax import linen as nn
 from hops_tpu.ops.attention import (
     attention_reference,
     decode_attention,
+    decode_attention_q8,
     flash_attention,
+    quantize_kv,
 )
 
 
@@ -72,6 +74,11 @@ class Attention(nn.Module):
     # sums combine with one psum over tp_axis.
     tp_axis: str | None = None
     tp_shards: int = 1
+    # "int8": the decode KV cache stores per-position-quantized int8
+    # values + fp32 scales and streams through the q8 kernel — half
+    # the HBM bytes of the (bandwidth-bound) decode step for <0.5%
+    # logit error (tests/test_generation.py).
+    kv_cache_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x, decode: bool = False):
@@ -155,24 +162,55 @@ class Attention(nn.Module):
         the validity mask applied as a bias), so jit sees one shape
         for every decode step.
         """
+        if self.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r} "
+                "(None or 'int8')"
+            )
         fresh_cache = not self.has_variable("cache", "k")
+        int8_cache = self.kv_cache_dtype == "int8"
+        store_dtype = jnp.int8 if int8_cache else self.dtype
         cache_shape = (b, q.shape[1], self.max_decode_len, head_dim)
-        ck = self.variable("cache", "k", jnp.zeros, cache_shape, self.dtype)
-        cv = self.variable("cache", "v", jnp.zeros, cache_shape, self.dtype)
+        ck = self.variable("cache", "k", jnp.zeros, cache_shape, store_dtype)
+        cv = self.variable("cache", "v", jnp.zeros, cache_shape, store_dtype)
+        if int8_cache:
+            cks = self.variable(
+                "cache", "k_scale", jnp.ones, cache_shape[:3], jnp.float32
+            )
+            cvs = self.variable(
+                "cache", "v_scale", jnp.ones, cache_shape[:3], jnp.float32
+            )
         idx = self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
         offset = idx.value
 
         pos = offset + jnp.arange(s)
         q = rotary_embedding(q, pos)
         k = rotary_embedding(k, pos)
-        ck.value = jax.lax.dynamic_update_slice(ck.value, k.astype(self.dtype), (0, 0, offset, 0))
-        cv.value = jax.lax.dynamic_update_slice(cv.value, v.astype(self.dtype), (0, 0, offset, 0))
+        if int8_cache:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            ck.value = jax.lax.dynamic_update_slice(ck.value, k_q, (0, 0, offset, 0))
+            cv.value = jax.lax.dynamic_update_slice(cv.value, v_q, (0, 0, offset, 0))
+            cks.value = jax.lax.dynamic_update_slice(cks.value, k_s, (0, 0, offset))
+            cvs.value = jax.lax.dynamic_update_slice(cvs.value, v_s, (0, 0, offset))
+        else:
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(self.dtype), (0, 0, offset, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(self.dtype), (0, 0, offset, 0)
+            )
         idx.value = offset + s
 
         if s > 1 and fresh_cache:
             # Prefill chunk on a fresh cache: nothing earlier to attend
-            # to, so the chunk's own k/v are the whole visible history.
+            # to, so the chunk's own (unquantized) k/v are the whole
+            # visible history.
             o = flash_attention(q, k, v, causal=True)
+        elif int8_cache:
+            o = decode_attention_q8(
+                q, ck.value, cv.value, cks.value, cvs.value, idx.value
+            ).astype(q.dtype)
         else:
             # Token steps (and warm-cache chunk appends) stream the
             # cache through the Pallas decode kernel — one
@@ -229,6 +267,7 @@ class Block(nn.Module):
     max_decode_len: int = 2048
     tp_axis: str | None = None
     tp_shards: int = 1
+    kv_cache_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -242,6 +281,7 @@ class Block(nn.Module):
             max_decode_len=self.max_decode_len,
             tp_axis=self.tp_axis,
             tp_shards=self.tp_shards,
+            kv_cache_dtype=self.kv_cache_dtype,
             name="attn",
         )(RMSNorm(dtype=self.dtype)(x), decode=decode)
         if self.dropout_rate:
@@ -276,6 +316,7 @@ class TransformerLM(nn.Module):
     num_experts: int = 8
     moe_top_k: int = 2
     max_decode_len: int = 2048
+    kv_cache_dtype: str | None = None  # "int8": quantized decode cache
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode: bool = False):
@@ -297,6 +338,7 @@ class TransformerLM(nn.Module):
                     batch_axis=self.batch_axis,
                     dropout_rate=self.dropout_rate,
                     max_decode_len=self.max_decode_len,
+                    kv_cache_dtype=self.kv_cache_dtype,
                     name=f"block_{i}",
                 )(x, train, decode)
                 continue
@@ -309,6 +351,7 @@ class TransformerLM(nn.Module):
                 batch_axis=self.batch_axis,
                 dropout_rate=self.dropout_rate,
                 max_decode_len=self.max_decode_len,
+                kv_cache_dtype=self.kv_cache_dtype,
                 name=f"block_{i}",
             )(x, train, decode)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)

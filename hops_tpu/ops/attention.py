@@ -82,13 +82,14 @@ def _causal_mask(s, qi, kj, block_q, block_k, q_offset):
 # ---------------------------------------------------------------------------
 
 
-def _online_softmax_update(sc, v_ref, m_scr, l_scr, acc_scr):
+def _online_softmax_update(sc, vb, m_scr, l_scr, acc_scr):
     """Fold one masked score block ``sc`` (fp32, -inf at masked entries)
-    into the running (m, l, acc) online-softmax scratch. The NEG_INF
-    guards keep fully-masked rows at l == 0 (finalize substitutes 1)
-    instead of NaN. Shared by the training forward kernel and the
-    decode kernel — this rescaling is the subtlest numerics in the
-    file and must exist exactly once."""
+    and its value tile ``vb`` into the running (m, l, acc)
+    online-softmax scratch. The NEG_INF guards keep fully-masked rows
+    at l == 0 (finalize substitutes 1) instead of NaN. Shared by the
+    training forward kernel and both decode kernels — this rescaling
+    is the subtlest numerics in the file and must exist exactly
+    once."""
     m = m_scr[:, :1]  # (rows, 1), broadcast across lanes
     l = l_scr[:, :1]
     m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
@@ -97,7 +98,7 @@ def _online_softmax_update(sc, v_ref, m_scr, l_scr, acc_scr):
     alpha = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - m_safe))
     l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
     pv = jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0],
+        p.astype(vb.dtype), vb,
         (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
     )
     acc_scr[...] = acc_scr[...] * alpha + pv
@@ -131,7 +132,7 @@ def _fwd_kernel(
         s = s * sm_scale
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, q_offset)
-        _online_softmax_update(s, v_ref, m_scr, l_scr, acc_scr)
+        _online_softmax_update(s, v_ref[0], m_scr, l_scr, acc_scr)
 
     @pl.when(kj == nk - 1)
     def _finalize():
@@ -507,7 +508,7 @@ def _decode_kernel(
         preferred_element_type=jnp.float32,
     )
     sc = sc * sm_scale + bias_ref[0]
-    _online_softmax_update(sc, v_ref, m_scr, l_scr, acc_scr)
+    _online_softmax_update(sc, v_ref[0], m_scr, l_scr, acc_scr)
 
     @pl.when(kj == nk - 1)
     def _finalize():
@@ -522,6 +523,8 @@ def decode_attention(
     v: jax.Array,
     valid_len: jax.Array,
     *,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
     sm_scale: float | None = None,
     block_k: int | None = None,
     interpret: bool | None = None,
@@ -543,7 +546,16 @@ def decode_attention(
     the proven training kernel. Query rows are padded to the sublane
     tile; pad rows are fully masked and sliced off. No VJP — this is
     an inference op.
+
+    With ``k_scale``/``v_scale`` (both or neither; fp32
+    ``(b, h, capacity)`` from :func:`quantize_kv`) the caches are int8
+    and tiles dequantize in VMEM — half the HBM bytes. The routing,
+    masking, and block scaffolding are THIS function for both
+    precisions; only the kernel body differs.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    quantized = k_scale is not None
     b, h, s, d = q.shape
     cap = k.shape[2]
     if sm_scale is None:
@@ -556,6 +568,12 @@ def decode_attention(
     # An explicit block_k that doesn't divide the capacity would floor
     # out of the grid and silently skip the cache tail — fall back.
     if not block_k or cap % block_k or s > 64 or q_rows > cap:
+        if quantized:
+            k = dequantize_kv(k, k_scale)
+            v = dequantize_kv(v, v_scale)
+            return decode_attention_reference(
+                q.astype(jnp.float32), k, v, valid_len, sm_scale
+            ).astype(q.dtype)
         return decode_attention_reference(q, k, v, valid_len, sm_scale)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -570,17 +588,29 @@ def decode_attention(
     visible = (row < s) & (k_pos <= valid_len - s + row)
     bias = jnp.where(visible, 0.0, NEG_INF).astype(jnp.float32)[None]
 
+    bh = b * h
+    kv_specs = [
+        pl.BlockSpec((1, q_rows, d), lambda bi, j: (bi, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bi, j: (bi, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bi, j: (bi, j, 0)),
+    ]
+    scale_specs = [
+        pl.BlockSpec((1, block_k), lambda bi, j: (bi, j)),
+        pl.BlockSpec((1, block_k), lambda bi, j: (bi, j)),
+    ]
+    bias_spec = pl.BlockSpec((1, q_rows, block_k), lambda bi, j: (0, 0, j))
+    args = (qf, _flat(k), _flat(v))
+    if quantized:
+        kernel, in_specs = _decode_q8_kernel, kv_specs + scale_specs + [bias_spec]
+        args += (k_scale.reshape(bh, cap), v_scale.reshape(bh, cap))
+    else:
+        kernel, in_specs = _decode_kernel, kv_specs + [bias_spec]
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, sm_scale=sm_scale),
-        grid=(b * h, cap // block_k),
-        in_specs=[
-            pl.BlockSpec((1, q_rows, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh, j: (bh, j, 0)),
-            pl.BlockSpec((1, q_rows, block_k), lambda bh, j: (0, 0, j)),
-        ],
-        out_specs=pl.BlockSpec((1, q_rows, d), lambda bh, j: (bh, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, q_rows, d), q.dtype),
+        functools.partial(kernel, sm_scale=sm_scale),
+        grid=(bh, cap // block_k),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, q_rows, d), lambda bi, j: (bi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, q_rows, d), q.dtype),
         scratch_shapes=[
             pltpu.VMEM((q_rows, _LANES), jnp.float32),
             pltpu.VMEM((q_rows, _LANES), jnp.float32),
@@ -590,5 +620,85 @@ def decode_attention(
             dimension_semantics=("parallel", "arbitrary")
         ),
         interpret=interpret,
-    )(qf, _flat(k), _flat(v), bias)
+    )(*args, bias)
     return out[:, :s].reshape(b, h, s, d)
+
+
+# ---------------------------------------------------------------------------
+# int8 KV cache: half the decode HBM traffic, dequantized in-kernel
+# ---------------------------------------------------------------------------
+
+
+def quantize_kv(x: jax.Array, eps: float = 1e-8) -> tuple[jax.Array, jax.Array]:
+    """Per-position symmetric int8 quantization over the head dim.
+
+    ``x`` (..., seq, d) -> (int8 values, fp32 scales (..., seq)) with
+    ``x ≈ values * scales[..., None]``. Decode is HBM-bound on the KV
+    cache (BENCHMARKS.md "KV-cached decoding"), so storing it int8
+    halves the bytes the decode kernel streams; the scale adds 4
+    bytes per d-vector (<4% at d=64).
+    """
+    scale = jnp.max(jnp.abs(x).astype(jnp.float32), axis=-1) / 127.0
+    scale = jnp.maximum(scale, eps)
+    q = jnp.round(x.astype(jnp.float32) / scale[..., None])
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def dequantize_kv(values: jax.Array, scales: jax.Array, dtype: Any = jnp.float32) -> jax.Array:
+    """Inverse of :func:`quantize_kv`."""
+    return (values.astype(jnp.float32) * scales[..., None].astype(jnp.float32)).astype(dtype)
+
+
+def _decode_q8_kernel(
+    q_ref, k_ref, v_ref, ks_ref, vs_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale,
+):
+    """:func:`_decode_kernel` over int8 K/V blocks: dequantize each
+    streamed tile in VMEM (one multiply per element) and reuse the
+    shared online-softmax update — HBM sees half the bytes."""
+    kj = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Dequantize to the query dtype (bf16 in production) so both
+    # dot_generals keep MXU-native input precision with fp32
+    # accumulation — the bf16 rounding of value*scale is the same
+    # order as the int8 quantization error itself.
+    kb = (k_ref[0].astype(jnp.float32) * ks_ref[0][:, None]).astype(q_ref.dtype)
+    sc = jax.lax.dot_general(
+        q_ref[0], kb, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    sc = sc * sm_scale + bias_ref[0]
+    vb = (v_ref[0].astype(jnp.float32) * vs_ref[0][:, None]).astype(q_ref.dtype)
+    _online_softmax_update(sc, vb, m_scr, l_scr, acc_scr)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention_q8(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    k_scale: jax.Array,
+    v_scale: jax.Array,
+    valid_len: jax.Array,
+    **kwargs: Any,
+) -> jax.Array:
+    """:func:`decode_attention` over an int8-quantized KV cache:
+    ``k``/``v`` are int8 ``(b, h, capacity, d)`` with fp32 scales
+    ``(b, h, capacity)`` from :func:`quantize_kv`. Thin wrapper — the
+    routing/masking/scaffolding live in :func:`decode_attention` so
+    the two precisions can never diverge."""
+    return decode_attention(
+        q, k, v, valid_len, k_scale=k_scale, v_scale=v_scale, **kwargs
+    )

@@ -211,3 +211,39 @@ def test_speculative_rejects_bad_args():
         generate_speculative(model, params, model, params,
                              jnp.zeros((1, 4), jnp.int32),
                              max_new_tokens=8, k=1)
+
+
+def test_int8_cache_decode_close_to_fp_cache():
+    """kv_cache_dtype='int8': decode logits track the fp-cache decode
+    within quantization tolerance, and greedy generation still emits
+    in-vocab tokens with the half-size cache."""
+    fp = TransformerLM(**TINY)
+    q8 = TransformerLM(**{**TINY, "kv_cache_dtype": "int8"})
+    tokens = jnp.asarray([[5, 3, 7, 2, 9, 4, 8, 6]], jnp.int32)
+    params = fp.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    fp_logits, fp_vars = fp.apply(
+        {"params": params}, tokens, decode=True, mutable=["cache"])
+    q8_logits, q8_vars = q8.apply(
+        {"params": params}, tokens, decode=True, mutable=["cache"])
+    # Prefill runs unquantized in both; logits identical.
+    np.testing.assert_allclose(q8_logits, fp_logits, atol=1e-5, rtol=1e-5)
+    caches = jax.tree_util.tree_leaves_with_path(q8_vars["cache"])
+    assert any(leaf.dtype == jnp.int8 for _, leaf in caches)
+
+    # Single-token steps: int8 path stays close to the fp path.
+    fp_c, q8_c = fp_vars["cache"], q8_vars["cache"]
+    tok = jnp.argmax(fp_logits[:, -1:], axis=-1)
+    for _ in range(4):
+        fp_step, fp_v = fp.apply(
+            {"params": params, "cache": fp_c}, tok, decode=True, mutable=["cache"])
+        q8_step, q8_v = q8.apply(
+            {"params": params, "cache": q8_c}, tok, decode=True, mutable=["cache"])
+        fp_c, q8_c = fp_v["cache"], q8_v["cache"]
+        np.testing.assert_allclose(q8_step, fp_step, atol=0.15, rtol=0.05)
+        tok = jnp.argmax(fp_step[:, -1:], axis=-1)
+
+    out = generate(q8, params, tokens, jax.random.PRNGKey(1),
+                   max_new_tokens=6, temperature=0.0)
+    assert out.shape == (1, 14)
+    assert bool(((out >= 0) & (out < 64)).all())

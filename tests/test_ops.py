@@ -241,3 +241,51 @@ def test_decode_attention_non_dividing_block_k_falls_back():
     out = decode_attention(q, k, v, jnp.int32(300), block_k=256)  # 384 % 256 != 0
     ref = decode_attention_reference(q, k, v, jnp.int32(300))
     np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+
+
+# -- int8-quantized decode cache ---------------------------------------------
+
+
+def test_quantize_kv_roundtrip_error_bound():
+    from hops_tpu.ops.attention import dequantize_kv, quantize_kv
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 2, 64, 64)) * 3.0
+    q, s = quantize_kv(x)
+    assert q.dtype == jnp.int8 and s.shape == (2, 2, 64)
+    back = dequantize_kv(q, s)
+    # Symmetric per-vector int8: error <= scale/2 = max|x|/254 per vector.
+    bound = jnp.max(jnp.abs(x), axis=-1, keepdims=True) / 254.0 + 1e-6
+    assert bool(jnp.all(jnp.abs(back - x) <= bound))
+
+
+@pytest.mark.parametrize("s,valid", [(1, 1), (1, 129), (4, 260), (1, 512)])
+def test_decode_attention_q8_close_to_fp(s, valid):
+    from hops_tpu.ops.attention import (
+        decode_attention_q8,
+        decode_attention_reference,
+        quantize_kv,
+    )
+
+    k, v = _cache_inputs()
+    q, _, _ = _inputs(batch=2, heads=4, seq=s, d=64, seed=3)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    out = decode_attention_q8(q, kq, vq, ks, vs, jnp.int32(valid), block_k=128)
+    ref = decode_attention_reference(q, k, v, jnp.int32(valid))
+    np.testing.assert_allclose(out, ref, atol=0.05, rtol=0.05)
+
+
+def test_decode_attention_q8_odd_capacity_falls_back():
+    from hops_tpu.ops.attention import (
+        decode_attention_q8,
+        decode_attention_reference,
+        quantize_kv,
+    )
+
+    k, v = _cache_inputs(batch=1, heads=1, cap=100)
+    q, _, _ = _inputs(batch=1, heads=1, seq=1, d=64, seed=3)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    out = decode_attention_q8(q, kq, vq, ks, vs, jnp.int32(60))
+    ref = decode_attention_reference(q, k, v, jnp.int32(60))
+    np.testing.assert_allclose(out, ref, atol=0.05, rtol=0.05)
