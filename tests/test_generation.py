@@ -247,3 +247,22 @@ def test_int8_cache_decode_close_to_fp_cache():
                    max_new_tokens=6, temperature=0.0)
     assert out.shape == (1, 14)
     assert bool(((out >= 0) & (out < 64)).all())
+
+
+def test_speculative_matches_greedy_with_int8_cache():
+    """Losslessness survives cache quantization: with kv_cache_dtype
+    ='int8' on both models, speculative output still equals that
+    model's own greedy decoding (both paths read the same quantized
+    cache content)."""
+    from hops_tpu.models.generation import generate_speculative
+
+    model = TransformerLM(**{**TINY, "kv_cache_dtype": "int8"})
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompt = jnp.asarray([[3, 1, 4, 1, 5]], jnp.int32)
+    ref = generate(model, params, prompt, jax.random.PRNGKey(0),
+                   max_new_tokens=13, temperature=0.0)
+    out = generate_speculative(model, params, model, params, prompt,
+                               max_new_tokens=13, k=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
