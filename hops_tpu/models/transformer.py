@@ -33,6 +33,7 @@ from hops_tpu.ops.attention import (
     decode_attention_q8,
     flash_attention,
     quantize_kv,
+    repeat_kv,
 )
 
 
@@ -79,6 +80,11 @@ class Attention(nn.Module):
     # the HBM bytes of the (bandwidth-bound) decode step for <0.5%
     # logit error (tests/test_generation.py).
     kv_cache_dtype: str | None = None
+    # Grouped-query attention: fewer kv heads than query heads shrinks
+    # the decode cache (and its bandwidth) by num_heads/num_kv_heads.
+    # None = MHA (kv heads == query heads, fused qkv projection —
+    # param tree unchanged).
+    num_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x, decode: bool = False):
@@ -89,10 +95,32 @@ class Attention(nn.Module):
             )
         heads = self.num_heads // self.tp_shards
         head_dim = dm // self.num_heads
-        qkv = nn.DenseGeneral(
-            (3, heads, head_dim), dtype=self.dtype, name="qkv", use_bias=False
-        )(x)
-        q, k, v = [jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3)]  # (b, h, s, d)
+        if self.num_kv_heads is None:
+            qkv = nn.DenseGeneral(
+                (3, heads, head_dim), dtype=self.dtype, name="qkv", use_bias=False
+            )(x)
+            q, k, v = [jnp.moveaxis(qkv[:, :, i], 2, 1) for i in range(3)]  # (b, h, s, d)
+        else:
+            if self.num_heads % self.num_kv_heads:
+                raise ValueError(
+                    f"{self.num_heads} heads not divisible by "
+                    f"num_kv_heads={self.num_kv_heads}"
+                )
+            if self.num_kv_heads % self.tp_shards:
+                raise ValueError(
+                    f"{self.num_kv_heads} kv heads not divisible by "
+                    f"tp_shards={self.tp_shards}"
+                )
+            kv_heads = self.num_kv_heads // self.tp_shards
+            q = jnp.moveaxis(
+                nn.DenseGeneral(
+                    (heads, head_dim), dtype=self.dtype, name="q", use_bias=False
+                )(x), 2, 1,
+            )
+            kv = nn.DenseGeneral(
+                (2, kv_heads, head_dim), dtype=self.dtype, name="kv", use_bias=False
+            )(x)
+            k, v = [jnp.moveaxis(kv[:, :, i], 2, 1) for i in range(2)]
 
         if decode:
             return self._decode_attend(q, k, v, b, s, dm, head_dim)
@@ -103,6 +131,11 @@ class Attention(nn.Module):
             # absolute positions start at this shard's offset.
             pos = pos + jax.lax.axis_index(self.seq_axis) * s
         q, k = rotary_embedding(q, pos), rotary_embedding(k, pos)
+        # Training/full-forward is FLOPs-bound: broadcasting GQA kv
+        # heads here costs memory only at the (short-lived) activation,
+        # while the decode path keeps the small cache and groups
+        # natively in-kernel (decode_attention).
+        k, v = repeat_kv(q, k, v)
 
         if self.attention_impl == "flash":
             o = flash_attention(q, k, v, causal=True)
@@ -170,7 +203,7 @@ class Attention(nn.Module):
         fresh_cache = not self.has_variable("cache", "k")
         int8_cache = self.kv_cache_dtype == "int8"
         store_dtype = jnp.int8 if int8_cache else self.dtype
-        cache_shape = (b, q.shape[1], self.max_decode_len, head_dim)
+        cache_shape = (b, k.shape[1], self.max_decode_len, head_dim)
         ck = self.variable("cache", "k", jnp.zeros, cache_shape, store_dtype)
         cv = self.variable("cache", "v", jnp.zeros, cache_shape, store_dtype)
         if int8_cache:
@@ -205,8 +238,9 @@ class Attention(nn.Module):
         if s > 1 and fresh_cache:
             # Prefill chunk on a fresh cache: nothing earlier to attend
             # to, so the chunk's own (unquantized) k/v are the whole
-            # visible history.
-            o = flash_attention(q, k, v, causal=True)
+            # visible history. GQA broadcasts kv heads for this one
+            # compute-bound pass; the cache itself stays small.
+            o = flash_attention(q, *repeat_kv(q, k, v), causal=True)
         elif int8_cache:
             o = decode_attention_q8(
                 q, ck.value, cv.value, cks.value, cvs.value, idx.value
@@ -268,6 +302,7 @@ class Block(nn.Module):
     tp_axis: str | None = None
     tp_shards: int = 1
     kv_cache_dtype: str | None = None
+    num_kv_heads: int | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -282,6 +317,7 @@ class Block(nn.Module):
             tp_axis=self.tp_axis,
             tp_shards=self.tp_shards,
             kv_cache_dtype=self.kv_cache_dtype,
+            num_kv_heads=self.num_kv_heads,
             name="attn",
         )(RMSNorm(dtype=self.dtype)(x), decode=decode)
         if self.dropout_rate:
@@ -317,6 +353,7 @@ class TransformerLM(nn.Module):
     moe_top_k: int = 2
     max_decode_len: int = 2048
     kv_cache_dtype: str | None = None  # "int8": quantized decode cache
+    num_kv_heads: int | None = None  # GQA: shrink the decode cache
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode: bool = False):
@@ -339,6 +376,7 @@ class TransformerLM(nn.Module):
                     dropout_rate=self.dropout_rate,
                     max_decode_len=self.max_decode_len,
                     kv_cache_dtype=self.kv_cache_dtype,
+                    num_kv_heads=self.num_kv_heads,
                     name=f"block_{i}",
                 )(x, train, decode)
                 continue
@@ -352,6 +390,7 @@ class TransformerLM(nn.Module):
                 dropout_rate=self.dropout_rate,
                 max_decode_len=self.max_decode_len,
                 kv_cache_dtype=self.kv_cache_dtype,
+                num_kv_heads=self.num_kv_heads,
                 name=f"block_{i}",
             )(x, train, decode)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)

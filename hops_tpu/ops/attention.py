@@ -42,6 +42,20 @@ _COMPILER_PARAMS = pltpu.CompilerParams(
 )
 
 
+
+def repeat_kv(q: jax.Array, k: jax.Array, v: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Broadcast GQA kv heads to match q's head count (no-op for MHA).
+
+    The single definition of the grouping layout: kv head j serves the
+    contiguous query heads ``j*g .. j*g + g - 1`` — the same order
+    :func:`decode_attention`'s row folding assumes.
+    """
+    if q.shape[1] == k.shape[1]:
+        return k, v
+    g = q.shape[1] // k.shape[1]
+    return jnp.repeat(k, g, axis=1), jnp.repeat(v, g, axis=1)
+
+
 def attention_reference(
     q: jax.Array,
     k: jax.Array,
@@ -474,8 +488,9 @@ def decode_attention_reference(
     traced arithmetic, hence a traced ``valid_len`` works). XLA lowers
     this to a badly-tiled matvec fusion at s=1 (~90 GB/s measured;
     BENCHMARKS.md "KV-cached decoding") — kept only as ground truth
-    and shape fallback.
+    and shape fallback. Fewer kv heads than q heads (GQA) broadcast.
     """
+    k, v = repeat_kv(q, k, v)
     return attention_reference(
         q, k, v, causal=True, sm_scale=sm_scale,
         q_offset=valid_len - q.shape[2],
@@ -557,17 +572,24 @@ def decode_attention(
         raise ValueError("pass both k_scale and v_scale, or neither")
     quantized = k_scale is not None
     b, h, s, d = q.shape
-    cap = k.shape[2]
+    hkv, cap = k.shape[1], k.shape[2]
+    if h % hkv:
+        raise ValueError(f"{h} query heads not divisible by {hkv} kv heads")
+    # GQA: the G query heads sharing a kv head fold into the row dim —
+    # one (b*hkv, G*s, d) q tile attends each kv tile, so the kernel
+    # streams the SMALL cache once (no head-repeat materialization).
+    g = h // hkv
+    rows = g * s
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     if block_k is None:
         block_k = _fit_block(cap, 512)
     else:
         block_k = min(block_k, cap)
-    q_rows = max(8, -(-s // 8) * 8)
+    q_rows = max(8, -(-rows // 8) * 8)
     # An explicit block_k that doesn't divide the capacity would floor
     # out of the grid and silently skip the cache tail — fall back.
-    if not block_k or cap % block_k or s > 64 or q_rows > cap:
+    if not block_k or cap % block_k or rows > 64 or q_rows > cap:
         if quantized:
             k = dequantize_kv(k, k_scale)
             v = dequantize_kv(v, v_scale)
@@ -578,17 +600,18 @@ def decode_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    qf = _flat(q)
-    if q_rows != s:
-        qf = jnp.pad(qf, ((0, 0), (0, q_rows - s), (0, 0)))
-    # (q_rows, cap) additive mask: 0 where row i sees k_pos, -inf
-    # elsewhere (pad rows i >= s see nothing; finalize guards l == 0).
+    qf = q.reshape(b * hkv, rows, d)
+    if q_rows != rows:
+        qf = jnp.pad(qf, ((0, 0), (0, q_rows - rows), (0, 0)))
+    # (q_rows, cap) additive mask: 0 where row r (query position
+    # r % s of group r // s) sees k_pos, -inf elsewhere (pad rows
+    # r >= rows see nothing; finalize guards l == 0).
     row = jnp.arange(q_rows)[:, None]
     k_pos = jnp.arange(cap)[None, :]
-    visible = (row < s) & (k_pos <= valid_len - s + row)
+    visible = (row < rows) & (k_pos <= valid_len - s + row % s)
     bias = jnp.where(visible, 0.0, NEG_INF).astype(jnp.float32)[None]
 
-    bh = b * h
+    bh = b * hkv
     kv_specs = [
         pl.BlockSpec((1, q_rows, d), lambda bi, j: (bi, 0, 0)),
         pl.BlockSpec((1, block_k, d), lambda bi, j: (bi, j, 0)),
@@ -621,7 +644,7 @@ def decode_attention(
         ),
         interpret=interpret,
     )(*args, bias)
-    return out[:, :s].reshape(b, h, s, d)
+    return out[:, :rows].reshape(b, hkv, g, s, d).reshape(b, h, s, d)
 
 
 # ---------------------------------------------------------------------------

@@ -295,6 +295,8 @@ def pipelined_lm_apply(
         dropout_rate=0.0,
         tp_axis=tp_axis,
         tp_shards=mesh.shape[tp_axis] if tp_axis else 1,
+        num_kv_heads=model.num_kv_heads,
+        kv_cache_dtype=model.kv_cache_dtype,
     )
     embed = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
     norm = RMSNorm(dtype=model.dtype)
@@ -388,6 +390,12 @@ def pipelined_lm_apply(
             names = [str(k.key) for k in path if hasattr(k, "key")]
             leaf = names[-1] if names else ""
             if "qkv" in names and leaf == "kernel":
+                return P(axis, None, None, None, tp_axis, None)
+            if "q" in names and leaf == "kernel":
+                # GQA split projections: q (S,K,dm,H,hd) shards heads,
+                # kv (S,K,dm,2,Hkv,hd) shards kv heads.
+                return P(axis, None, None, tp_axis, None)
+            if "kv" in names and leaf == "kernel":
                 return P(axis, None, None, None, tp_axis, None)
             if "out" in names and leaf == "kernel":
                 return P(axis, None, tp_axis, None)

@@ -266,3 +266,62 @@ def test_speculative_matches_greedy_with_int8_cache():
     out = generate_speculative(model, params, model, params, prompt,
                                max_new_tokens=13, k=3)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_gqa_decode_matches_full_forward():
+    """num_kv_heads < num_heads: the cache holds only kv-head slots and
+    the grouped decode kernel reproduces the full (repeat-broadcast)
+    forward at every step; composes with int8 and speculative."""
+    from hops_tpu.models.generation import generate_speculative
+
+    cfg = {**TINY, "num_kv_heads": 2}
+    model = TransformerLM(**cfg)
+    tokens = jnp.asarray([[5, 3, 7, 2, 9, 4, 8, 6]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    full = model.apply({"params": params}, tokens)
+    logits, variables = model.apply(
+        {"params": params}, tokens, decode=True, mutable=["cache"])
+    np.testing.assert_allclose(logits, full, atol=2e-4, rtol=2e-4)
+    caches = jax.tree_util.tree_leaves_with_path(variables["cache"])
+    kv_shapes = {leaf.shape[1] for _, leaf in caches if leaf.ndim == 4}
+    assert kv_shapes == {2}, kv_shapes  # cache sized by kv heads
+
+    cache = variables["cache"]
+    tok = jnp.argmax(logits[:, -1:], axis=-1)
+    for t in range(3):
+        step_logits, variables = model.apply(
+            {"params": params, "cache": cache}, tok, decode=True, mutable=["cache"])
+        cache = variables["cache"]
+        want = model.apply(
+            {"params": params}, jnp.concatenate([tokens, tok], axis=1))[:, -1]
+        np.testing.assert_allclose(step_logits[:, 0], want, atol=2e-4, rtol=2e-4)
+        tokens = jnp.concatenate([tokens, tok], axis=1)
+        tok = jnp.argmax(step_logits[:, -1:], axis=-1)
+
+    # GQA + speculative losslessness
+    prompt = jnp.asarray([[3, 1, 4, 1]], jnp.int32)
+    ref = generate(model, params, prompt, jax.random.PRNGKey(0),
+                   max_new_tokens=9, temperature=0.0)
+    out = generate_speculative(model, params, model, params, prompt,
+                               max_new_tokens=9, k=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    # GQA + int8: a WARM-cache decode step (the path that actually
+    # reads quantized (b, hkv, cap) content) stays close to the
+    # fp-cache step.
+    q8 = TransformerLM(**{**cfg, "kv_cache_dtype": "int8"})
+    fp_logits, fp_vars = model.apply(
+        {"params": params}, prompt, decode=True, mutable=["cache"])
+    q8_logits, q8_vars = q8.apply(
+        {"params": params}, prompt, decode=True, mutable=["cache"])
+    np.testing.assert_allclose(q8_logits, fp_logits, atol=1e-5)  # prefill: unquantized
+    step_tok = jnp.argmax(fp_logits[:, -1:], axis=-1)
+    fp_step, _ = model.apply(
+        {"params": params, "cache": fp_vars["cache"]}, step_tok,
+        decode=True, mutable=["cache"])
+    q8_step, _ = q8.apply(
+        {"params": params, "cache": q8_vars["cache"]}, step_tok,
+        decode=True, mutable=["cache"])
+    np.testing.assert_allclose(q8_step, fp_step, atol=0.15, rtol=0.05)
+    assert float(jnp.max(jnp.abs(q8_step - fp_step))) > 0.0  # really quantized
