@@ -85,6 +85,10 @@ class Attention(nn.Module):
     # None = MHA (kv heads == query heads, fused qkv projection —
     # param tree unchanged).
     num_kv_heads: int | None = None
+    # Sliding-window (Mistral-style) causal attention: query p sees
+    # keys [p - window + 1, p]. Kernel skips out-of-window tiles, so
+    # long-sequence compute is O(seq * window).
+    window: int | None = None
 
     @nn.compact
     def __call__(self, x, decode: bool = False):
@@ -138,10 +142,14 @@ class Attention(nn.Module):
         k, v = repeat_kv(q, k, v)
 
         if self.attention_impl == "flash":
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, k, v, causal=True, window=self.window)
         elif self.attention_impl == "reference":
-            o = attention_reference(q, k, v, causal=True)
+            o = attention_reference(q, k, v, causal=True, window=self.window)
         elif self.attention_impl == "ring_local":
+            if self.window is not None:
+                raise NotImplementedError(
+                    "sliding window is not composed with ring attention yet"
+                )
             # Already inside a shard_map carrying a seq-named mesh axis
             # (sp inside pp stages): run the per-device ring body with
             # named-axis collectives only.
@@ -153,6 +161,10 @@ class Attention(nn.Module):
                 ring_size=self.mesh.shape[self.seq_axis],
             )
         elif self.attention_impl in ("ring", "ulysses"):
+            if self.window is not None:
+                raise NotImplementedError(
+                    "sliding window is not composed with ring/Ulysses yet"
+                )
             from hops_tpu.parallel import ringattention
 
             fn = (
@@ -240,10 +252,13 @@ class Attention(nn.Module):
             # to, so the chunk's own (unquantized) k/v are the whole
             # visible history. GQA broadcasts kv heads for this one
             # compute-bound pass; the cache itself stays small.
-            o = flash_attention(q, *repeat_kv(q, k, v), causal=True)
+            o = flash_attention(
+                q, *repeat_kv(q, k, v), causal=True, window=self.window
+            )
         elif int8_cache:
             o = decode_attention_q8(
-                q, ck.value, cv.value, cks.value, cvs.value, idx.value
+                q, ck.value, cv.value, cks.value, cvs.value, idx.value,
+                window=self.window,
             ).astype(q.dtype)
         else:
             # Token steps (and warm-cache chunk appends) stream the
@@ -252,7 +267,9 @@ class Attention(nn.Module):
             # matvec fusion XLA makes of the einsum formulation, which
             # was 85% of decode step time (BENCHMARKS.md "KV-cached
             # decoding").
-            o = decode_attention(q, ck.value, cv.value, idx.value)
+            o = decode_attention(
+                q, ck.value, cv.value, idx.value, window=self.window
+            )
         return self._project_out(o, b, s, dm)
 
 
@@ -303,6 +320,7 @@ class Block(nn.Module):
     tp_shards: int = 1
     kv_cache_dtype: str | None = None
     num_kv_heads: int | None = None
+    window: int | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -318,6 +336,7 @@ class Block(nn.Module):
             tp_shards=self.tp_shards,
             kv_cache_dtype=self.kv_cache_dtype,
             num_kv_heads=self.num_kv_heads,
+            window=self.window,
             name="attn",
         )(RMSNorm(dtype=self.dtype)(x), decode=decode)
         if self.dropout_rate:
@@ -354,6 +373,7 @@ class TransformerLM(nn.Module):
     max_decode_len: int = 2048
     kv_cache_dtype: str | None = None  # "int8": quantized decode cache
     num_kv_heads: int | None = None  # GQA: shrink the decode cache
+    window: int | None = None  # sliding-window causal attention
 
     @nn.compact
     def __call__(self, tokens, train: bool = False, decode: bool = False):
@@ -391,6 +411,7 @@ class TransformerLM(nn.Module):
                 max_decode_len=self.max_decode_len,
                 kv_cache_dtype=self.kv_cache_dtype,
                 num_kv_heads=self.num_kv_heads,
+                window=self.window,
                 name=f"block_{i}",
             )(x, train, decode)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)

@@ -63,6 +63,7 @@ def attention_reference(
     causal: bool = False,
     sm_scale: float | None = None,
     q_offset: int | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Pure-XLA attention: numeric ground truth + fallback path.
 
@@ -73,6 +74,8 @@ def attention_reference(
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal=True and window >= 1")
     if q_offset is None:
         q_offset = k.shape[2] - q.shape[2] if causal else 0
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -80,15 +83,38 @@ def attention_reference(
     if causal:
         q_pos = jnp.arange(q.shape[2])[:, None] + q_offset
         k_pos = jnp.arange(k.shape[2])[None, :]
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        visible = q_pos >= k_pos
+        if window is not None:
+            visible &= q_pos - k_pos < window
+        s = jnp.where(visible, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
-def _causal_mask(s, qi, kj, block_q, block_k, q_offset):
+def _causal_mask(s, qi, kj, block_q, block_k, q_offset, window=None):
     q_pos = qi * block_q + q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
     k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+    visible = q_pos >= k_pos
+    if window is not None:
+        visible &= q_pos - k_pos < window
+    return jnp.where(visible, s, NEG_INF)
+
+
+def _block_runs(qi, kj, block_q, block_k, q_offset, causal, window):
+    """Whether a (qi, kj) tile intersects the (windowed-)causal band —
+    tiles past the diagonal AND tiles fully below the sliding window
+    are skipped entirely, making long-sequence windowed attention
+    O(seq * window) compute."""
+    if not causal:
+        return True
+    runs = kj * block_k < (qi + 1) * block_q + q_offset
+    if window is not None:
+        # Tile's newest key vs the oldest position the tile's oldest
+        # query still sees.
+        runs = jnp.logical_and(
+            runs, (kj + 1) * block_k - 1 >= qi * block_q + q_offset - (window - 1)
+        )
+    return runs
 
 
 # ---------------------------------------------------------------------------
@@ -122,7 +148,7 @@ def _online_softmax_update(sc, vb, m_scr, l_scr, acc_scr):
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-    *, sm_scale, causal, block_q, block_k, q_offset,
+    *, sm_scale, causal, block_q, block_k, q_offset, window,
 ):
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -133,8 +159,8 @@ def _fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Causal: skip K blocks entirely above the (offset) diagonal.
-    run = True if not causal else kj * block_k < (qi + 1) * block_q + q_offset
+    # Causal: skip K blocks above the diagonal or below the window.
+    run = _block_runs(qi, kj, block_q, block_k, q_offset, causal, window)
 
     @pl.when(run)
     def _step():
@@ -145,7 +171,7 @@ def _fwd_kernel(
         )
         s = s * sm_scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k, q_offset)
+            s = _causal_mask(s, qi, kj, block_q, block_k, q_offset, window)
         _online_softmax_update(s, v_ref[0], m_scr, l_scr, acc_scr)
 
     @pl.when(kj == nk - 1)
@@ -168,7 +194,7 @@ def _fwd_kernel(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr,
-    *, sm_scale, causal, block_q, block_k, q_offset,
+    *, sm_scale, causal, block_q, block_k, q_offset, window,
 ):
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
@@ -177,7 +203,7 @@ def _bwd_dq_kernel(
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    run = True if not causal else kj * block_k < (qi + 1) * block_q + q_offset
+    run = _block_runs(qi, kj, block_q, block_k, q_offset, causal, window)
 
     @pl.when(run)
     def _step():
@@ -192,7 +218,7 @@ def _bwd_dq_kernel(
         )
         s = s * sm_scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k, q_offset)
+            s = _causal_mask(s, qi, kj, block_q, block_k, q_offset, window)
         lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
         p = jnp.where(lse == NEG_INF, 0.0, jnp.exp(s - lse_safe))
         dp = jax.lax.dot_general(
@@ -210,7 +236,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k, q_offset,
+    dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k, q_offset, window,
 ):
     kj, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
@@ -220,7 +246,7 @@ def _bwd_dkv_kernel(
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    run = True if not causal else kj * block_k < (qi + 1) * block_q + q_offset
+    run = _block_runs(qi, kj, block_q, block_k, q_offset, causal, window)
 
     @pl.when(run)
     def _step():
@@ -235,7 +261,7 @@ def _bwd_dkv_kernel(
         )
         s = s * sm_scale
         if causal:
-            s = _causal_mask(s, qi, kj, block_q, block_k, q_offset)
+            s = _causal_mask(s, qi, kj, block_q, block_k, q_offset, window)
         lse_safe = jnp.where(lse == NEG_INF, 0.0, lse)
         p = jnp.where(lse == NEG_INF, 0.0, jnp.exp(s - lse_safe))
         dv_scr[...] += jax.lax.dot_general(
@@ -265,13 +291,13 @@ def _flat(x):
     return x.reshape(b * h, s, d)
 
 
-def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, q_offset, interpret):
+def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, q_offset, window, interpret):
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
     grid = (bh, seq_q // block_q, seq_k // block_k)
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, q_offset=q_offset,
+        block_k=block_k, q_offset=q_offset, window=window,
     )
     return pl.pallas_call(
         kernel,
@@ -299,24 +325,24 @@ def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, q_offset, interpret):
     )(q, k, v)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset, window, interpret):
     o, _ = _fwd_call(
         _flat(q), _flat(k), _flat(v), causal, sm_scale, block_q, block_k,
-        q_offset, interpret,
+        q_offset, window, interpret,
     )
     return o.reshape(q.shape)
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_offset, interpret):
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_offset, window, interpret):
     o, lse = _fwd_call(
         _flat(q), _flat(k), _flat(v), causal, sm_scale, block_q, block_k,
-        q_offset, interpret,
+        q_offset, window, interpret,
     )
     return o.reshape(q.shape), (q, k, v, o.reshape(q.shape), lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, interpret, res, g):
+def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, window, interpret, res, g):
     q, k, v, o, lse = res
     shape = q.shape
     qf, kf, vf, of, gf = _flat(q), _flat(k), _flat(v), _flat(o), _flat(g)
@@ -326,7 +352,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, interpret, res, g):
 
     dq_kernel = functools.partial(
         _bwd_dq_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, q_offset=q_offset,
+        block_k=block_k, q_offset=q_offset, window=window,
     )
     dq = pl.pallas_call(
         dq_kernel,
@@ -348,7 +374,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, interpret, res, g):
 
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, q_offset=q_offset,
+        block_k=block_k, q_offset=q_offset, window=window,
     )
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -410,9 +436,15 @@ def flash_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     q_offset: int | None = None,
+    window: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Blocked flash attention over ``(batch, heads, seq, head_dim)``.
+
+    ``window`` (causal only): query p attends keys in
+    ``[p - window + 1, p]`` — Mistral-style sliding-window attention.
+    Tiles fully below the window are skipped in all three kernels, so
+    long-sequence compute is O(seq * window).
 
     Cross-length causal calls (chunked prefill: ``seq_q < seq_k``) run
     in-kernel with the query chunk placed at ``q_offset`` (default: the
@@ -426,6 +458,10 @@ def flash_attention(
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     seq_q, seq_k = q.shape[2], k.shape[2]
     if q_offset is None:
         q_offset = seq_k - seq_q if causal else 0
@@ -459,11 +495,14 @@ def flash_attention(
         or (seq_k < _XLA_FASTER_BELOW and not forced)
     ):
         return attention_reference(
-            q, k, v, causal=causal, sm_scale=sm_scale, q_offset=q_offset
+            q, k, v, causal=causal, sm_scale=sm_scale, q_offset=q_offset,
+            window=window,
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset, interpret)
+    return _flash(
+        q, k, v, causal, sm_scale, block_q, block_k, q_offset, window, interpret
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -477,6 +516,7 @@ def decode_attention_reference(
     v: jax.Array,
     valid_len: jax.Array,
     sm_scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """XLA ground truth for :func:`decode_attention`.
 
@@ -493,7 +533,7 @@ def decode_attention_reference(
     k, v = repeat_kv(q, k, v)
     return attention_reference(
         q, k, v, causal=True, sm_scale=sm_scale,
-        q_offset=valid_len - q.shape[2],
+        q_offset=valid_len - q.shape[2], window=window,
     )
 
 
@@ -542,6 +582,7 @@ def decode_attention(
     v_scale: jax.Array | None = None,
     sm_scale: float | None = None,
     block_k: int | None = None,
+    window: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Attention for KV-cached decoding: ``q`` (b, h, s, d) against
@@ -570,6 +611,8 @@ def decode_attention(
     """
     if (k_scale is None) != (v_scale is None):
         raise ValueError("pass both k_scale and v_scale, or neither")
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
     quantized = k_scale is not None
     b, h, s, d = q.shape
     hkv, cap = k.shape[1], k.shape[2]
@@ -594,9 +637,9 @@ def decode_attention(
             k = dequantize_kv(k, k_scale)
             v = dequantize_kv(v, v_scale)
             return decode_attention_reference(
-                q.astype(jnp.float32), k, v, valid_len, sm_scale
+                q.astype(jnp.float32), k, v, valid_len, sm_scale, window
             ).astype(q.dtype)
-        return decode_attention_reference(q, k, v, valid_len, sm_scale)
+        return decode_attention_reference(q, k, v, valid_len, sm_scale, window)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -608,7 +651,10 @@ def decode_attention(
     # r >= rows see nothing; finalize guards l == 0).
     row = jnp.arange(q_rows)[:, None]
     k_pos = jnp.arange(cap)[None, :]
-    visible = (row < rows) & (k_pos <= valid_len - s + row % s)
+    q_pos = valid_len - s + row % s
+    visible = (row < rows) & (k_pos <= q_pos)
+    if window is not None:
+        visible &= q_pos - k_pos < window
     bias = jnp.where(visible, 0.0, NEG_INF).astype(jnp.float32)[None]
 
     bh = b * hkv

@@ -325,3 +325,28 @@ def test_gqa_decode_matches_full_forward():
         decode=True, mutable=["cache"])
     np.testing.assert_allclose(q8_step, fp_step, atol=0.15, rtol=0.05)
     assert float(jnp.max(jnp.abs(q8_step - fp_step))) > 0.0  # really quantized
+
+
+def test_sliding_window_decode_matches_full_forward():
+    """window=4: decode-path logits equal the full windowed forward at
+    every step (the cache keeps all positions; masking enforces the
+    window)."""
+    model = TransformerLM(**{**TINY, "window": 4})
+    tokens = jnp.asarray([[5, 3, 7, 2, 9, 4, 8, 6, 1, 2]], jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)["params"]
+
+    full = model.apply({"params": params}, tokens)
+    logits, variables = model.apply(
+        {"params": params}, tokens, decode=True, mutable=["cache"])
+    np.testing.assert_allclose(logits, full, atol=2e-4, rtol=2e-4)
+
+    cache = variables["cache"]
+    tok = jnp.argmax(logits[:, -1:], axis=-1)
+    for _ in range(3):
+        step_logits, variables = model.apply(
+            {"params": params, "cache": cache}, tok, decode=True, mutable=["cache"])
+        cache = variables["cache"]
+        tokens = jnp.concatenate([tokens, tok], axis=1)
+        want = model.apply({"params": params}, tokens)[:, -1]
+        np.testing.assert_allclose(step_logits[:, 0], want, atol=2e-4, rtol=2e-4)
+        tok = jnp.argmax(step_logits[:, -1:], axis=-1)

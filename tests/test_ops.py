@@ -289,3 +289,62 @@ def test_decode_attention_q8_odd_capacity_falls_back():
     out = decode_attention_q8(q, kq, vq, ks, vs, jnp.int32(60))
     ref = decode_attention_reference(q, k, v, jnp.int32(60))
     np.testing.assert_allclose(out, ref, atol=0.05, rtol=0.05)
+
+
+# -- sliding-window attention ------------------------------------------------
+
+
+def _window_reference(q, k, v, window):
+    import math as _math
+
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / _math.sqrt(q.shape[-1])
+    q_pos = jnp.arange(q.shape[2])[:, None]
+    k_pos = jnp.arange(k.shape[2])[None, :]
+    visible = (q_pos >= k_pos) & (q_pos - k_pos < window)
+    scores = jnp.where(visible[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+@pytest.mark.parametrize("window", [64, 100, 256])
+def test_sliding_window_flash_matches_reference(window):
+    q, k, v = _inputs(seq=256)
+    out = flash_attention(
+        q, k, v, causal=True, window=window, block_q=64, block_k=64)
+    ref = _window_reference(q, k, v, window)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_sliding_window_grads_match_reference(window=96):
+    q, k, v = _inputs(batch=1, heads=2, seq=256, d=32)
+
+    def loss_flash(q, k, v):
+        return flash_attention(
+            q, k, v, causal=True, window=window, block_q=64, block_k=64).sum()
+
+    def loss_ref(q, k, v):
+        return _window_reference(q, k, v, window).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
+
+
+def test_sliding_window_requires_causal():
+    q, k, v = _inputs(seq=128)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=64)
+
+
+def test_sliding_window_decode_matches_reference():
+    from hops_tpu.ops.attention import decode_attention, decode_attention_reference
+
+    k, v = _cache_inputs(batch=1, heads=2, cap=512)
+    q, _, _ = _inputs(batch=1, heads=2, seq=1, d=64, seed=4)
+    for valid, window in [(300, 64), (512, 128), (40, 100)]:
+        out = decode_attention(
+            q, k, v, jnp.int32(valid), window=window, block_k=128)
+        ref = decode_attention_reference(
+            q, k, v, jnp.int32(valid), window=window)
+        np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
