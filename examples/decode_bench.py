@@ -25,6 +25,18 @@ def main() -> None:
     parser.add_argument("--d-model", type=int, default=512)
     parser.add_argument("--layers", type=int, default=6)
     parser.add_argument("--max-decode-len", type=int, default=2048)
+    parser.add_argument(
+        "--kv-dtype", choices=["bf16", "int8"], default="bf16",
+        help="int8: quantized cache, half the decode HBM bytes",
+    )
+    parser.add_argument(
+        "--kv-heads", type=int, default=None,
+        help="GQA kv heads (< 8 shrinks the cache by the group factor)",
+    )
+    parser.add_argument(
+        "--window", type=int, default=None,
+        help="sliding-window causal attention width",
+    )
     args = parser.parse_args()
 
     import jax
@@ -41,6 +53,9 @@ def main() -> None:
         num_layers=args.layers,
         dtype=jnp.bfloat16,
         max_decode_len=args.max_decode_len,
+        kv_cache_dtype=None if args.kv_dtype == "bf16" else args.kv_dtype,
+        num_kv_heads=args.kv_heads,
+        window=args.window,
     )
     prompt = jax.random.randint(
         jax.random.PRNGKey(0), (args.batch, args.prompt), 0, 32000
@@ -65,7 +80,9 @@ def main() -> None:
     print(
         f"decode: {per_step * 1e3:.2f} ms/token-step, "
         f"{args.batch * args.tokens / total:.0f} tokens/s "
-        f"(batch {args.batch}, {args.layers} layers, d={args.d_model})"
+        f"(batch {args.batch}, {args.layers} layers, d={args.d_model}, "
+        f"cache={args.kv_dtype}, kv_heads={args.kv_heads or 8}, "
+        f"window={args.window})"
     )
 
     trace_dir = tempfile.mkdtemp(prefix="decode_trace_")
