@@ -146,10 +146,6 @@ class Attention(nn.Module):
         elif self.attention_impl == "reference":
             o = attention_reference(q, k, v, causal=True, window=self.window)
         elif self.attention_impl == "ring_local":
-            if self.window is not None:
-                raise NotImplementedError(
-                    "sliding window is not composed with ring attention yet"
-                )
             # Already inside a shard_map carrying a seq-named mesh axis
             # (sp inside pp stages): run the per-device ring body with
             # named-axis collectives only.
@@ -158,13 +154,10 @@ class Attention(nn.Module):
             o = ringattention.ring_attention_local(
                 q, k, v,
                 axis=self.seq_axis, batch_axis=self.batch_axis, causal=True,
+                window=self.window,
                 ring_size=self.mesh.shape[self.seq_axis],
             )
         elif self.attention_impl in ("ring", "ulysses"):
-            if self.window is not None:
-                raise NotImplementedError(
-                    "sliding window is not composed with ring/Ulysses yet"
-                )
             from hops_tpu.parallel import ringattention
 
             fn = (
@@ -175,6 +168,7 @@ class Attention(nn.Module):
             o = fn(
                 q, k, v, self.mesh,
                 axis=self.seq_axis, batch_axis=self.batch_axis, causal=True,
+                window=self.window,
             )
         else:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")

@@ -39,14 +39,17 @@ from hops_tpu.ops.attention import NEG_INF, flash_attention
 from hops_tpu.parallel.mesh import pvary as _pvary
 
 
-def _local_scores(q, k, sm_scale, q_offset, k_offset, causal):
+def _local_scores(q, k, sm_scale, q_offset, k_offset, causal, window=None):
     """(bh, sq, sk) masked scores for one ring step, fp32."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * sm_scale
     if causal:
         q_pos = q_offset + jnp.arange(q.shape[2])[:, None]
         k_pos = k_offset + jnp.arange(k.shape[2])[None, :]
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        visible = q_pos >= k_pos
+        if window is not None:
+            visible &= q_pos - k_pos < window
+        s = jnp.where(visible, s, NEG_INF)
     return s
 
 
@@ -74,6 +77,7 @@ def ring_attention_local(
     batch_axis: str | None = None,
     causal: bool = False,
     sm_scale: float | None = None,
+    window: int | None = None,
     ring_size: int,
 ) -> jax.Array:
     """The per-device body of ring attention, for use under an
@@ -110,7 +114,9 @@ def ring_attention_local(
     def step(t, carry):
         m, l, acc, k_cur, v_cur = carry
         src_idx = (my_idx - t) % n
-        s = _local_scores(q32, k_cur, sm_scale, q_offset, src_idx * seq_local, causal)
+        s = _local_scores(
+            q32, k_cur, sm_scale, q_offset, src_idx * seq_local, causal, window
+        )
         m, l, acc = _fold((m, l, acc), s, v_cur)
         # Rotate K/V one hop (device i sends to i+1) so that at
         # step t every device holds the chunk that originated at
@@ -136,6 +142,7 @@ def ring_attention(
     batch_axis: str | None = None,
     causal: bool = False,
     sm_scale: float | None = None,
+    window: int | None = None,
 ) -> jax.Array:
     """Ring attention over globally-shaped ``(batch, heads, seq, d)``.
 
@@ -150,7 +157,7 @@ def ring_attention(
     local = functools.partial(
         ring_attention_local,
         axis=axis, batch_axis=batch_axis, causal=causal,
-        sm_scale=sm_scale, ring_size=n,
+        sm_scale=sm_scale, window=window, ring_size=n,
     )
     spec = P(batch_axis, None, axis, None)
     return shard_map(
@@ -168,6 +175,7 @@ def ulysses_attention(
     batch_axis: str | None = None,
     causal: bool = False,
     sm_scale: float | None = None,
+    window: int | None = None,
     use_flash: bool = True,
 ) -> jax.Array:
     """DeepSpeed-Ulysses-style sequence parallelism via two all-to-alls.
@@ -184,6 +192,7 @@ def ulysses_attention(
         flash_attention if use_flash else _reference_local,
         causal=causal,
         sm_scale=sm_scale,
+        window=window,
     )
 
     def local_fn(q, k, v):
@@ -202,7 +211,9 @@ def ulysses_attention(
     )(q, k, v)
 
 
-def _reference_local(q, k, v, causal, sm_scale):
+def _reference_local(q, k, v, causal, sm_scale, window=None):
     from hops_tpu.ops.attention import attention_reference
 
-    return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    return attention_reference(
+        q, k, v, causal=causal, sm_scale=sm_scale, window=window
+    )

@@ -64,3 +64,20 @@ def test_ring_attention_grads_flow(seq_mesh):
     g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(g, g_ref):
         np.testing.assert_allclose(a, b, atol=5e-5, rtol=5e-5)
+
+
+def test_ring_and_ulysses_with_sliding_window():
+    """window composes with both sp schemes: outputs match the XLA
+    windowed reference on the fake mesh."""
+    from hops_tpu.ops.attention import attention_reference
+    from hops_tpu.parallel import mesh as mesh_lib
+    from hops_tpu.parallel.ringattention import ring_attention, ulysses_attention
+
+    mesh = mesh_lib.make_mesh({"seq": 4}, devices=jax.devices()[:4])
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(kk, (1, 4, 256, 32), jnp.float32) for kk in ks)
+    ref = attention_reference(q, k, v, causal=True, window=96)
+    ring = ring_attention(q, k, v, mesh, causal=True, window=96)
+    np.testing.assert_allclose(ring, ref, atol=2e-5, rtol=2e-5)
+    uly = ulysses_attention(q, k, v, mesh, causal=True, window=96, use_flash=False)
+    np.testing.assert_allclose(uly, ref, atol=2e-5, rtol=2e-5)
