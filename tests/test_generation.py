@@ -350,3 +350,32 @@ def test_sliding_window_decode_matches_full_forward():
         want = model.apply({"params": params}, tokens)[:, -1]
         np.testing.assert_allclose(step_logits[:, 0], want, atol=2e-4, rtol=2e-4)
         tok = jnp.argmax(step_logits[:, -1:], axis=-1)
+
+
+def test_all_decode_knobs_compose():
+    """The modern-LM preset: GQA + int8 cache + sliding window, decoded
+    speculatively — the full knob stack in one model, output identical
+    to that model's own greedy decoding."""
+    from hops_tpu.models.generation import generate_speculative
+
+    model = TransformerLM(**{
+        **TINY, "num_kv_heads": 2, "kv_cache_dtype": "int8", "window": 6,
+    })
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    prompt = jnp.asarray([[3, 1, 4, 1, 5, 9], [2, 6, 5, 3, 5, 8]], jnp.int32)
+
+    ref = generate(model, params, prompt, jax.random.PRNGKey(0),
+                   max_new_tokens=11, temperature=0.0)
+    assert ref.shape == (2, 17)
+    assert bool(((ref >= 0) & (ref < 64)).all())
+    out = generate_speculative(model, params, model, params, prompt,
+                               max_new_tokens=11, k=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    # And the decode path still equals the full (windowed) forward.
+    full = model.apply({"params": params}, prompt)
+    logits, _ = model.apply(
+        {"params": params}, prompt, decode=True, mutable=["cache"])
+    np.testing.assert_allclose(logits, full, atol=2e-4, rtol=2e-4)
