@@ -7,7 +7,11 @@ hand-written here with Pallas:
 
 - ``attention`` — blocked flash attention (fwd + bwd) with online
   softmax: O(seq) memory, never materializes the (seq, seq) score
-  matrix in HBM.
+  matrix in HBM; sliding-window variants skip out-of-window tiles.
+- ``decode_attention`` — one near-bandwidth HBM pass over a
+  fixed-capacity KV cache for autoregressive decoding, with optional
+  int8 dequantization in VMEM (``quantize_kv``) and native GQA
+  query-head grouping.
 
 Every kernel ships with a pure-XLA reference twin used for (a) numeric
 tests, (b) non-TPU backends, (c) shapes the kernel doesn't support.
@@ -15,5 +19,11 @@ tests, (b) non-TPU backends, (c) shapes the kernel doesn't support.
 
 from hops_tpu.ops.attention import (  # noqa: F401
     attention_reference,
+    decode_attention,
+    decode_attention_q8,
+    decode_attention_reference,
+    dequantize_kv,
     flash_attention,
+    quantize_kv,
+    repeat_kv,
 )
