@@ -114,10 +114,29 @@ def ring_attention_local(
     def step(t, carry):
         m, l, acc, k_cur, v_cur = carry
         src_idx = (my_idx - t) % n
-        s = _local_scores(
-            q32, k_cur, sm_scale, q_offset, src_idx * seq_local, causal, window
-        )
-        m, l, acc = _fold((m, l, acc), s, v_cur)
+        k_start = src_idx * seq_local
+
+        def fold_chunk(carry):
+            s = _local_scores(
+                q32, k_cur, sm_scale, q_offset, k_start, causal, window
+            )
+            return _fold(carry, s, v_cur)
+
+        if causal and window is not None:
+            # Sliding window: skip the fold (scores + exp + two
+            # einsums) for chunks entirely outside this device's
+            # visible band [q_start - window + 1, q_end]. The chunk
+            # must still ROTATE — downstream devices may need it — so
+            # only compute is conditional (no collectives inside cond).
+            relevant = jnp.logical_and(
+                k_start <= q_offset + seq_local - 1,
+                k_start + seq_local - 1 >= q_offset - (window - 1),
+            )
+            m, l, acc = jax.lax.cond(
+                relevant, fold_chunk, lambda c: c, (m, l, acc)
+            )
+        else:
+            m, l, acc = fold_chunk((m, l, acc))
         # Rotate K/V one hop (device i sends to i+1) so that at
         # step t every device holds the chunk that originated at
         # (my_idx - t) mod n. The permute overlaps the next step's
