@@ -181,6 +181,7 @@ class MoEBlock(nn.Module):
     expert_shards: int = 1
     kv_cache_dtype: str | None = None
     num_kv_heads: int | None = None
+    window: int | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -196,6 +197,7 @@ class MoEBlock(nn.Module):
             max_decode_len=self.max_decode_len,
             kv_cache_dtype=self.kv_cache_dtype,
             num_kv_heads=self.num_kv_heads,
+            window=self.window,
             name="attn",
         )(RMSNorm(dtype=self.dtype)(x), decode=decode)
         if self.dropout_rate:

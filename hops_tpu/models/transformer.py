@@ -391,6 +391,7 @@ class TransformerLM(nn.Module):
                     max_decode_len=self.max_decode_len,
                     kv_cache_dtype=self.kv_cache_dtype,
                     num_kv_heads=self.num_kv_heads,
+                    window=self.window,
                     name=f"block_{i}",
                 )(x, train, decode)
                 continue

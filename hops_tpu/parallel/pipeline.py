@@ -297,6 +297,7 @@ def pipelined_lm_apply(
         tp_shards=mesh.shape[tp_axis] if tp_axis else 1,
         num_kv_heads=model.num_kv_heads,
         kv_cache_dtype=model.kv_cache_dtype,
+        window=model.window,
     )
     embed = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
     norm = RMSNorm(dtype=model.dtype)
@@ -324,6 +325,9 @@ def pipelined_lm_apply(
             dropout_rate=0.0,
             expert_axis=expert_axis,
             expert_shards=mesh.shape[expert_axis] if expert_axis else 1,
+            num_kv_heads=model.num_kv_heads,
+            kv_cache_dtype=model.kv_cache_dtype,
+            window=model.window,
         )
         groups = []
         for start in range(0, model.num_layers, g):
