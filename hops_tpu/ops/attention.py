@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import functools
 import math
+from typing import Any
 
 import jax
 import jax.numpy as jnp
@@ -537,20 +538,61 @@ def decode_attention_reference(
     )
 
 
-def _decode_kernel(
-    q_ref, k_ref, v_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
-    *, sm_scale,
-):
-    """One (bh, kj) grid step of cache attention.
+def _read_scalar(ref):
+    """First element of a scalar-prefetch operand. Kernel bodies get a
+    (1,)-shaped SMEM ref; BlockSpec index maps may receive the scalar
+    already unwrapped to 0-d depending on the Pallas version — accept
+    both (the rank is static, so this branches at trace time)."""
+    return ref if getattr(ref, "ndim", None) == 0 else ref[0]
 
-    Deliberately uses only the features of the proven ``_fwd_kernel``
-    (static grid, program-id conditions, VMEM scratch): the
-    causal/validity mask arrives as an additive fp32 bias computed by
-    XLA from the traced ``valid_len``, so the kernel itself is fully
-    static — no scalar prefetch, no data-dependent predication.
+
+def _decode_block_range(vl, *, block_k, s, window):
+    """(first, last) k-block indices that can contain visible keys for a
+    decode step whose chunk ends at traced position ``vl``: validity
+    caps the top at ``ceil(vl/block_k)-1``; a sliding window lifts the
+    bottom to the block holding ``vl - s - window + 1``. Shared by the
+    kernels' compute guard and the BlockSpec index maps so the two can
+    never disagree."""
+    last = (vl + block_k - 1) // block_k - 1
+    if window is None:
+        first = jnp.int32(0)
+    else:
+        first = jnp.maximum(vl - s - window + 1, 0) // block_k
+    return first, last
+
+
+def _decode_mask(vl, qi, kj, *, block_q, block_k, s, rows, window):
+    """(block_q, block_k) visibility of k positions to query rows.
+
+    Row ``r`` of the folded (group*chunk) q tile holds chunk position
+    ``r % s`` = absolute position ``vl - s + r % s``; rows >= ``rows``
+    are padding and see nothing. Computed in-kernel from the
+    scalar-prefetched ``vl`` — no XLA-materialized bias buffer."""
+    row = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    q_pos = vl - s + row % s
+    visible = (row < rows) & (k_pos <= q_pos)
+    if window is not None:
+        visible &= q_pos - k_pos < window
+    return visible
+
+
+def _decode_kernel(
+    vl_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale, block_q, block_k, s, rows, window,
+):
+    """One (bh, qi, kj) grid step of cache attention.
+
+    ``vl_ref`` is the scalar-prefetched ``valid_len`` (SMEM): the
+    causal/validity mask is computed in-kernel from it, and grid steps
+    whose k block lies outside ``_decode_block_range`` skip compute —
+    their BlockSpec index maps clamp to the range edge, so Mosaic
+    revisits the previous block window and issues no HBM copy. HBM
+    traffic is therefore O(valid_len), not O(capacity).
     """
-    kj = pl.program_id(1)
-    nk = pl.num_programs(1)
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    vl = _read_scalar(vl_ref)
 
     @pl.when(kj == 0)
     def _init():
@@ -558,12 +600,20 @@ def _decode_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    sc = jax.lax.dot_general(
-        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    sc = sc * sm_scale + bias_ref[0]
-    _online_softmax_update(sc, v_ref[0], m_scr, l_scr, acc_scr)
+    first, last = _decode_block_range(vl, block_k=block_k, s=s, window=window)
+
+    @pl.when((kj >= first) & (kj <= last))
+    def _body():
+        sc = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        visible = _decode_mask(
+            vl, qi, kj, block_q=block_q, block_k=block_k, s=s, rows=rows,
+            window=window,
+        )
+        sc = jnp.where(visible, sc * sm_scale, NEG_INF)
+        _online_softmax_update(sc, v_ref[0], m_scr, l_scr, acc_scr)
 
     @pl.when(kj == nk - 1)
     def _finalize():
@@ -595,13 +645,16 @@ def decode_attention(
     s=1 matvec + mask + softmax chain to a fusion that sustains only
     ~90 GB/s on v5e (BENCHMARKS.md "KV-cached decoding" — 85% of decode
     step time). Here K/V stream through the MXU in ``block_k`` tiles
-    with fp32 online-softmax scratch, one HBM pass at near-bandwidth.
-    The causal/validity mask is an additive bias computed by XLA from
-    ``valid_len`` (~``q_rows*capacity*4`` bytes, <2% of the K/V
-    traffic) so the kernel needs no dynamic features beyond those of
-    the proven training kernel. Query rows are padded to the sublane
-    tile; pad rows are fully masked and sliced off. No VJP — this is
-    an inference op.
+    with fp32 online-softmax scratch. ``valid_len`` rides scalar
+    prefetch: the mask is computed in-kernel, and k blocks past the
+    valid prefix (or, with ``window``, before the window) are skipped
+    by both the compute guard and the clamped BlockSpec index maps —
+    Mosaic elides the HBM copy when consecutive grid steps map to the
+    same block, so **decode HBM traffic is proportional to
+    ``valid_len``, not cache capacity**. Query rows tile in ``block_q``
+    chunks (multi-row warm-cache appends of any size stay on the
+    kernel path); pad rows are fully masked and sliced off. No VJP —
+    this is an inference op.
 
     With ``k_scale``/``v_scale`` (both or neither; fp32
     ``(b, h, capacity)`` from :func:`quantize_kv`) the caches are int8
@@ -629,10 +682,13 @@ def decode_attention(
         block_k = _fit_block(cap, 512)
     else:
         block_k = min(block_k, cap)
-    q_rows = max(8, -(-rows // 8) * 8)
+    # Single-token decode (small rows) runs as one padded-to-sublane q
+    # tile; large warm-cache appends tile the rows in 64-row blocks.
+    block_q = 64 if rows > 64 else max(8, -(-rows // 8) * 8)
+    q_rows = -(-rows // block_q) * block_q
     # An explicit block_k that doesn't divide the capacity would floor
     # out of the grid and silently skip the cache tail — fall back.
-    if not block_k or cap % block_k or rows > 64 or q_rows > cap:
+    if not block_k or cap % block_k:
         if quantized:
             k = dequantize_kv(k, k_scale)
             v = dequantize_kv(v, v_scale)
@@ -643,53 +699,61 @@ def decode_attention(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    qf = q.reshape(b * hkv, rows, d)
+    bh = b * hkv
+    qf = q.reshape(bh, rows, d)
     if q_rows != rows:
         qf = jnp.pad(qf, ((0, 0), (0, q_rows - rows), (0, 0)))
-    # (q_rows, cap) additive mask: 0 where row r (query position
-    # r % s of group r // s) sees k_pos, -inf elsewhere (pad rows
-    # r >= rows see nothing; finalize guards l == 0).
-    row = jnp.arange(q_rows)[:, None]
-    k_pos = jnp.arange(cap)[None, :]
-    q_pos = valid_len - s + row % s
-    visible = (row < rows) & (k_pos <= q_pos)
-    if window is not None:
-        visible &= q_pos - k_pos < window
-    bias = jnp.where(visible, 0.0, NEG_INF).astype(jnp.float32)[None]
+    vl = jnp.asarray(valid_len, jnp.int32).reshape(1)
 
-    bh = b * hkv
+    # Index maps receive (*grid_indices, *scalar_prefetch_refs); kernel
+    # bodies receive the scalar refs FIRST — Pallas's convention.
+    def kv_index(bi, qi, kj, vl_ref):
+        # Out-of-range grid steps revisit the range edge's block: same
+        # window as an in-range neighbor step -> Mosaic issues no copy.
+        first, last = _decode_block_range(
+            _read_scalar(vl_ref), block_k=block_k, s=s, window=window
+        )
+        return bi, jnp.clip(kj, first, last), 0
+
     kv_specs = [
-        pl.BlockSpec((1, q_rows, d), lambda bi, j: (bi, 0, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bi, j: (bi, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bi, j: (bi, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bi, qi, kj, vl_ref: (bi, qi, 0)),
+        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec((1, block_k, d), kv_index),
     ]
     scale_specs = [
-        pl.BlockSpec((1, block_k), lambda bi, j: (bi, j)),
-        pl.BlockSpec((1, block_k), lambda bi, j: (bi, j)),
+        pl.BlockSpec((1, block_k), lambda bi, qi, kj, vl_ref: kv_index(bi, qi, kj, vl_ref)[:2]),
+        pl.BlockSpec((1, block_k), lambda bi, qi, kj, vl_ref: kv_index(bi, qi, kj, vl_ref)[:2]),
     ]
-    bias_spec = pl.BlockSpec((1, q_rows, block_k), lambda bi, j: (0, 0, j))
     args = (qf, _flat(k), _flat(v))
     if quantized:
-        kernel, in_specs = _decode_q8_kernel, kv_specs + scale_specs + [bias_spec]
+        kernel, in_specs = _decode_q8_kernel, kv_specs + scale_specs
         args += (k_scale.reshape(bh, cap), v_scale.reshape(bh, cap))
     else:
-        kernel, in_specs = _decode_kernel, kv_specs + [bias_spec]
+        kernel, in_specs = _decode_kernel, kv_specs
     out = pl.pallas_call(
-        functools.partial(kernel, sm_scale=sm_scale),
-        grid=(bh, cap // block_k),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, q_rows, d), lambda bi, j: (bi, 0, 0)),
+        functools.partial(
+            kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
+            s=s, rows=rows, window=window,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, q_rows // block_q, cap // block_k),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, block_q, d), lambda bi, qi, kj, vl_ref: (bi, qi, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_q, d), jnp.float32),
+            ],
+        ),
         out_shape=jax.ShapeDtypeStruct((bh, q_rows, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((q_rows, _LANES), jnp.float32),
-            pltpu.VMEM((q_rows, _LANES), jnp.float32),
-            pltpu.VMEM((q_rows, d), jnp.float32),
-        ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")
         ),
         interpret=interpret,
-    )(*args, bias)
+    )(vl, *args)
     return out[:, :rows].reshape(b, hkv, g, s, d).reshape(b, h, s, d)
 
 
@@ -719,14 +783,15 @@ def dequantize_kv(values: jax.Array, scales: jax.Array, dtype: Any = jnp.float32
 
 
 def _decode_q8_kernel(
-    q_ref, k_ref, v_ref, ks_ref, vs_ref, bias_ref, o_ref, m_scr, l_scr, acc_scr,
-    *, sm_scale,
+    vl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale, block_q, block_k, s, rows, window,
 ):
     """:func:`_decode_kernel` over int8 K/V blocks: dequantize each
     streamed tile in VMEM (one multiply per element) and reuse the
     shared online-softmax update — HBM sees half the bytes."""
-    kj = pl.program_id(1)
-    nk = pl.num_programs(1)
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+    vl = _read_scalar(vl_ref)
 
     @pl.when(kj == 0)
     def _init():
@@ -734,18 +799,26 @@ def _decode_q8_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Dequantize to the query dtype (bf16 in production) so both
-    # dot_generals keep MXU-native input precision with fp32
-    # accumulation — the bf16 rounding of value*scale is the same
-    # order as the int8 quantization error itself.
-    kb = (k_ref[0].astype(jnp.float32) * ks_ref[0][:, None]).astype(q_ref.dtype)
-    sc = jax.lax.dot_general(
-        q_ref[0], kb, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    sc = sc * sm_scale + bias_ref[0]
-    vb = (v_ref[0].astype(jnp.float32) * vs_ref[0][:, None]).astype(q_ref.dtype)
-    _online_softmax_update(sc, vb, m_scr, l_scr, acc_scr)
+    first, last = _decode_block_range(vl, block_k=block_k, s=s, window=window)
+
+    @pl.when((kj >= first) & (kj <= last))
+    def _body():
+        # Dequantize to the query dtype (bf16 in production) so both
+        # dot_generals keep MXU-native input precision with fp32
+        # accumulation — the bf16 rounding of value*scale is the same
+        # order as the int8 quantization error itself.
+        kb = (k_ref[0].astype(jnp.float32) * ks_ref[0][:, None]).astype(q_ref.dtype)
+        sc = jax.lax.dot_general(
+            q_ref[0], kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        visible = _decode_mask(
+            vl, qi, kj, block_q=block_q, block_k=block_k, s=s, rows=rows,
+            window=window,
+        )
+        sc = jnp.where(visible, sc * sm_scale, NEG_INF)
+        vb = (v_ref[0].astype(jnp.float32) * vs_ref[0][:, None]).astype(q_ref.dtype)
+        _online_softmax_update(sc, vb, m_scr, l_scr, acc_scr)
 
     @pl.when(kj == nk - 1)
     def _finalize():
