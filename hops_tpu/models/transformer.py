@@ -135,11 +135,14 @@ class Attention(nn.Module):
             # absolute positions start at this shard's offset.
             pos = pos + jax.lax.axis_index(self.seq_axis) * s
         q, k = rotary_embedding(q, pos), rotary_embedding(k, pos)
-        # Training/full-forward is FLOPs-bound: broadcasting GQA kv
-        # heads here costs memory only at the (short-lived) activation,
-        # while the decode path keeps the small cache and groups
-        # natively in-kernel (decode_attention).
-        k, v = repeat_kv(q, k, v)
+        # Single-chip training/full-forward is FLOPs-bound:
+        # broadcasting GQA kv heads here costs memory only at the
+        # (short-lived) activation. The sequence-parallel impls below
+        # take UN-repeated K/V instead — what rotates the ring / rides
+        # the all-to-alls is Hkv/H of the MHA bytes (ring folds query
+        # groups locally; Ulysses repeats after the reshard).
+        if self.attention_impl in ("flash", "reference"):
+            k, v = repeat_kv(q, k, v)
 
         if self.attention_impl == "flash":
             o = flash_attention(q, k, v, causal=True, window=self.window)

@@ -33,18 +33,25 @@ import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from hops_tpu.ops.attention import NEG_INF, flash_attention
+from hops_tpu.ops.attention import NEG_INF, flash_attention, repeat_kv
 
 
 from hops_tpu.parallel.mesh import pvary as _pvary
 
 
-def _local_scores(q, k, sm_scale, q_offset, k_offset, causal, window=None):
-    """(bh, sq, sk) masked scores for one ring step, fp32."""
+def _local_scores(q, k, sm_scale, q_offset, k_offset, causal, window=None,
+                  s_q: int | None = None):
+    """(bh, rows, sk) masked scores for one ring step, fp32.
+
+    ``s_q``: the true per-device query length when GQA query-head
+    groups are folded into the row dim (rows = g * s_q; row r holds
+    chunk position r % s_q). Defaults to the row count (no folding).
+    """
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * sm_scale
     if causal:
-        q_pos = q_offset + jnp.arange(q.shape[2])[:, None]
+        s_q = s_q or q.shape[2]
+        q_pos = q_offset + jnp.arange(q.shape[2])[:, None] % s_q
         k_pos = k_offset + jnp.arange(k.shape[2])[None, :]
         visible = q_pos >= k_pos
         if window is not None:
@@ -87,11 +94,24 @@ def ring_attention_local(
     are the local ``(batch, heads, seq/ring_size, d)`` shards; only
     named-axis collectives (``ppermute``/``axis_index``) are used, so
     it composes with any outer axes.
+
+    GQA: ``k``/``v`` may carry fewer heads than ``q`` — the UN-repeated
+    kv heads are what rotates the ring, so a GQA model moves
+    ``num_kv_heads/num_heads`` of the MHA ICI bytes. Locally the
+    query-head groups fold into the row dim (as the decode kernel
+    does), so no repeat is ever materialized.
     """
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
     n = ring_size
-    seq_local = q.shape[2]
+    b, h, seq_local, d = q.shape
+    hkv = k.shape[1]
+    if h % hkv:
+        raise ValueError(f"{h} query heads not divisible by {hkv} kv heads")
+    g = h // hkv
+    if g > 1:
+        # (b, h, s, d) -> (b, hkv, g*s, d): row r = group * s + pos.
+        q = q.reshape(b, hkv, g * seq_local, d)
     my_idx = jax.lax.axis_index(axis)
     q32 = q.astype(jnp.float32)
     bh_shape = q.shape[:2] + (q.shape[2],)
@@ -118,7 +138,8 @@ def ring_attention_local(
 
         def fold_chunk(carry):
             s = _local_scores(
-                q32, k_cur, sm_scale, q_offset, k_start, causal, window
+                q32, k_cur, sm_scale, q_offset, k_start, causal, window,
+                s_q=seq_local,
             )
             return _fold(carry, s, v_cur)
 
@@ -148,7 +169,10 @@ def ring_attention_local(
 
     m, l, acc, _, _ = jax.lax.fori_loop(0, n, step, (m0, l0, acc0, k, v))
     l_safe = jnp.where(l == 0.0, 1.0, l)
-    return (acc / l_safe[..., None]).astype(q.dtype)
+    out = (acc / l_safe[..., None]).astype(q.dtype)
+    if g > 1:
+        out = out.reshape(b, h, seq_local, d)
+    return out
 
 
 def ring_attention(
@@ -202,10 +226,22 @@ def ulysses_attention(
     Requires ``heads % mesh.shape[axis] == 0``. Locally each device runs
     full-sequence attention over its head subset (flash kernel when
     shapes allow), so quality-of-fusion matches the single-chip path.
+
+    GQA: when ``num_kv_heads % ring == 0`` too, K/V ride the
+    all-to-alls UN-repeated (``Hkv/H`` of the MHA bytes) and the
+    repeat to the local query-head count happens after the reshard —
+    a local copy, not ICI traffic. An indivisible kv head count
+    repeats before the all-to-all instead (correct, MHA-cost).
     """
     n = mesh.shape[axis]
     if q.shape[1] % n:
         raise ValueError(f"heads {q.shape[1]} not divisible by {axis}={n}")
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"{q.shape[1]} query heads not divisible by {k.shape[1]} kv heads"
+        )
+    if k.shape[1] % n:
+        k, v = repeat_kv(q, k, v)
 
     attn = functools.partial(
         flash_attention if use_flash else _reference_local,
@@ -222,7 +258,9 @@ def ulysses_attention(
         def rev(x):
             return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
 
-        return rev(attn(fwd(q), fwd(k), fwd(v)))
+        q, k, v = fwd(q), fwd(k), fwd(v)
+        k, v = repeat_kv(q, k, v)  # no-op unless GQA kv heads crossed
+        return rev(attn(q, k, v))
 
     spec = P(batch_axis, None, axis, None)
     return shard_map(
