@@ -383,6 +383,36 @@ class TestTrainingDataset:
         b = next(it)
         assert set(b) == {"image", "label"}
 
+    def test_feeder_process_sharded(self, fs):
+        """VERDICT r3 item 6 (single-process leg; the two-process leg is
+        tests/test_multihost_integration.py): process_sharded yields
+        global jax.Arrays assembled via make_array_from_process_local_data
+        and sharded over the mesh; the guard rails reject misuse."""
+        import jax
+        from hops_tpu.parallel import mesh as mesh_lib
+
+        td = self.make_td(fs)
+        mesh = mesh_lib.make_mesh({"data": 4}, devices=jax.devices()[:4])
+        sharding = mesh_lib.batch_sharding(mesh, "data")
+        feeder = td.tf_data(target_name="sales")
+        batches = list(feeder.numpy_iterator(
+            batch_size=4, num_epochs=1, shuffle=False,
+            process_sharded=True, sharding=sharding))
+        assert len(batches) == 1
+        x, y = batches[0]
+        assert isinstance(x, jax.Array) and x.shape == (4, 1)
+        assert x.sharding.spec == jax.sharding.PartitionSpec("data")
+        # Same rows as the plain iterator (1 process -> shard == batch).
+        px, py = next(feeder.numpy_iterator(batch_size=4, shuffle=False))
+        np.testing.assert_allclose(np.asarray(x), px)
+        np.testing.assert_allclose(np.asarray(y), py)
+
+        with pytest.raises(ValueError, match="drop_remainder"):
+            next(feeder.numpy_iterator(
+                batch_size=4, process_sharded=True, drop_remainder=False))
+        with pytest.raises(ValueError, match="process_sharded"):
+            next(feeder.numpy_iterator(batch_size=4, sharding=sharding))
+
     def test_tags(self, fs):
         td = self.make_td(fs)
         td.add_tag("purpose", "unit-test")

