@@ -34,13 +34,13 @@ def test_experiment_launch_cell():
     assert metrics["metric"] == 0.91 and "log" in metrics
 
 
-def test_hdfs_cells():
+def test_hdfs_cells(tmp_path):
     """HopsFSOperations.ipynb verbs through the shim."""
     p = hdfs.project_path("Resources")
     hdfs.mkdir(p)
     hdfs.dump(b"data", p + "/a.bin")
     assert hdfs.load(p + "/a.bin") == b"data"
-    local = hdfs.copy_to_local(p + "/a.bin", ".")
+    local = hdfs.copy_to_local(p + "/a.bin", str(tmp_path))
     assert local.endswith("a.bin")
     assert any(e.endswith("a.bin") for e in hdfs.ls(p))
     assert hdfs.project_name() and hdfs.project_user()

@@ -7,11 +7,14 @@ both in-process and through the jobs control plane.
 
 import sys
 from pathlib import Path
+import pytest
 
 sys.path.insert(0, str(Path(__file__).parent.parent))
 
 from hops_tpu import jobs
 from hops_tpu.jobs import api, dataset
+
+pytestmark = pytest.mark.slow  # heavy compiles / subprocess e2e (fast tier: -m 'not slow')
 
 
 def test_make_builds_site(tmp_path):

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from hops_tpu.models.generation import generate
 from hops_tpu.models.transformer import TransformerLM
@@ -20,6 +21,7 @@ def _model_and_params(seed=0):
     return model, variables["params"]
 
 
+@pytest.mark.slow
 def test_decode_logits_match_full_forward():
     """Cache path must reproduce the dense causal forward exactly."""
     model, params = _model_and_params()
@@ -93,6 +95,7 @@ def test_moe_blocks_inherit_max_decode_len():
     assert key_lens == {TINY["max_decode_len"]}, key_lens
 
 
+@pytest.mark.slow
 def test_long_prefill_kernel_path_matches_full_forward():
     """Prefill with s>1 rides the flash kernel (round 3); at a kernel-eligible
     length it must still reproduce the dense causal forward."""
@@ -159,6 +162,7 @@ def test_eos_none_keeps_previous_behavior():
     np.testing.assert_array_equal(a, b)
 
 
+@pytest.mark.slow
 def test_speculative_matches_greedy():
     """Speculative decoding is lossless: with any draft model the
     output equals the target's own greedy decoding, token for token."""
@@ -184,6 +188,7 @@ def test_speculative_matches_greedy():
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+@pytest.mark.slow
 def test_speculative_with_perfect_draft():
     """Draft == target: every round accepts the cap (k-1 drafts +
     bonus) and the output still matches greedy exactly."""
@@ -213,6 +218,7 @@ def test_speculative_rejects_bad_args():
                              max_new_tokens=8, k=1)
 
 
+@pytest.mark.slow
 def test_int8_cache_decode_close_to_fp_cache():
     """kv_cache_dtype='int8': decode logits track the fp-cache decode
     within quantization tolerance, and greedy generation still emits
@@ -249,6 +255,7 @@ def test_int8_cache_decode_close_to_fp_cache():
     assert bool(((out >= 0) & (out < 64)).all())
 
 
+@pytest.mark.slow
 def test_speculative_matches_greedy_with_int8_cache():
     """Losslessness survives cache quantization: with kv_cache_dtype
     ='int8' on both models, speculative output still equals that
@@ -268,6 +275,7 @@ def test_speculative_matches_greedy_with_int8_cache():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
 
+@pytest.mark.slow
 def test_gqa_decode_matches_full_forward():
     """num_kv_heads < num_heads: the cache holds only kv-head slots and
     the grouped decode kernel reproduces the full (repeat-broadcast)
@@ -327,6 +335,7 @@ def test_gqa_decode_matches_full_forward():
     assert float(jnp.max(jnp.abs(q8_step - fp_step))) > 0.0  # really quantized
 
 
+@pytest.mark.slow
 def test_sliding_window_decode_matches_full_forward():
     """window=4: decode-path logits equal the full windowed forward at
     every step (the cache keeps all positions; masking enforces the
@@ -352,6 +361,7 @@ def test_sliding_window_decode_matches_full_forward():
         tok = jnp.argmax(step_logits[:, -1:], axis=-1)
 
 
+@pytest.mark.slow
 def test_all_decode_knobs_compose():
     """The modern-LM preset: GQA + int8 cache + sliding window, decoded
     speculatively — the full knob stack in one model, output identical
@@ -381,6 +391,7 @@ def test_all_decode_knobs_compose():
     np.testing.assert_allclose(logits, full, atol=2e-4, rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_windowed_moe_decode_matches_full_forward():
     """Advisor r3 (medium): window must apply in MoE layers too — the
     decode path and the full forward agree for a windowed MoE model,

@@ -8,6 +8,7 @@ from pathlib import Path
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
@@ -17,6 +18,7 @@ from hops_tpu.parallel import mesh as mesh_lib, sharding as shard_lib  # noqa: E
 REPO_ROOT = Path(__file__).resolve().parent.parent
 
 
+@pytest.mark.slow
 def test_dryrun_cannot_touch_a_poisoned_backend():
     """VERDICT r3 item 1: the r03 MULTICHIP artifact timed out because the
     parent probed ``jax.devices()``, initializing the wedged TPU relay
@@ -71,6 +73,7 @@ def test_dryrun_native_escape_hatch(monkeypatch):
     assert called == [8]
 
 
+@pytest.mark.slow
 def test_entry_is_jittable_small():
     # Full ResNet-50 compile is exercised by the driver; here we check the
     # contract shape cheaply via lowering (no XLA compile).
@@ -103,6 +106,7 @@ class TestShardingRules:
         assert sharded["w"].sharding.spec == jax.sharding.PartitionSpec("model", None)
 
 
+@pytest.mark.slow
 def test_bn_train_step():
     from hops_tpu.models import common
     from hops_tpu.models.resnet import ResNet18ish

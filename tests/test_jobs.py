@@ -11,6 +11,8 @@ from hops_tpu.jobs import api, dag, dataset, streaming
 from hops_tpu.messaging import pubsub
 from hops_tpu.runtime import fs
 
+pytestmark = pytest.mark.slow  # heavy compiles / subprocess e2e (fast tier: -m 'not slow')
+
 
 def _write_app(tmp_path, body: str, name="app.py") -> str:
     p = tmp_path / name

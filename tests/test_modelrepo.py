@@ -275,6 +275,7 @@ class TestStandaloneServing:
         )
         serving.create_or_update(name, model_path=str(tmp_path), model_server="PYTHON")
 
+    @pytest.mark.slow
     def test_standalone_serving_outlives_its_creator(self, tmp_path, workspace):
         import os
         import subprocess

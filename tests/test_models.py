@@ -3,11 +3,14 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from hops_tpu.models import common
 from hops_tpu.models.mnist import CNN, FFN
 from hops_tpu.models.resnet import ResNet18ish, ResNet50
 from hops_tpu.models.widedeep import WideAndDeep, make_taxi_batch
+
+pytestmark = pytest.mark.slow  # heavy compiles / subprocess e2e (fast tier: -m 'not slow')
 
 
 class TestMnistModels:

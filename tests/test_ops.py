@@ -22,6 +22,7 @@ def test_flash_matches_reference(causal):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_flash_grads_match_reference(causal):
     q, k, v = _inputs(batch=1, heads=2, seq=128, d=32)
@@ -104,6 +105,7 @@ def test_causal_cross_length_in_kernel(monkeypatch, seq_q, seq_k):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_causal_cross_length_grads():
     q, _, _ = _inputs(batch=1, heads=2, seq=128, d=32)
     _, k, v = _inputs(batch=1, heads=2, seq=256, d=32, seed=1)
@@ -315,6 +317,7 @@ def test_sliding_window_flash_matches_reference(window):
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_sliding_window_grads_match_reference(window=96):
     q, k, v = _inputs(batch=1, heads=2, seq=256, d=32)
 
@@ -337,6 +340,7 @@ def test_sliding_window_requires_causal():
         flash_attention(q, k, v, causal=False, window=64)
 
 
+@pytest.mark.slow
 def test_sliding_window_decode_matches_reference():
     from hops_tpu.ops.attention import decode_attention, decode_attention_reference
 
@@ -353,6 +357,7 @@ def test_sliding_window_decode_matches_reference():
 # -- decode kernel: large warm-cache appends + valid-proportional DMA --------
 
 
+@pytest.mark.slow
 def test_decode_large_warm_append_stays_on_kernel(monkeypatch):
     """VERDICT r3 item 8: chunk appends past 64 rows used to silently
     fall back to the O(s*capacity) XLA reference; the q-row-blocked
@@ -378,6 +383,7 @@ def test_decode_large_warm_append_stays_on_kernel(monkeypatch):
         np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
 
 
+@pytest.mark.slow
 def test_decode_large_warm_append_gqa_and_q8(monkeypatch):
     """rows = g*s > 64 with GQA folding and the int8 cache: both land on
     the blocked kernel (fallback poisoned) and match the reference."""
@@ -405,6 +411,7 @@ def test_decode_large_warm_append_gqa_and_q8(monkeypatch):
     np.testing.assert_allclose(out8, ref, atol=0.05, rtol=0.05)
 
 
+@pytest.mark.slow
 def test_decode_large_warm_append_windowed(monkeypatch):
     """Sliding window composes with the q-row-blocked append path
     (fallback poisoned, as above)."""

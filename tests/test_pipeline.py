@@ -9,6 +9,8 @@ from hops_tpu.models.moe import sum_sown_losses
 from hops_tpu.parallel import mesh as mesh_lib
 from hops_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 
+pytestmark = pytest.mark.slow  # heavy compiles / subprocess e2e (fast tier: -m 'not slow')
+
 STAGES = 4
 DIM = 16
 

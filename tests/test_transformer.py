@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from hops_tpu.models import common
 from hops_tpu.models.transformer import TransformerLM, make_lm_train_step
@@ -47,6 +48,7 @@ def test_flash_and_reference_impls_agree():
     )
 
 
+@pytest.mark.slow
 def test_ring_impl_matches_reference_on_mesh():
     mesh = mesh_lib.make_mesh({"seq": 4}, devices=jax.devices()[:4])
     tokens = _tokens(batch=1, seq=128)
@@ -58,6 +60,7 @@ def test_ring_impl_matches_reference_on_mesh():
     )
 
 
+@pytest.mark.slow
 def test_remat_matches_plain():
     tokens = _tokens(seq=32)
     plain = TransformerLM(**TINY, attention_impl="reference")
