@@ -124,9 +124,61 @@ def run_bench(
     }
 
 
+def probe_tpu(timeout_s: int = 120) -> dict:
+    """Cheaply answer "is the TPU reachable?" without risking a wedge.
+
+    The relay is single-tenant and killed clients can wedge it
+    (BENCHMARKS.md operational note), so the probe runs a tiny matmul
+    in a SUBPROCESS: on timeout the parent stops waiting but lets the
+    child run to completion/exit on its own (never killed mid-
+    handshake). This is how a recovered relay is detected so the real
+    bench can re-measure — the smoke path stays CPU-pinned and would
+    never notice recovery on its own.
+    """
+    import subprocess
+    import sys
+    import tempfile
+
+    out = Path(tempfile.mkdtemp()) / "probe.json"
+    code = (
+        "import json, time, sys\n"
+        "t0 = time.time()\n"
+        "try:\n"
+        "    import jax, jax.numpy as jnp\n"
+        "    x = jnp.ones((256, 256), jnp.bfloat16)\n"
+        "    v = float((x @ x).sum())\n"
+        "    r = {'ok': True, 'platform': jax.devices()[0].platform,\n"
+        "         'elapsed_s': round(time.time() - t0, 1)}\n"
+        "except Exception as e:\n"
+        "    r = {'ok': False, 'error': repr(e)[:300],\n"
+        "         'elapsed_s': round(time.time() - t0, 1)}\n"
+        f"open({str(out)!r}, 'w').write(json.dumps(r))\n"
+        "print(json.dumps(r))\n"
+    )
+    # The child must not inherit our stdout/stderr: a still-running
+    # child would hold the caller's pipes open past our return.
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        proc.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # Deliberately NOT killed: detach and report unreachable.
+        return {"ok": False, "error": f"probe still hung after {timeout_s}s "
+                "(child left to exit on its own; relay likely wedged)"}
+    if out.exists():
+        return json.loads(out.read_text())
+    return {"ok": False, "error": f"probe exited rc={proc.returncode} without a result"}
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--smoke", action="store_true", help="tiny CPU-safe run")
+    parser.add_argument(
+        "--probe", action="store_true",
+        help="subprocess TPU health check (never wedges); prints one JSON line",
+    )
     parser.add_argument("--batch", type=int, default=128, help="per-chip batch size")
     parser.add_argument("--steps", type=int, default=32)
     parser.add_argument(
@@ -138,6 +190,10 @@ def main() -> None:
         "(see RUNBOOK_v5e64.md)",
     )
     args = parser.parse_args()
+
+    if args.probe:
+        print(json.dumps({"metric": "tpu_probe", **probe_tpu()}))
+        return
 
     if args.smoke:
         # The smoke run is documented CPU-safe; pin it there so it
