@@ -69,16 +69,49 @@ class HopsFSConnector(StorageConnector):
 
 
 class S3Connector(StorageConnector):
-    """S3 bucket. Functional when the bucket is locally mounted (FUSE) via
-    ``options["mount_point"]``; otherwise read() is gated on boto3."""
+    """S3 bucket (reference ingest role:
+    S3-Ingest-to-Feature-Store-basics.ipynb:100).
+
+    Reads accept both bucket-relative keys and full ``s3://bucket/key``
+    URIs. The byte source is ``options["mount_point"]`` — a local
+    directory standing in for the bucket root (FUSE mount in
+    production, an injected fixture dir in tests), so the whole
+    resolve→read→ingest path executes without network egress. A URI
+    naming a different bucket, or a read with no mount configured,
+    raises honestly.
+    """
+
+    def resolve(self, path: str | None = None) -> Path:
+        mount = self.options.get("mount_point")
+        if not mount:
+            raise RuntimeError(
+                f"S3 connector {self.name!r}: no mount_point configured and "
+                "no S3 client library in this image; mount the bucket or "
+                "copy locally")
+        key = path or ""
+        if key.startswith("s3://") or key.startswith("s3a://"):
+            rest = key.split("://", 1)[1]
+            uri_bucket, _, key = rest.partition("/")
+            if not self.bucket:
+                raise ValueError(
+                    f"S3 connector {self.name!r} has no bucket configured; "
+                    "cannot validate URI reads — pass a bucket-relative key "
+                    "or create the connector with bucket=...")
+            if uri_bucket != self.bucket:
+                raise ValueError(
+                    f"S3 connector {self.name!r} is bound to bucket "
+                    f"{self.bucket!r}, not {uri_bucket!r}")
+        # Keys are bucket-relative by definition: anchor them under the
+        # mount and refuse escapes (absolute keys, '..' traversal).
+        root = Path(mount).resolve()
+        target = (root / key.lstrip("/")).resolve()
+        if root != target and root not in target.parents:
+            raise ValueError(
+                f"S3 key {path!r} escapes the mounted bucket root {root}")
+        return target
 
     def read(self, query=None, data_format=None, path=None) -> pd.DataFrame:
-        mount = self.options.get("mount_point")
-        if mount:
-            return _read_path(Path(mount) / (path or ""), data_format)
-        raise RuntimeError(
-            f"S3 connector {self.name!r}: no mount_point configured and no S3 "
-            "client library in this image; mount the bucket or copy locally")
+        return _read_path(self.resolve(path), data_format)
 
     @property
     def bucket(self) -> str:
