@@ -79,12 +79,30 @@ class TrainingDataset:
     # -- identity -------------------------------------------------------------
 
     @property
-    def dir(self):
+    def meta_dir(self):
+        """Workspace registry entry — metadata always lives here so
+        ``get_training_dataset`` finds connector-backed TDs too."""
         return storage.entity_dir(_KIND, self.name, self.version)
 
     @property
+    def dir(self):
+        """Data root: the workspace by default, or the storage
+        connector's resolved directory when one is set (reference:
+        training_datasets.ipynb cell 12 saves a TD through an S3
+        connector)."""
+        if self.storage_connector is not None:
+            if not hasattr(self.storage_connector, "resolve"):
+                raise ValueError(
+                    f"storage connector {self.storage_connector.name!r} "
+                    f"({self.storage_connector.type}) cannot host training "
+                    "datasets: only path-backed connectors (HOPSFS, mounted "
+                    "S3) materialize files")
+            return self.storage_connector.resolve(f"{self.name}_{self.version}")
+        return self.meta_dir
+
+    @property
     def features(self) -> list[Feature]:
-        if not self._features and (self.dir / "metadata.json").exists():
+        if not self._features and (self.meta_dir / "metadata.json").exists():
             self._load_meta()
         return self._features
 
@@ -92,7 +110,7 @@ class TrainingDataset:
     def query(self) -> Query | None:
         """Replay of the query this TD was built from (reference:
         ``td.query``, training_datasets.ipynb cell 14)."""
-        if self._query_dict is None and (self.dir / "metadata.json").exists():
+        if self._query_dict is None and (self.meta_dir / "metadata.json").exists():
             self._load_meta()
         if self._query_dict is None:
             return None
@@ -102,7 +120,7 @@ class TrainingDataset:
         return f"TrainingDataset({self.name!r}, version={self.version}, format={self.data_format})"
 
     def _save_meta(self) -> None:
-        storage.write_metadata(self.dir, {
+        storage.write_metadata(self.meta_dir, {
             "name": self.name,
             "version": self.version,
             "description": self.description,
@@ -112,13 +130,16 @@ class TrainingDataset:
             "label": self.label,
             "coalesce": self.coalesce,
             "train_split": self.train_split,
+            "storage_connector": getattr(self.storage_connector, "name", None),
             "features": [f.to_dict() for f in self._features],
             "query": self._query_dict,
-            "tags": {},
+            # A re-save must not wipe tags set via add_tag.
+            "tags": (storage.read_metadata(self.meta_dir).get("tags", {})
+                     if (self.meta_dir / "metadata.json").exists() else {}),
         })
 
     def _load_meta(self) -> None:
-        meta = storage.read_metadata(self.dir)
+        meta = storage.read_metadata(self.meta_dir)
         self.description = meta.get("description", "")
         self.data_format = meta.get("data_format", "parquet")
         self.splits = meta.get("splits", {})
@@ -126,6 +147,9 @@ class TrainingDataset:
         self.label = meta.get("label", [])
         self.coalesce = meta.get("coalesce", False)
         self.train_split = meta.get("train_split")
+        sc = meta.get("storage_connector")
+        if sc and self.storage_connector is None:
+            self.storage_connector = self._fs.get_storage_connector(sc)
         self._features = [Feature.from_dict(f) for f in meta.get("features", [])]
         self._query_dict = meta.get("query")
 
@@ -145,7 +169,7 @@ class TrainingDataset:
         self._save_meta()
         if self.statistics_config.enabled:
             stats_mod.save_statistics(
-                self.dir, "all", stats_mod.compute_statistics(df, self.statistics_config))
+                self.meta_dir, "all", stats_mod.compute_statistics(df, self.statistics_config))
         return self
 
     def insert(self, data: Query | pd.DataFrame, overwrite: bool = True,
@@ -261,7 +285,7 @@ class TrainingDataset:
         return self.read(split=split).head(n)
 
     def get_statistics(self) -> dict:
-        return stats_mod.load_statistics(self.dir)
+        return stats_mod.load_statistics(self.meta_dir)
 
     # -- feeding (td.tf_data twin) --------------------------------------------
 
@@ -323,26 +347,34 @@ class TrainingDataset:
     # -- tags -----------------------------------------------------------------
 
     def add_tag(self, name: str, value: Any) -> None:
-        meta = storage.read_metadata(self.dir)
+        meta = storage.read_metadata(self.meta_dir)
         meta.setdefault("tags", {})[name] = value
-        storage.write_metadata(self.dir, meta)
+        storage.write_metadata(self.meta_dir, meta)
 
     def get_tag(self, name: str) -> Any:
-        return storage.read_metadata(self.dir).get("tags", {}).get(name)
+        return storage.read_metadata(self.meta_dir).get("tags", {}).get(name)
 
     def get_tags(self) -> dict:
-        return storage.read_metadata(self.dir).get("tags", {})
+        return storage.read_metadata(self.meta_dir).get("tags", {})
 
     def delete_tag(self, name: str) -> None:
-        meta = storage.read_metadata(self.dir)
+        meta = storage.read_metadata(self.meta_dir)
         meta.get("tags", {}).pop(name, None)
-        storage.write_metadata(self.dir, meta)
+        storage.write_metadata(self.meta_dir, meta)
 
     def delete(self) -> None:
         import shutil
 
-        if self.dir.exists():
-            shutil.rmtree(self.dir)
+        dirs = {self.meta_dir}
+        try:
+            dirs.add(self.dir)
+        except (ValueError, RuntimeError):
+            # Unresolvable connector (SQL-typed, or mount absent on this
+            # host): the registry entry must still be removable.
+            pass
+        for d in dirs:
+            if d.exists():
+                shutil.rmtree(d)
 
 
 # -- format codecs ------------------------------------------------------------

@@ -520,6 +520,36 @@ class TestConnectors:
         td.save(fg.select_all())
         assert len(td.read()) == 3
 
+    def test_training_dataset_saved_through_s3_connector(self, fs, tmp_path):
+        """training_datasets.ipynb cell 12: a TD materializes into the
+        connector's storage, not the workspace; the registry still finds
+        it, read/feeder work, and the connector restores on reload."""
+        bucket = tmp_path / "td-bucket"
+        bucket.mkdir()
+        fs.create_storage_connector(
+            "tdsink", "S3", bucket="td-bucket", mount_point=str(bucket))
+        fg = make_fg(fs)
+        td = fs.create_training_dataset(
+            "s3td", version=1, label=["sales"],
+            storage_connector=fs.get_storage_connector("tdsink"))
+        td.save(fg.select(["store_id", "sales"]))
+        # Files live under the bucket, not the workspace registry entry.
+        assert (bucket / "s3td_1" / "data").exists()
+        assert not (td.meta_dir / "data").exists()
+
+        again = fs.get_training_dataset("s3td", 1)
+        assert again.storage_connector.name == "tdsink"
+        assert len(again.read()) == 4
+        x, y = again.tf_data(target_name="sales").numpy_arrays()
+        assert x.shape == (4, 1) and y.shape == (4,)
+
+    def test_training_dataset_rejects_sql_connector_sink(self, fs):
+        fs.create_storage_connector("wh", "SNOWFLAKE", url="u")
+        td = fs.create_training_dataset(
+            "whtd", version=1, storage_connector=fs.get_storage_connector("wh"))
+        with pytest.raises(ValueError, match="cannot host"):
+            td.save(pd.DataFrame({"a": [1]}))
+
     def test_s3_connector_without_mount_raises(self, fs):
         fs.create_storage_connector("far", "S3", bucket="remote-only")
         with pytest.raises(RuntimeError, match="mount"):
@@ -758,3 +788,24 @@ class TestScalaBuilderErgonomics:
 
         conn = HopsworksConnection.builder.build()
         assert conn.get_feature_store().getName()
+
+
+class TestTrainingDatasetConnectorRegressions:
+    """Review findings on the connector-backed TD data root."""
+
+    def test_delete_with_unresolvable_connector_removes_registry(self, fs):
+        fs.create_storage_connector("wh2", "SNOWFLAKE", url="u")
+        td = fs.create_training_dataset(
+            "whtd2", version=1, storage_connector=fs.get_storage_connector("wh2"))
+        td._save_meta()
+        assert (td.meta_dir / "metadata.json").exists()
+        td.delete()  # must not raise despite the unresolvable data dir
+        assert not td.meta_dir.exists()
+
+    def test_resave_preserves_tags(self, fs):
+        fg = make_fg(fs)
+        td = fs.create_training_dataset("tagged", version=1, label=["sales"])
+        td.save(fg.select(["store_id", "sales"]))
+        td.add_tag("owner", "ml-team")
+        td.insert(fg.select(["store_id", "sales"]))  # re-save path
+        assert fs.get_training_dataset("tagged", 1).get_tag("owner") == "ml-team"
