@@ -37,6 +37,12 @@ def main() -> None:
         "--window", type=int, default=None,
         help="sliding-window causal attention width",
     )
+    parser.add_argument(
+        "--valid-sweep", action="store_true",
+        help="time raw decode_attention vs valid_len at fixed capacity: "
+        "flat times mean capacity-proportional DMA, linear-in-valid times "
+        "confirm the scalar-prefetch clamp (BENCHMARKS.md round 4)",
+    )
     args = parser.parse_args()
 
     import jax
@@ -45,6 +51,10 @@ def main() -> None:
     from hops_tpu.models.generation import generate
     from hops_tpu.models.transformer import TransformerLM
     from hops_tpu.runtime import diagnostics
+
+    if args.valid_sweep:
+        _valid_sweep(args)
+        return
 
     model = TransformerLM(
         vocab_size=32000,
@@ -97,6 +107,62 @@ def main() -> None:
             f"{r['ms']:7.3f} ms  {r['tflops_per_s']:6.2f} TF/s {r['gb']:7.3f} GB  "
             f"x{r['count']:4d} {r['category'][:18]:18s} {r['source'].split('/')[-1][:40]}"
         )
+
+
+def _valid_sweep(args) -> None:
+    """Step time of the raw decode kernel as valid_len grows, capacity
+    fixed. The round-4 kernel clamps its K/V index maps to the valid
+    prefix (ops/attention.py), so HBM traffic — and on a
+    bandwidth-bound chip, time — should scale with valid_len where the
+    round-3 kernel was flat at the capacity cost."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hops_tpu.ops.attention import decode_attention
+
+    b, h, d, cap = args.batch, 8, args.d_model // 8, args.max_decode_len
+    hkv = args.kv_heads or h
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(b, h, 1, d), jnp.bfloat16)
+    k = jnp.asarray(rs.randn(b, hkv, cap, d), jnp.bfloat16)
+    v = jnp.asarray(rs.randn(b, hkv, cap, d), jnp.bfloat16)
+
+    n_steps = 64
+
+    @jax.jit
+    def steps(vl):
+        def body(acc, _):
+            return acc + decode_attention(
+                q, k, v, vl, window=args.window
+            ).astype(jnp.float32).sum(), None
+
+        out, _ = jax.lax.scan(body, jnp.float32(0), None, length=n_steps)
+        return out
+
+    print(f"valid-len sweep @ capacity {cap} "
+          f"(b={b}, kv_heads={hkv}, d={d}, window={args.window}):")
+    header_done = False
+    from hops_tpu.ops.attention import _decode_block_range, _fit_block
+
+    block_k = _fit_block(cap, 512)
+    for frac in (1 / 16, 1 / 8, 1 / 4, 1 / 2, 1.0):
+        vl = jnp.int32(max(1, int(cap * frac)))
+        _ = float(steps(vl))  # compile once; later vls reuse (traced scalar)
+        t0 = time.perf_counter()
+        _ = float(steps(vl))
+        dt = (time.perf_counter() - t0) / n_steps
+        if not header_done:
+            print(f"{'valid':>8} {'us/step':>10} {'GB touched':>11}")
+            header_done = True
+        # Bytes the kernel actually streams: the clamped block range
+        # (validity from above, window from below), not raw valid_len.
+        first, last = _decode_block_range(
+            int(vl), block_k=block_k, s=1, window=args.window)
+        touched = (int(last) - int(first) + 1) * block_k
+        bytes_per_elem = 2  # bf16 K and V tiles
+        gb = 2 * b * hkv * touched * d * bytes_per_elem / 1e9
+        print(f"{int(vl):>8} {dt * 1e6:>10.1f} {gb:>11.4f}")
 
 
 if __name__ == "__main__":

@@ -28,6 +28,25 @@ if TYPE_CHECKING:
     from hops_tpu.featurestore.connection import FeatureStore
 
 _KIND = "trainingdatasets"
+
+
+class _MissingConnector:
+    """Stand-in for a storage connector recorded in TD metadata but
+    absent from the connector registry. ``resolve`` raises (so reads
+    fail with the real cause) with the RuntimeError that
+    ``TrainingDataset.delete`` tolerates."""
+
+    type = "MISSING"
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def resolve(self, path: str | None = None):
+        raise RuntimeError(
+            f"storage connector {self.name!r} is recorded in this training "
+            "dataset's metadata but missing from the connector registry; "
+            "recreate it with fs.create_storage_connector to read the data")
+
 # - petastorm: schema'd columnar with tensor columns + row-group reader
 #   (featurestore/columnar.py; reference PetastormHelloWorld.ipynb:21-44)
 # - delta: transactional commit-log materialization with append/overwrite
@@ -149,7 +168,13 @@ class TrainingDataset:
         self.train_split = meta.get("train_split")
         sc = meta.get("storage_connector")
         if sc and self.storage_connector is None:
-            self.storage_connector = self._fs.get_storage_connector(sc)
+            try:
+                self.storage_connector = self._fs.get_storage_connector(sc)
+            except KeyError:
+                # Registry entry gone (wiped registry, partial workspace
+                # copy): keep the TD loadable — and deletable — with a
+                # sentinel that names the problem on any data access.
+                self.storage_connector = _MissingConnector(sc)
         self._features = [Feature.from_dict(f) for f in meta.get("features", [])]
         self._query_dict = meta.get("query")
 

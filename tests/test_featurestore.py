@@ -809,3 +809,25 @@ class TestTrainingDatasetConnectorRegressions:
         td.add_tag("owner", "ml-team")
         td.insert(fg.select(["store_id", "sales"]))  # re-save path
         assert fs.get_training_dataset("tagged", 1).get_tag("owner") == "ml-team"
+
+    def test_load_with_missing_connector_registry_entry(self, fs, tmp_path):
+        """Registry wiped after a connector-backed TD was saved: the TD
+        must still load (for inspection) and delete; reads name the
+        missing connector."""
+        bucket = tmp_path / "gone-bucket"
+        bucket.mkdir()
+        fs.create_storage_connector(
+            "gonesink", "S3", bucket="gone-bucket", mount_point=str(bucket))
+        fg = make_fg(fs, name="gsales")
+        td = fs.create_training_dataset(
+            "gtd", version=1, storage_connector=fs.get_storage_connector("gonesink"))
+        td.save(fg.select(["store_id", "sales"]))
+
+        from hops_tpu.featurestore import connectors as conn_mod
+        conn_mod._registry_path().write_text("{}")  # registry wiped
+
+        again = fs.get_training_dataset("gtd", 1)
+        with pytest.raises(RuntimeError, match="missing from the connector"):
+            again.read()
+        again.delete()  # must not raise
+        assert not again.meta_dir.exists()
