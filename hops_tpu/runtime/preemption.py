@@ -1,0 +1,176 @@
+"""Preemption-safe training: cooperative SIGTERM handling + resume.
+
+TPU pods are preemptible infrastructure: maintenance events and
+scheduler evictions deliver SIGTERM with a grace window. The reference
+has no story here (a killed run restarts from scratch — SURVEY.md §5
+"no auto-resume of a killed run"). The TPU-native pattern is
+cooperative: a signal cannot safely interrupt a dispatched XLA program,
+so the handler only sets a flag and the training loop checks it at
+step boundaries — checkpoint, then exit cleanly, and the restarted job
+resumes via :func:`hops_tpu.runtime.checkpoint.restore_or_init`.
+
+Multihost: a maintenance event may SIGTERM hosts at slightly different
+times, but every process must leave the collective at the SAME step or
+the stragglers deadlock in their next all-reduce. ``should_stop
+(sync=True)`` agrees globally (any-host max over a tiny device
+all-reduce), so the loop exits coherently.
+
+    guard = PreemptionGuard()
+    state, start = checkpoint.restore_or_init(state)
+    with CheckpointManager() as ckpt:
+        for step in range(start, num_steps):
+            state, metrics = train_step(state, batch)
+            if guard.should_stop(sync=jax.process_count() > 1):
+                ckpt.save(step, state, force=True)
+                break
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any
+
+from hops_tpu.runtime.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class PreemptionGuard:
+    """Flag-based cooperative preemption notice.
+
+    Installs handlers for ``signals`` (default SIGTERM) that set a
+    thread-safe flag and chain to any previous handler. The training
+    loop polls :meth:`should_stop` at step boundaries; nothing is
+    interrupted mid-dispatch. Use as a context manager (or call
+    :meth:`uninstall`) to restore the previous handlers.
+    """
+
+    def __init__(self, signals: tuple = (signal.Signals.SIGTERM,), install: bool = True):
+        self._flag = threading.Event()
+        self._signals = tuple(signals)
+        self._previous: dict[Any, Any] = {}
+        if install:
+            self.install()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def install(self) -> "PreemptionGuard":
+        if self._previous:
+            return self  # already installed: re-chaining would make the
+            # handler its own "previous" and recurse on delivery
+        for sig in self._signals:
+            self._previous[sig] = signal.signal(sig, self._handler)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._previous.items():
+            signal.signal(sig, prev)
+        self._previous.clear()
+
+    def __enter__(self) -> "PreemptionGuard":
+        if not self._previous:
+            self.install()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    def _handler(self, signum, frame) -> None:
+        log.warning("preemption notice (signal %s): will stop at the next "
+                    "step boundary", signum)
+        self._flag.set()
+        prev = self._previous.get(signum)
+        if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+            prev(signum, frame)
+
+    # -- polling -------------------------------------------------------------
+
+    def notice(self) -> None:
+        """Programmatic preemption (tests, external watchers)."""
+        self._flag.set()
+
+    def should_stop(self, sync: bool = False) -> bool:
+        """True once a preemption notice arrived.
+
+        ``sync=True``: agree across ALL processes (any-host max) so a
+        multihost loop exits at one coherent step boundary. Costs one
+        tiny all-reduce — poll every step (it rides the step's existing
+        dispatch cadence) or every k steps on latency-critical loops.
+        """
+        import jax
+
+        local = self._flag.is_set()
+        if not sync or jax.process_count() == 1:
+            return local
+        from jax.experimental import multihost_utils
+        import numpy as np
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([local], dtype=np.int32))
+        agreed = bool(flags.max())
+        if agreed and not local:
+            log.warning("another host was preempted: stopping at this "
+                        "step boundary")
+            self._flag.set()
+        return agreed
+
+
+def run_preemptible(
+    train_step,
+    state: Any,
+    batches,
+    *,
+    directory: str | None = None,
+    save_every: int = 100,
+    sync: bool | None = None,
+    guard: PreemptionGuard | None = None,
+):
+    """Checkpointed, preemption-safe training loop.
+
+    Resumes from the latest checkpoint under ``directory`` (the active
+    run's ``checkpoints/`` by default), steps through ``batches``
+    (an iterable; steps already completed before resume are skipped),
+    saves every ``save_every`` steps, and on preemption saves once more
+    and returns early. Returns ``(state, last_metrics, completed_steps)``.
+    """
+    import jax
+
+    from hops_tpu.runtime.checkpoint import CheckpointManager, restore_or_init
+
+    own_guard = guard is None
+    guard = guard or PreemptionGuard()
+    if sync is None:
+        sync = jax.process_count() > 1
+    state, start = restore_or_init(state, directory)
+    metrics = None
+    step = start - 1
+    try:
+        with CheckpointManager(directory, save_interval_steps=save_every) as ckpt:
+            saved = ran = False
+            for step, batch in enumerate(batches):
+                if step < start:
+                    continue  # consumed by a previous incarnation
+                ran = True
+                state, metrics = train_step(state, batch)
+                saved = ckpt.save(step, state)  # interval save
+                if guard.should_stop(sync=sync):
+                    if not saved:
+                        # orbax refuses to overwrite an existing step
+                        # even with force=True — only save if the
+                        # interval save didn't just write this step.
+                        ckpt.save(step, state, force=True)
+                    log.warning("preempted: checkpointed step %d, exiting "
+                                "cleanly", step)
+                    break
+            else:
+                # Normal completion: make the final state durable too —
+                # otherwise up to save_every-1 finished steps would be
+                # redone by the next incarnation after a hard kill.
+                if ran and not saved:
+                    ckpt.save(step, state, force=True)
+            ckpt.wait()
+    finally:
+        if own_guard:
+            guard.uninstall()
+    return state, metrics, step + 1

@@ -101,3 +101,138 @@ def test_trace_writes_into_rundir(tmp_path):
     import os
 
     assert os.listdir(target)
+
+
+# -- preemption-safe training (runtime/preemption.py) ------------------------
+
+
+def test_preemption_guard_catches_sigterm():
+    import os
+    import signal
+
+    from hops_tpu.runtime.preemption import PreemptionGuard
+
+    with PreemptionGuard() as guard:
+        assert not guard.should_stop()
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.05)
+        assert guard.should_stop()
+    # Uninstalled: default disposition restored.
+    assert signal.getsignal(signal.SIGTERM) != guard._handler
+
+
+def test_preemption_guard_chains_previous_handler():
+    import os
+    import signal
+
+    from hops_tpu.runtime.preemption import PreemptionGuard
+
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    try:
+        with PreemptionGuard() as guard:
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.05)
+            assert guard.should_stop() and seen == [signal.SIGTERM]
+        assert signal.getsignal(signal.SIGTERM) is not None
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_run_preemptible_checkpoints_and_resumes(tmp_path):
+    """Preemption mid-run saves at the step boundary and exits; a second
+    incarnation resumes from there and finishes the epoch."""
+    from hops_tpu.runtime.preemption import PreemptionGuard, run_preemptible
+
+    step_fn = jax.jit(common.make_train_step())
+    rs = np.random.RandomState(0)
+    batches = [
+        {"image": rs.rand(2, 28, 28, 1).astype(np.float32),
+         "label": rs.randint(0, 10, 2)}
+        for _ in range(6)
+    ]
+
+    guard = PreemptionGuard(install=False)
+    calls = []
+
+    def preempting_step(state, batch):
+        calls.append(1)
+        if len(calls) == 3:
+            guard.notice()  # delivered "mid-step"; honored at the boundary
+        return step_fn(state, batch)
+
+    state, metrics, done = run_preemptible(
+        preempting_step, _state(), batches,
+        directory=str(tmp_path / "ck"), save_every=100, guard=guard)
+    assert done == 3 and len(calls) == 3
+    assert np.isfinite(float(metrics["loss"]))
+    with checkpoint.CheckpointManager(tmp_path / "ck", async_save=False) as mgr:
+        assert mgr.latest_step() == 2  # the boundary it was preempted at
+
+    # Second incarnation: skips steps 0-2, finishes 3-5.
+    state2, metrics2, done2 = run_preemptible(
+        step_fn, _state(), batches, directory=str(tmp_path / "ck"),
+        save_every=100, guard=PreemptionGuard(install=False))
+    assert done2 == 6
+    assert int(state2.step) == 6  # 3 restored + 3 new optimizer steps
+
+
+def test_run_preemptible_preempt_on_interval_step(tmp_path):
+    """Review regression: preemption landing on a step the interval save
+    just wrote must not re-save (orbax raises StepAlreadyExistsError on
+    overwrite, even with force=True)."""
+    from hops_tpu.runtime.preemption import PreemptionGuard, run_preemptible
+
+    step_fn = jax.jit(common.make_train_step())
+    rs = np.random.RandomState(0)
+    batches = [
+        {"image": rs.rand(2, 28, 28, 1).astype(np.float32),
+         "label": rs.randint(0, 10, 2)}
+        for _ in range(4)
+    ]
+    guard = PreemptionGuard(install=False)
+
+    def step_then_preempt(state, batch):
+        guard.notice()  # every step coincides with save_every=1
+        return step_fn(state, batch)
+
+    state, _, done = run_preemptible(
+        step_then_preempt, _state(), batches,
+        directory=str(tmp_path / "ck"), save_every=1, guard=guard)
+    assert done == 1  # stopped at the first boundary, no crash
+
+
+def test_run_preemptible_final_state_is_durable(tmp_path):
+    """Review regression: normal completion checkpoints the last step
+    even when it falls between save_every intervals."""
+    from hops_tpu.runtime.preemption import PreemptionGuard, run_preemptible
+
+    step_fn = jax.jit(common.make_train_step())
+    rs = np.random.RandomState(0)
+    batches = [
+        {"image": rs.rand(2, 28, 28, 1).astype(np.float32),
+         "label": rs.randint(0, 10, 2)}
+        for _ in range(5)
+    ]
+    run_preemptible(step_fn, _state(), batches,
+                    directory=str(tmp_path / "ck"), save_every=100,
+                    guard=PreemptionGuard(install=False))
+    with checkpoint.CheckpointManager(tmp_path / "ck", async_save=False) as mgr:
+        assert mgr.latest_step() == 4
+
+
+def test_preemption_guard_install_is_idempotent():
+    import os
+    import signal
+
+    from hops_tpu.runtime.preemption import PreemptionGuard
+
+    guard = PreemptionGuard()
+    try:
+        guard.install()  # second install must not chain to itself
+        os.kill(os.getpid(), signal.SIGTERM)  # would recurse before the fix
+        time.sleep(0.05)
+        assert guard.should_stop()
+    finally:
+        guard.uninstall()
+    assert signal.getsignal(signal.SIGTERM) != guard._handler

@@ -274,3 +274,101 @@ def test_two_process_feeder_process_sharded(tmp_path):
     expected = [float(f[perm[s:s + 8]].sum()) for s in range(0, 64, 8)]
     got = eval(sums.pop())
     np.testing.assert_allclose(got, expected)
+
+
+PREEMPT_WORKER = """
+import os
+import signal
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hops_tpu import experiment
+from hops_tpu.models import common
+from hops_tpu.models.mnist import FFN
+from hops_tpu.runtime.preemption import PreemptionGuard, run_preemptible
+
+
+def train_fn():
+    from hops_tpu.parallel.strategy import current_strategy
+
+    guard = PreemptionGuard()  # installed before any heavy setup
+    strategy = current_strategy()
+    step_fn = strategy.step(common.make_train_step(), donate_state=False)
+    state = strategy.replicate(common.create_train_state(
+        FFN(dtype=jnp.float32), jax.random.PRNGKey(0), (2, 28, 28, 1)))
+    rs = np.random.RandomState(jax.process_index())
+    n_local = 2 * jax.local_device_count()
+    batches = [strategy.distribute_batch({
+        "image": rs.rand(n_local, 28, 28, 1).astype(np.float32),
+        "label": rs.randint(0, 10, n_local),
+    }) for _ in range(40)]
+
+    calls = []
+
+    def counting_step(st, batch):
+        calls.append(1)
+        # ONLY process 0 is preempted (a real SIGTERM, mid-step 4);
+        # sync=True must stop BOTH processes at the same boundary.
+        if jax.process_index() == 0 and len(calls) == 4:
+            os.kill(os.getpid(), signal.SIGTERM)
+        return step_fn(st, batch)
+
+    ckdir = os.environ["PREEMPT_CKPT_DIR"]
+    state, metrics, done = run_preemptible(
+        counting_step, state, batches, directory=ckdir, save_every=1000,
+        sync=True, guard=guard)
+    return {"metric": float(done), "done": int(done)}
+
+
+path, metrics = experiment.collective_all_reduce(train_fn, name="mh_preempt")
+print(f"PREEMPT_OK proc={jax.process_index()} done={int(metrics['done'])}", flush=True)
+"""
+
+
+def test_two_process_preemption_stops_both_at_same_step(tmp_path):
+    """SIGTERM on ONE host: the sync'd guard stops every process at one
+    coherent step boundary (no straggler deadlocked in a collective),
+    checkpoints, and exits rc=0."""
+    worker = tmp_path / "preempt_worker.py"
+    worker.write_text(PREEMPT_WORKER)
+    port = _free_port()
+    env = dict(os.environ)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "HOPS_TPU_WORKSPACE": str(tmp_path / "ws"),
+            "PREEMPT_CKPT_DIR": str(tmp_path / "ck"),
+            "TF_CPP_MIN_LOG_LEVEL": "3",
+        }
+    )
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, "-m", "hops_tpu.launch",
+                "--platform", "cpu",
+                "--coordinator", f"127.0.0.1:{port}",
+                "--num-processes", "2",
+                "--process-id", str(i),
+                str(worker),
+            ],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=str(Path(__file__).parent.parent),
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    dones = []
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        line = [l for l in out.splitlines() if "PREEMPT_OK" in l]
+        assert line, out
+        dones.append(int(line[0].split("done=")[1]))
+    # Both exited at the SAME boundary, before the batch list ran out.
+    assert dones[0] == dones[1], dones
+    assert 0 < dones[0] < 40, dones

@@ -624,3 +624,25 @@ def test_pp_windowed_moe_lm_matches_dense(stage_mesh):
     dense = model.apply({"params": params}, tokens)
     pp = pipelined_lm_apply(model, params, tokens, stage_mesh)
     np.testing.assert_allclose(pp, dense, atol=1e-4, rtol=1e-4)
+
+
+def test_pp_sp_gqa_windowed_matches_dense():
+    """Composition stack: GQA + sliding window + sequence parallelism
+    INSIDE pipeline stages — the ring_attention_local body folds
+    un-repeated kv-head groups per shard and still honors the window."""
+    from hops_tpu.models.transformer import TransformerLM
+    from hops_tpu.parallel.pipeline import pipelined_lm_apply
+
+    mesh = mesh_lib.make_mesh({"stage": 2, "seq": 2}, devices=jax.devices()[:4])
+    model = TransformerLM(
+        vocab_size=64, d_model=32, num_heads=4, num_layers=4,
+        dtype=jnp.float32, attention_impl="reference", max_decode_len=32,
+        num_kv_heads=2, window=4,
+    )
+    tokens = jax.random.randint(jax.random.PRNGKey(30), (4, 16), 0, 64)
+    params = model.init(jax.random.PRNGKey(31), tokens)["params"]
+    logits = jax.jit(
+        lambda p, t: pipelined_lm_apply(model, p, t, mesh, seq_axis="seq")
+    )(params, tokens)
+    dense = model.apply({"params": params}, tokens)
+    np.testing.assert_allclose(logits, dense, atol=1e-4, rtol=1e-4)
