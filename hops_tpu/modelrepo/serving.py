@@ -92,7 +92,13 @@ def _save_registry(reg: dict[str, dict[str, Any]]) -> None:
 
 
 class FlaxPredictor:
-    """Serves a ``save_flax`` bundle with a jitted apply."""
+    """Serves a ``save_flax`` bundle with a jitted apply.
+
+    Batch sizes are bucketed to the next power of two (padded with the
+    first row, result sliced back): under jit every distinct shape is a
+    separate compile, and a dynamic batcher produces many distinct
+    sizes — bucketing caps the compile count at log2(max_batch).
+    """
 
     def __init__(self, artifact_dir: Path):
         import jax
@@ -105,8 +111,13 @@ class FlaxPredictor:
         self._apply = jax.jit(lambda x: module.apply(variables, x, train=False))
 
     def predict(self, instances: list[Any]) -> list[Any]:
-        x = self._np.asarray(instances, dtype=self._np.float32)
-        return self._np.asarray(self._apply(x)).tolist()
+        np = self._np
+        x = np.asarray(instances, dtype=np.float32)
+        n = len(x)
+        bucket = 1 << max(0, (n - 1)).bit_length()
+        if bucket != n:
+            x = np.concatenate([x, np.broadcast_to(x[:1], (bucket - n, *x.shape[1:]))])
+        return np.asarray(self._apply(x))[:n].tolist()
 
 
 class PythonPredictor:
@@ -141,6 +152,119 @@ def _build_predictor(cfg: dict[str, Any]) -> Any:
     return FlaxPredictor(artifact_dir)
 
 
+# -- dynamic batching ---------------------------------------------------------
+
+
+class DynamicBatcher:
+    """Server-side request batching (TF-Serving's ``enable_batching``).
+
+    Concurrent requests are coalesced: the batcher thread collects
+    instances arriving within ``timeout_ms`` of the first, up to
+    ``max_batch_size`` rows, runs ONE ``predict_fn`` over the
+    concatenation, and splits the predictions back per request. On TPU
+    this turns N concurrent batch-1 dispatches into one batch-N pass —
+    the difference between matvec and matmul on the MXU. Exceptions
+    from ``predict_fn`` propagate to every waiting request of that
+    batch; later batches are unaffected.
+
+    Requests never merge past ``max_batch_size`` (a request that would
+    overflow the cap seeds the next batch instead); a SINGLE request
+    larger than the cap runs alone, unsplit — the caller chose that
+    batch shape explicitly.
+    """
+
+    def __init__(self, predict_fn, max_batch_size: int = 64,
+                 timeout_ms: float = 5.0):
+        import queue
+
+        self._predict = predict_fn
+        self.max_batch_size = max_batch_size
+        self.timeout_s = timeout_ms / 1e3
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stopped = False
+        self.batches_run = 0
+        self.rows_run = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def predict(self, instances: list[Any]) -> list[Any]:
+        from concurrent.futures import Future
+
+        if self._stopped:
+            raise RuntimeError("serving stopped")
+        fut: Future = Future()
+        self._queue.put((list(instances), fut))
+        return fut.result()
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._queue.put(None)
+        self._thread.join(timeout=5)
+        # In-flight handler threads that raced past the _stopped check
+        # may have enqueued after the sentinel: fail them rather than
+        # leave their futures unresolved forever.
+        self._drain_and_fail()
+
+    def _loop(self) -> None:
+        import queue
+        import time as _time
+
+        carry = None  # a request that didn't fit the previous batch
+        while True:
+            item = carry if carry is not None else self._queue.get()
+            carry = None
+            if item is None:
+                self._drain_and_fail()
+                return
+            pending = [item]
+            rows = len(item[0])
+            deadline = _time.monotonic() + self.timeout_s
+            while rows < self.max_batch_size:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._run(pending)
+                    self._drain_and_fail()
+                    return
+                if rows + len(nxt[0]) > self.max_batch_size:
+                    carry = nxt  # seed of the NEXT batch; cap respected
+                    break
+                pending.append(nxt)
+                rows += len(nxt[0])
+            self._run(pending)
+
+    def _drain_and_fail(self) -> None:
+        import queue
+
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                item[1].set_exception(RuntimeError("serving stopped"))
+
+    def _run(self, pending) -> None:
+        flat = [row for instances, _ in pending for row in instances]
+        try:
+            preds = self._predict(flat)
+        except Exception as e:  # noqa: BLE001 — fail THIS batch only
+            for _, fut in pending:
+                fut.set_exception(e)
+            return
+        self.batches_run += 1
+        self.rows_run += len(flat)
+        start = 0
+        for instances, fut in pending:
+            fut.set_result(preds[start:start + len(instances)])
+            start += len(instances)
+
+
 # -- the HTTP server ----------------------------------------------------------
 
 
@@ -150,7 +274,15 @@ class _RunningServing:
         self.predictor = _build_predictor(cfg)
         self.producer = pubsub.Producer(cfg["topic"])
         name = cfg["name"]
-        predictor = self.predictor
+        self.batcher = None
+        if cfg.get("batching_enabled"):
+            bc = cfg.get("batching_config") or {}
+            self.batcher = DynamicBatcher(
+                self.predictor.predict,
+                max_batch_size=int(bc.get("max_batch_size", 64)),
+                timeout_ms=float(bc.get("timeout_ms", 5.0)),
+            )
+        predictor = self.batcher or self.predictor
         producer = self.producer
 
         class Handler(BaseHTTPRequestHandler):
@@ -196,6 +328,8 @@ class _RunningServing:
     def stop(self) -> None:
         self.server.shutdown()
         self.server.server_close()
+        if self.batcher is not None:
+            self.batcher.stop()
 
 
 # -- public API (reference surface) ------------------------------------------
@@ -209,10 +343,15 @@ def create_or_update(
     model_server: str = FLAX,
     kfserving: bool = False,  # accepted for parity; single serving tool here
     instances: int = 1,
+    batching_enabled: bool = False,
+    batching_config: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """Create/update a serving endpoint definition (reference:
-    ``serving.create_or_update``). ``model_path`` may be a registry path
-    or omitted in favor of ``model_name``+``model_version``."""
+    ``serving.create_or_update``; ``batching_enabled`` mirrors the
+    platform's server-side request batching). ``model_path`` may be a
+    registry path or omitted in favor of ``model_name``+``model_version``.
+    ``batching_config`` knobs: ``max_batch_size`` (default 64),
+    ``timeout_ms`` (default 5)."""
     reg = _load_registry()
     if model_path is None:
         meta = registry.get_model(model_name or name, model_version)
@@ -230,6 +369,8 @@ def create_or_update(
         "model_server": model_server.upper(),
         "kfserving": kfserving,
         "instances": instances,
+        "batching_enabled": batching_enabled,
+        "batching_config": batching_config or {},
         "status": reg.get(name, {}).get("status", "Stopped"),
         "topic": f"serving-{name}-inference",
     }

@@ -362,3 +362,157 @@ class TestStandaloneServing:
         assert serving.reconcile() == ["super_hosted"]
         assert not serving._port_alive(port)
         assert serving.reconcile() == []  # idempotent
+
+
+class TestDynamicBatching:
+    """Server-side request batching (TF-Serving enable_batching twin)."""
+
+    def test_batcher_coalesces_concurrent_requests(self):
+        import threading as th
+
+        calls = []
+
+        def predict(instances):
+            calls.append(len(instances))
+            return [i[0] * 2 for i in instances]
+
+        b = serving.DynamicBatcher(predict, max_batch_size=64, timeout_ms=50)
+        try:
+            results = {}
+
+            def req(i):
+                results[i] = b.predict([[i]])
+
+            threads = [th.Thread(target=req, args=(i,)) for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            # Every request got ITS answer...
+            assert all(results[i] == [i * 2] for i in range(16))
+            # ...and far fewer predict calls than requests ran.
+            assert sum(calls) == 16 and len(calls) < 16
+        finally:
+            b.stop()
+
+    def test_batcher_respects_max_batch_size(self):
+        import threading as th
+
+        calls = []
+        gate = th.Event()
+
+        def predict(instances):
+            gate.wait(2)  # hold the first batch until all requests queue
+            calls.append(len(instances))
+            return list(instances)
+
+        b = serving.DynamicBatcher(predict, max_batch_size=4, timeout_ms=200)
+        try:
+            threads = [
+                th.Thread(target=b.predict, args=([[i]],)) for i in range(10)
+            ]
+            for t in threads:
+                t.start()
+            import time as _t
+            _t.sleep(0.3)  # let all 10 enqueue behind the gated batch
+            gate.set()
+            for t in threads:
+                t.join()
+            assert sum(calls) == 10
+            assert max(calls) <= 4
+        finally:
+            b.stop()
+
+    def test_batcher_propagates_errors_per_batch(self):
+        def predict(instances):
+            if any(i == ["bad"] for i in instances):
+                raise ValueError("poison")
+            return list(instances)
+
+        b = serving.DynamicBatcher(predict, max_batch_size=2, timeout_ms=1)
+        try:
+            with pytest.raises(ValueError, match="poison"):
+                b.predict([["bad"]])
+            assert b.predict([["ok"]]) == [["ok"]]  # later batches fine
+        finally:
+            b.stop()
+
+    def test_batched_serving_end_to_end(self, trained_ffn):
+        import threading as th
+
+        model, params = trained_ffn
+        registry.save_flax(model, params, "batched-ffn", metrics={"acc": 0.5})
+        serving.create_or_update(
+            "batched-ffn", model_name="batched-ffn", batching_enabled=True,
+            batching_config={"max_batch_size": 32, "timeout_ms": 40})
+        serving.start("batched-ffn")
+        try:
+            rows = np.random.RandomState(0).rand(6, 28, 28, 1)
+            want = serving.make_inference_request(
+                "batched-ffn", {"instances": rows.tolist()})["predictions"]
+
+            got = {}
+
+            def req(i):
+                got[i] = serving.make_inference_request(
+                    "batched-ffn", {"instances": [rows[i].tolist()]}
+                )["predictions"]
+
+            threads = [th.Thread(target=req, args=(i,)) for i in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for i in range(6):
+                np.testing.assert_allclose(got[i][0], want[i], atol=1e-5)
+        finally:
+            serving.stop("batched-ffn")
+
+    def test_batcher_never_merges_past_cap_with_multirow_requests(self):
+        import threading as th
+
+        calls = []
+        gate = th.Event()
+
+        def predict(instances):
+            gate.wait(2)
+            calls.append(len(instances))
+            return list(instances)
+
+        b = serving.DynamicBatcher(predict, max_batch_size=4, timeout_ms=200)
+        try:
+            threads = [
+                th.Thread(target=b.predict, args=([[i], [i], [i]],))
+                for i in range(5)  # 3-row requests; 3+3 > 4 -> no merging
+            ]
+            for t in threads:
+                t.start()
+            import time as _t
+            _t.sleep(0.3)
+            gate.set()
+            for t in threads:
+                t.join()
+            assert sum(calls) == 15 and max(calls) <= 4
+        finally:
+            b.stop()
+
+    def test_batcher_oversized_single_request_runs_alone(self):
+        calls = []
+
+        def predict(instances):
+            calls.append(len(instances))
+            return list(instances)
+
+        b = serving.DynamicBatcher(predict, max_batch_size=4, timeout_ms=1)
+        try:
+            out = b.predict([[i] for i in range(10)])
+            assert len(out) == 10 and calls == [10]
+        finally:
+            b.stop()
+
+    def test_batcher_predict_after_stop_raises(self):
+        b = serving.DynamicBatcher(lambda x: list(x), max_batch_size=4,
+                                   timeout_ms=1)
+        b.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            b.predict([[1]])
