@@ -52,6 +52,16 @@ def scoped_devices() -> list[Any] | None:
     return list(devs) if devs is not None else None
 
 
+def _resolve_devices(devices: Sequence[Any] | None) -> list[Any]:
+    """Device list for mesh construction: the explicit argument, else the
+    enclosing :func:`device_scope`'s group, else all chips — host-major
+    sorted so intra-host neighbors stay adjacent on inner mesh axes."""
+    if devices is None:
+        devices = scoped_devices()
+    devs = list(devices) if devices is not None else list(jax.devices())
+    return sorted(devs, key=lambda d: (d.process_index, d.id))
+
+
 def make_mesh(
     shape: Sequence[int] | Mapping[str, int] | None = None,
     axis_names: Sequence[str] = ("data",),
@@ -64,12 +74,7 @@ def make_mesh(
     ``axis_names``, or ``None`` (all devices on the first axis). ``-1``
     in one position means "whatever is left".
     """
-    if devices is None:
-        devices = scoped_devices()
-    devs = list(devices) if devices is not None else list(jax.devices())
-    # Host-major ordering keeps intra-host neighbors adjacent on the
-    # innermost mesh axis.
-    devs = sorted(devs, key=lambda d: (d.process_index, d.id))
+    devs = _resolve_devices(devices)
     if isinstance(shape, Mapping):
         axis_names = tuple(shape.keys())
         shape = tuple(shape.values())
@@ -83,6 +88,60 @@ def make_mesh(
         raise ValueError(f"mesh shape {tuple(shape)} != {len(devs)} devices")
     arr = np.array(devs).reshape(shape)
     return Mesh(arr, tuple(axis_names))
+
+
+def hybrid_mesh(
+    ici: Mapping[str, int],
+    dcn: Mapping[str, int],
+    devices: Sequence[Any] | None = None,
+    slice_id=None,
+) -> Mesh:
+    """Multi-slice mesh: DCN axes outermost, ICI axes innermost.
+
+    A TPU pod job can span several slices; links WITHIN a slice (ICI)
+    are an order of magnitude faster than the data-center network
+    BETWEEN slices (DCN). The scaling-book recipe: put pure
+    data-parallelism on the DCN axes (one gradient all-reduce per step
+    amortizes fine over DCN) and keep every bandwidth-hungry axis —
+    tensor/sequence/expert — on ICI axes inside one slice. This helper
+    encodes that layout: ``dcn`` axes index whole slices, ``ici`` axes
+    tile the chips of each slice, so XLA's collectives over an ``ici``
+    axis never cross DCN.
+
+    ``slice_id`` maps a device to its slice (default: the TPU runtime's
+    ``device.slice_index``, falling back to ``process_index`` for
+    non-TPU multi-process backends; single-process fake CPU meshes must
+    pass an explicit ``slice_id`` — e.g. ``lambda d: d.id // 4`` —
+    to emulate slices). Every slice must hold ``prod(ici)`` devices and
+    ``prod(dcn)`` must equal the slice count.
+
+        mesh = hybrid_mesh(ici={"data": 4, "model": 2}, dcn={"replica": 2})
+        # axes ("replica", "data", "model"); psum over "model" rides ICI
+
+    Feed to ``Strategy(mesh, data_axis=("replica", "data"))`` (batch
+    shards over both) or use directly with shard_map/pjit.
+    """
+    devs = _resolve_devices(devices)
+    if slice_id is None:
+        def slice_id(d):
+            return getattr(d, "slice_index", d.process_index)
+
+    groups: dict[Any, list[Any]] = {}
+    for d in devs:
+        groups.setdefault(slice_id(d), []).append(d)
+    slices = [groups[k] for k in sorted(groups)]
+    n_dcn, n_ici = math.prod(dcn.values()), math.prod(ici.values())
+    if len(slices) != n_dcn:
+        raise ValueError(
+            f"dcn axes {dict(dcn)} want {n_dcn} slices, found {len(slices)} "
+            f"(slice ids {sorted(groups)})")
+    sizes = {len(s) for s in slices}
+    if sizes != {n_ici}:
+        raise ValueError(
+            f"ici axes {dict(ici)} want {n_ici} chips per slice, "
+            f"found sizes {sorted(sizes)}")
+    arr = np.array(slices).reshape(tuple(dcn.values()) + tuple(ici.values()))
+    return Mesh(arr, tuple(dcn) + tuple(ici))
 
 
 def local_mesh(axis_names: Sequence[str] = ("data",)) -> Mesh:

@@ -37,7 +37,9 @@ _current: list["Strategy"] = []
 class Strategy:
     """Base: data-parallel SPMD over an arbitrary mesh."""
 
-    def __init__(self, mesh: Mesh | None = None, data_axis: str = "data"):
+    def __init__(
+        self, mesh: Mesh | None = None, data_axis: str | tuple[str, ...] = "data"
+    ):
         self.mesh = mesh if mesh is not None else mesh_lib.global_mesh()
         self.data_axis = data_axis
 
@@ -45,7 +47,12 @@ class Strategy:
 
     @property
     def num_replicas_in_sync(self) -> int:
-        return self.mesh.shape[self.data_axis]
+        axes = (
+            self.data_axis
+            if isinstance(self.data_axis, tuple)
+            else (self.data_axis,)
+        )
+        return math.prod(self.mesh.shape[a] for a in axes)
 
     @property
     def num_hosts(self) -> int:
@@ -138,18 +145,12 @@ class ShardedStrategy(Strategy):
         min_shard_size: int = 4096,
     ):
         mesh = mesh_lib.make_mesh({"data": data, "fsdp": fsdp, "model": model})
-        super().__init__(mesh, "data")
+        # ZeRO semantics: the batch shards over data AND fsdp — each
+        # fsdp group works on different samples (params are what fsdp
+        # shards); only the model axis replicates the batch. The base
+        # class derives replica count and batch sharding from the tuple.
+        super().__init__(mesh, ("data", "fsdp"))
         self.min_shard_size = min_shard_size
-
-    # ZeRO semantics: the batch shards over data AND fsdp — each fsdp
-    # group works on different samples (params are what fsdp shards);
-    # only the model axis replicates the batch.
-    @property
-    def num_replicas_in_sync(self) -> int:
-        return self.mesh.shape["data"] * self.mesh.shape["fsdp"]
-
-    def distribute_batch(self, batch: Any) -> Any:
-        return mesh_lib.shard_batch(self.mesh, batch, ("data", "fsdp"))
 
     def _spec_for(self, leaf: Any) -> P:
         from hops_tpu.parallel import sharding as shard_lib
