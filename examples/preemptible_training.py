@@ -1,0 +1,81 @@
+"""Preemption-safe training: survive SIGTERM, resume where you left off.
+
+The reference had no story for a killed run (SURVEY.md §5 "no
+auto-resume") — on preemptible TPU pods that means losing the whole
+run to a maintenance event. This example simulates the full lifecycle
+in one process:
+
+1. first incarnation trains, is "preempted" (a real SIGTERM) mid-run,
+   checkpoints at the step boundary, and exits cleanly;
+2. second incarnation calls the SAME code and transparently resumes
+   from the checkpoint, finishing the remaining steps.
+
+Run: python examples/preemptible_training.py
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import tempfile
+
+
+def train(ckpt_dir: str, batches, preempt_at: int | None = None) -> dict:
+    """One incarnation of the job: same code before and after preemption."""
+    import jax
+    import jax.numpy as jnp
+
+    from hops_tpu.models import common
+    from hops_tpu.models.mnist import CNN
+    from hops_tpu.runtime.preemption import PreemptionGuard, run_preemptible
+
+    guard = PreemptionGuard()
+    step_fn = jax.jit(common.make_train_step())
+    seen = []
+
+    def step(state, batch):
+        seen.append(1)
+        if preempt_at is not None and len(seen) == preempt_at:
+            os.kill(os.getpid(), signal.SIGTERM)  # the maintenance event
+        return step_fn(state, batch)
+
+    state = common.create_train_state(
+        CNN(dtype=jnp.float32), jax.random.PRNGKey(0), (8, 28, 28, 1)
+    )
+    state, metrics, done = run_preemptible(
+        step, state, batches, directory=ckpt_dir, save_every=50, guard=guard
+    )
+    return {
+        "steps_completed": done,
+        "optimizer_steps": int(state.step),
+        "loss": float(metrics["loss"]) if metrics else None,
+    }
+
+
+def main(num_steps: int = 10, preempt_at: int = 4) -> dict:
+    import numpy as np
+
+    rs = np.random.RandomState(0)
+    batches = [
+        {
+            "image": rs.rand(8, 28, 28, 1).astype(np.float32),
+            "label": rs.randint(0, 10, 8),
+        }
+        for _ in range(num_steps)
+    ]
+    ckpt_dir = tempfile.mkdtemp(prefix="preemptible_")
+
+    first = train(ckpt_dir, batches, preempt_at=preempt_at)
+    second = train(ckpt_dir, batches)
+    print(
+        f"incarnation 1: preempted after {first['steps_completed']} steps "
+        f"(loss {first['loss']:.3f}); incarnation 2 resumed and finished "
+        f"{second['steps_completed']} / {num_steps} "
+        f"(optimizer steps {second['optimizer_steps']}, "
+        f"loss {second['loss']:.3f})"
+    )
+    return {"first": first, "second": second}
+
+
+if __name__ == "__main__":
+    main()

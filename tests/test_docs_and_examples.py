@@ -121,3 +121,12 @@ def test_lm_generation_serving():
     assert result["accuracy"] > 0.9
     expected = [lm_serving.CYCLE[(4 + i) % len(lm_serving.CYCLE)] for i in range(8)]
     assert result["continuation"][:8] == expected
+
+
+def test_preemptible_training_example():
+    from examples import preemptible_training
+
+    result = preemptible_training.main(num_steps=8, preempt_at=3)
+    assert result["first"]["steps_completed"] == 3
+    assert result["second"]["steps_completed"] == 8
+    assert result["second"]["optimizer_steps"] == 8  # 3 restored + 5 new
