@@ -373,7 +373,13 @@ class TransformerLM(nn.Module):
     window: int | None = None  # sliding-window causal attention
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False, decode: bool = False):
+    def __call__(
+        self,
+        tokens,
+        train: bool = False,
+        decode: bool = False,
+        return_hidden: bool = False,
+    ):
         from hops_tpu.models.moe import MoEBlock
 
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")(tokens)
@@ -413,17 +419,33 @@ class TransformerLM(nn.Module):
                 name=f"block_{i}",
             )(x, train, decode)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
+        if return_hidden:
+            # The chunked-vocab loss (ops/xent.py) computes the loss
+            # straight from hidden states + the unembed kernel without
+            # ever materializing (batch, seq, vocab) fp32 logits.
+            return x
         logits = nn.Dense(self.vocab_size, dtype=self.dtype, use_bias=False, name="unembed")(x)
         return logits.astype(jnp.float32)
 
 
-def make_lm_train_step(aux_loss_weight: float = 0.01):
+def make_lm_train_step(
+    aux_loss_weight: float = 0.01, loss_chunk: int | None = None
+):
     """Next-token-prediction step: ``(state, {"tokens"}) -> (state, metrics)``.
 
     Same ``step(state, batch)`` contract as ``common.make_train_step``
     so every launcher (launch/mirrored/collective_all_reduce) accepts it
     unchanged. MoE blocks' sown load-balancing losses are folded in at
     ``aux_loss_weight``.
+
+    ``loss_chunk``: compute the loss via the memory-efficient
+    token-chunked LM-head path (``ops/xent.py``) — ``loss_chunk``
+    tokens' logits at a time, so the (batch, seq, vocab) fp32 logits
+    are never materialized (peak ``loss_chunk x vocab``). For fp32
+    models the loss and gradients are identical to the dense path
+    (tests/test_ops.py parity); for bf16 models they differ slightly —
+    in the chunked path's favor, since its logits are fp32-accumulated
+    on the MXU while the dense path rounds them through bf16 first.
     """
     import optax
 
@@ -435,14 +457,24 @@ def make_lm_train_step(aux_loss_weight: float = 0.01):
         step_rng = jax.random.fold_in(state.rng, state.step)
 
         def compute_loss(params):
-            logits, mods = state.apply_fn(
+            out, mods = state.apply_fn(
                 {"params": params},
                 inputs,
                 train=True,
+                return_hidden=bool(loss_chunk),
                 rngs={"dropout": step_rng},
                 mutable=["losses"],
             )
-            loss = optax.softmax_cross_entropy_with_integer_labels(logits, targets).mean()
+            if loss_chunk:
+                from hops_tpu.ops.xent import chunked_softmax_xent
+
+                loss = chunked_softmax_xent(
+                    out, params["unembed"]["kernel"], targets, chunk=loss_chunk
+                )
+            else:
+                loss = optax.softmax_cross_entropy_with_integer_labels(
+                    out, targets
+                ).mean()
             aux = sum_sown_losses(mods)
             return loss + aux_loss_weight * aux, loss
 

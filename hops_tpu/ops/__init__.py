@@ -12,6 +12,9 @@ hand-written here with Pallas:
   fixed-capacity KV cache for autoregressive decoding, with optional
   int8 dequantization in VMEM (``quantize_kv``) and native GQA
   query-head grouping.
+- ``chunked_softmax_xent`` — LM-head loss computed per sequence chunk
+  under ``jax.checkpoint``: the (batch, seq, vocab) fp32 logits are
+  never materialized (peak chunk x vocab instead).
 
 Every kernel ships with a pure-XLA reference twin used for (a) numeric
 tests, (b) non-TPU backends, (c) shapes the kernel doesn't support.
@@ -27,3 +30,4 @@ from hops_tpu.ops.attention import (  # noqa: F401
     quantize_kv,
     repeat_kv,
 )
+from hops_tpu.ops.xent import chunked_softmax_xent  # noqa: F401

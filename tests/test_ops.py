@@ -442,3 +442,74 @@ def test_decode_block_range_clamps_dma_to_valid_prefix():
     # Window lifts the bottom: positions < vl - s - w + 1 never stream.
     first, last = _decode_block_range(jnp.int32(1000), block_k=128, s=1, window=64)
     assert (int(first), int(last)) == (7, 7)   # only the newest block
+
+
+# -- chunked-vocab cross-entropy (ops/xent.py) -------------------------------
+
+
+def test_chunked_xent_matches_optax_value_and_grad():
+    import optax
+
+    from hops_tpu.ops.xent import chunked_softmax_xent
+
+    rs = np.random.RandomState(0)
+    b, s, d, v = 2, 12, 16, 37  # vocab/seq deliberately not chunk-aligned
+    h = jnp.asarray(rs.randn(b, s, d), jnp.float32)
+    w = jnp.asarray(rs.randn(d, v) * 0.1, jnp.float32)
+    t = jnp.asarray(rs.randint(0, v, (b, s)))
+
+    def full(h, w):
+        logits = jnp.asarray(h @ w, jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, t).mean()
+
+    def chunked(h, w):
+        return chunked_softmax_xent(h, w, t, chunk=8)  # 24 tokens -> pad to 32
+
+    np.testing.assert_allclose(chunked(h, w), full(h, w), rtol=1e-6)
+    g_full = jax.grad(full, argnums=(0, 1))(h, w)
+    g_chunk = jax.grad(chunked, argnums=(0, 1))(h, w)
+    for a, b_ in zip(g_chunk, g_full):
+        np.testing.assert_allclose(a, b_, atol=1e-5, rtol=1e-5)
+
+
+def test_chunked_xent_never_materializes_full_logits():
+    """The compiled forward+backward must not allocate a (tokens, vocab)
+    fp32 buffer — that is the entire point of the chunked path."""
+    from hops_tpu.ops.xent import chunked_softmax_xent
+
+    rs = np.random.RandomState(1)
+    b, s, d, v = 2, 256, 32, 512
+    h = jnp.asarray(rs.randn(b, s, d), jnp.float32)
+    w = jnp.asarray(rs.randn(d, v) * 0.1, jnp.float32)
+    t = jnp.asarray(rs.randint(0, v, (b, s)))
+
+    def loss(h, w):
+        return chunked_softmax_xent(h, w, t, chunk=64)
+
+    text = jax.jit(jax.grad(loss, argnums=(0, 1))).lower(h, w).as_text()
+    full, chunked = f"{b * s}x{v}", f"64x{v}"
+    assert chunked in text       # per-chunk logits exist
+    assert full not in text      # full logits never do
+
+
+def test_lm_train_step_loss_chunk_matches_dense_path():
+    from hops_tpu.models import common
+    from hops_tpu.models.transformer import TransformerLM, make_lm_train_step
+
+    model = TransformerLM(
+        vocab_size=64, d_model=32, num_heads=4, num_layers=2,
+        dtype=jnp.float32, attention_impl="reference", max_decode_len=32,
+    )
+    tokens = {"tokens": jnp.asarray(
+        np.random.RandomState(0).randint(0, 64, (4, 17)))}
+    s0 = common.create_train_state(
+        model, jax.random.PRNGKey(0), (4, 16), input_dtype=jnp.int32)
+    s1, m1 = jax.jit(make_lm_train_step())(s0, tokens)
+    s0b = common.create_train_state(
+        model, jax.random.PRNGKey(0), (4, 16), input_dtype=jnp.int32)
+    s2, m2 = jax.jit(make_lm_train_step(loss_chunk=32))(s0b, tokens)
+    np.testing.assert_allclose(float(m1["loss"]), float(m2["loss"]), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5),
+        s1.params, s2.params,
+    )
