@@ -63,14 +63,25 @@ class Predict:
         )
 
     def predict(self, instances):
-        """instances: list of equal-length prompt token-id lists ->
-        list of generated continuation token-id lists."""
-        prompt = jnp.asarray(instances, jnp.int32)
-        out = generate(
-            self.model, self.params, prompt, jax.random.PRNGKey(0),
-            max_new_tokens=16, temperature=0.0, eos_id=1, pad_id=0,
-        )
-        return out[:, prompt.shape[1] :].tolist()
+        """instances: list of prompt token-id lists -> list of generated
+        continuation token-id lists. Lengths MAY differ: with server-side
+        batching the server coalesces prompts from different clients into
+        one call, so prompts are grouped by length and each group runs
+        one KV-cached pass (grouping, not padding — left-pad would shift
+        a causal LM's positions)."""
+        out = [None] * len(instances)
+        by_len = {}
+        for i, p in enumerate(instances):
+            by_len.setdefault(len(p), []).append(i)
+        for n, idxs in by_len.items():
+            prompt = jnp.asarray([instances[i] for i in idxs], jnp.int32)
+            gen = generate(
+                self.model, self.params, prompt, jax.random.PRNGKey(0),
+                max_new_tokens=16, temperature=0.0, eos_id=1, pad_id=0,
+            )
+            for row, i in enumerate(idxs):
+                out[i] = gen[row, n:].tolist()
+        return out
 '''
 
 
@@ -119,6 +130,9 @@ def main() -> dict:
     serving.create_or_update(
         MODEL_NAME, model_name=MODEL_NAME, model_version=meta["version"],
         model_server="PYTHON",
+        # Concurrent clients coalesce into one predictor pass per window.
+        batching_enabled=True, batching_config={"max_batch_size": 16,
+                                                "timeout_ms": 10},
     )
     serving.start(MODEL_NAME)
     try:
@@ -128,11 +142,31 @@ def main() -> dict:
             {"signature_name": "serving_default", "instances": [prompt]},
         )
         continuation = resp["predictions"][0]
+
+        # Concurrent clients with DIFFERENT prompt lengths: the server-
+        # side batcher coalesces them; the predictor groups by length.
+        import threading
+
+        ragged = {}
+
+        def client(key, p):
+            ragged[key] = serving.make_inference_request(
+                MODEL_NAME, {"instances": [p]})["predictions"][0]
+
+        threads = [
+            threading.Thread(target=client, args=("short", CYCLE[:2])),
+            threading.Thread(target=client, args=("long", CYCLE[:6])),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
         print(
             f"lm served: next-token acc={acc:.3f} prompt={prompt} "
-            f"continuation={continuation}"
+            f"continuation={continuation} ragged_ok={sorted(ragged)}"
         )
-        return {"accuracy": acc, "prompt": prompt, "continuation": continuation}
+        return {"accuracy": acc, "prompt": prompt, "continuation": continuation,
+                "ragged": ragged}
     finally:
         serving.stop(MODEL_NAME)
 

@@ -121,6 +121,11 @@ def test_lm_generation_serving():
     assert result["accuracy"] > 0.9
     expected = [lm_serving.CYCLE[(4 + i) % len(lm_serving.CYCLE)] for i in range(8)]
     assert result["continuation"][:8] == expected
+    # Ragged concurrent prompts (server-side batching coalesces them):
+    # each continues its OWN cycle position.
+    cyc = lm_serving.CYCLE
+    assert result["ragged"]["short"][:4] == [cyc[(2 + i) % 8] for i in range(4)]
+    assert result["ragged"]["long"][:4] == [cyc[(6 + i) % 8] for i in range(4)]
 
 
 def test_preemptible_training_example():
