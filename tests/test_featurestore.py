@@ -564,6 +564,40 @@ class TestConnectors:
         with pytest.raises(RuntimeError):
             c.read()
 
+    def test_snowflake_account_url_never_treated_as_local_file(self, fs):
+        fs.create_storage_connector(
+            "snowreal", "SNOWFLAKE", url="xy123.eu-west-1.snowflakecomputing.com")
+        with pytest.raises(RuntimeError, match="driver"):
+            fs.get_storage_connector("snowreal").read(query="select 1")
+
+    def test_snowflake_embedded_read_path(self, fs, tmp_path):
+        """The warehouse-SQL → on-demand-FG path executes when the
+        Snowflake connector points at an embedded database — same
+        contract as JDBC/Redshift (snowflake/getting-started.ipynb
+        role: warehouse query feeds a feature group)."""
+        import sqlite3
+
+        db = tmp_path / "wh.db"
+        conn = sqlite3.connect(db)
+        conn.execute("create table trips (id int, fare real)")
+        conn.executemany("insert into trips values (?, ?)",
+                         [(1, 7.5), (2, 11.0), (3, 3.25)])
+        conn.commit()
+        conn.close()
+
+        fs.create_storage_connector(
+            "wh_snow", "SNOWFLAKE", url=f"jdbc:sqlite:{db}",
+            user="svc", database="wh", schema="public", warehouse="xs")
+        c = fs.get_storage_connector("wh_snow", "SNOWFLAKE")
+        df = c.read(query="select id, fare from trips where fare > 5 order by id")
+        assert list(df["id"]) == [1, 2]
+        ofg = fs.create_on_demand_feature_group(
+            name="snow_trips", version=1,
+            query="select id, fare from trips order by id",
+            storage_connector=c)
+        got = ofg.read()
+        assert len(got) == 3 and got["fare"].iloc[2] == 3.25
+
     def test_unknown_connector(self, fs):
         with pytest.raises(KeyError):
             fs.get_storage_connector("nope")
