@@ -29,6 +29,33 @@ import numpy as np
 BASELINE_FILE = Path(__file__).parent / "BASELINE_SELF.json"
 
 
+def _note(msg: str) -> None:
+    """Progress line on stderr (stdout carries only the driver's JSON line).
+
+    The relay makes first-compile slow (can exceed 10 min); without
+    these lines a slow run and a wedged run look identical from the
+    outside, and the only way to tell used to be killing the client —
+    which is exactly what wedges the relay."""
+    import sys
+
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+_T0 = time.perf_counter()
+
+
+def _enable_compile_cache() -> None:
+    """Persist compiled executables under .jax_cache/ next to this file.
+
+    The driver re-runs bench.py at round end with identical shapes; a
+    warm cache turns the multi-minute relay compile into a fast load,
+    shrinking the window in which a timeout/kill could wedge the relay."""
+    cache = Path(__file__).parent / ".jax_cache"
+    cache.mkdir(exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", str(cache))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
 def _sync(tree) -> float:
     """Force completion via a device-to-host transfer.
 
@@ -74,12 +101,14 @@ def run_bench(
     n_chips = strategy.num_replicas_in_sync
     global_batch = per_chip_batch * n_chips
     local_batch = per_chip_batch * (jax.local_device_count() if multihost else n_chips)
+    _note(f"backend up: {n_chips} chip(s), platform={jax.devices()[0].platform}")
 
     state = strategy.replicate(
         common.create_bn_train_state(
             model, jax.random.PRNGKey(0), (per_chip_batch, image_size, image_size, 3)
         )
     )
+    _note("params initialized")
     train_step = common.make_bn_train_step()
 
     def multi_step(state, batch):
@@ -101,9 +130,11 @@ def run_bench(
         }
     )
 
+    _note(f"compiling + warmup ({max(1, warmup // scan_chunk)} dispatches of {scan_chunk} steps)")
     for _ in range(max(1, warmup // scan_chunk)):
         state, loss = step_fn(state, batch)
     _sync(loss)
+    _note("warmup done, timing")
 
     n_dispatch = max(1, steps // scan_chunk)  # whole dispatches only, never overshoot
     t0 = time.perf_counter()
@@ -189,6 +220,10 @@ def main() -> None:
         help="whole-slice data parallelism; launch per host via hops_tpu.launch "
         "(see RUNBOOK_v5e64.md)",
     )
+    parser.add_argument(
+        "--no-probe", action="store_true",
+        help="skip the pre-run relay health probe (saves ~20s when known-healthy)",
+    )
     args = parser.parse_args()
 
     if args.probe:
@@ -201,7 +236,19 @@ def main() -> None:
         # Env alone is not enough when a sitecustomize pre-imported
         # jax — same trick as tests/conftest.py.
         jax.config.update("jax_platforms", "cpu")
+    elif not args.multihost and not args.no_probe:
+        # Fail fast instead of hanging the driver: a wedged relay makes
+        # every backend call block forever, and killing the hung bench
+        # is what wedges the relay further. A healthy relay answers the
+        # probe in ~20 s; 240 s means it is down — exit cleanly.
+        _note("probing relay health before committing to the real run")
+        health = probe_tpu(timeout_s=240)
+        if not health.get("ok"):
+            _note(f"relay unreachable, aborting: {health.get('error')}")
+            raise SystemExit(1)
+        _note(f"relay healthy ({health.get('platform')}, {health.get('elapsed_s')}s)")
 
+    _enable_compile_cache()
     result = run_bench(
         per_chip_batch=args.batch,
         steps=args.steps,

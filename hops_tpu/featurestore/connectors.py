@@ -133,20 +133,26 @@ class JDBCConnector(StorageConnector):
 
     executes_sql = True
 
+    #: Whether a scheme-less connection string may name a local database
+    #: file. True for generic JDBC; Snowflake overrides to False since
+    #: its scheme-less account URLs (*.snowflakecomputing.com) must
+    #: never be mistaken for a filesystem path.
+    _allow_bare_path = True
+
     def read(self, query=None, data_format=None, path=None) -> pd.DataFrame:
         db_path = self._sqlite_path()
         if db_path is None:
             raise RuntimeError(
-                f"JDBC connector {self.name!r}: connection string "
+                f"{self.type} connector {self.name!r}: connection string "
                 f"{self.connection_string()!r} requires a network database "
                 "driver not in this image; embedded sqlite "
                 "(jdbc:sqlite:<path>) is supported")
         if not Path(db_path).exists():
             raise FileNotFoundError(
-                f"JDBC connector {self.name!r}: database {db_path} does not exist")
+                f"{self.type} connector {self.name!r}: database {db_path} does not exist")
         sql = query or self.options.get("query")
         if not sql:
-            raise ValueError(f"JDBC connector {self.name!r}: read() needs a query")
+            raise ValueError(f"{self.type} connector {self.name!r}: read() needs a query")
         import sqlite3
 
         db = sqlite3.connect(db_path)
@@ -163,7 +169,7 @@ class JDBCConnector(StorageConnector):
         for prefix in ("jdbc:sqlite:", "sqlite:///", "sqlite:"):
             if cs.startswith(prefix):
                 return cs[len(prefix):]
-        if cs and ":" not in cs.split("/", 1)[0]:
+        if self._allow_bare_path and cs and ":" not in cs.split("/", 1)[0]:
             return cs  # bare filesystem path
         return None
 
@@ -190,18 +196,10 @@ class SnowflakeConnector(JDBCConnector):
             "sfRole": o.get("role", ""),
         }
 
+    _allow_bare_path = False
+
     def connection_string(self) -> str:
         return self.options.get("connection_string") or self.options.get("url", "")
-
-    def _sqlite_path(self) -> str | None:
-        # No bare-path fallback here: a Snowflake account URL
-        # (xy123.snowflakecomputing.com) contains no scheme either and
-        # must not be mistaken for a local database file.
-        cs = self.connection_string()
-        for prefix in ("jdbc:sqlite:", "sqlite:///", "sqlite:"):
-            if cs.startswith(prefix):
-                return cs[len(prefix):]
-        return None
 
 
 class RedshiftConnector(JDBCConnector):
