@@ -135,3 +135,17 @@ def test_preemptible_training_example():
     assert result["first"]["steps_completed"] == 3
     assert result["second"]["steps_completed"] == 8
     assert result["second"]["optimizer_steps"] == 8  # 3 restored + 5 new
+
+
+def test_batch_inference_example():
+    from examples import batch_inference
+
+    result = batch_inference.main(n_images=70, per_chip_batch=4)
+    # 70 images over 4/chip chunks exercises the padded ragged tail.
+    assert result["rows"] == 70
+    import pandas as pd
+
+    df = pd.read_parquet(result["path"])
+    assert set(df.columns) == {"image_id", "prediction", "probability"}
+    assert df["prediction"].between(0, 9).all()
+    assert df["probability"].between(0.0, 1.0).all()
