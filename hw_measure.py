@@ -1,0 +1,75 @@
+"""Unattended hardware measurement sweep for the single-tenant TPU relay.
+
+Runs, sequentially and with NO timeouts or kills (a killed client wedges
+the relay — BENCHMARKS.md operational note), every measurement the round
+needs on real hardware:
+
+  1. relay health probe (kill-safe subprocess, bench.py --probe)
+  2. headline ResNet-50 bench (bench.py)
+  3. decode_bench: base / int8 / GQA / window / int8+GQA+window
+  4. decode_bench --valid-sweep (valid-length-proportional DMA check)
+
+Each step's stdout+stderr and wall time append to HW_MEASURE.jsonl so a
+later session (or a human) can transcribe the numbers into
+BENCHMARKS.md even if this process's parent goes away. Steps run to
+natural completion; a failed step records its output and the sweep
+moves on.
+
+Usage: nohup python hw_measure.py >> hw_measure.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).parent
+OUT = ROOT / "HW_MEASURE.jsonl"
+
+STEPS: list[tuple[str, list[str]]] = [
+    ("probe", [sys.executable, "bench.py", "--probe"]),
+    ("resnet50_bench", [sys.executable, "bench.py", "--no-probe"]),
+    ("decode_base", [sys.executable, "examples/decode_bench.py"]),
+    ("decode_int8", [sys.executable, "examples/decode_bench.py", "--kv-dtype", "int8"]),
+    ("decode_gqa", [sys.executable, "examples/decode_bench.py", "--kv-heads", "2"]),
+    ("decode_window", [sys.executable, "examples/decode_bench.py", "--window", "256"]),
+    ("decode_all_knobs", [sys.executable, "examples/decode_bench.py",
+                          "--kv-dtype", "int8", "--kv-heads", "2", "--window", "256"]),
+    ("valid_sweep", [sys.executable, "examples/decode_bench.py", "--valid-sweep"]),
+]
+
+
+def record(entry: dict) -> None:
+    with OUT.open("a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def main() -> int:
+    for name, cmd in STEPS:
+        t0 = time.time()
+        print(f"[hw_measure] {name}: {' '.join(cmd[1:])}", flush=True)
+        proc = subprocess.run(  # no timeout, ever: let the relay finish
+            cmd, cwd=ROOT, capture_output=True, text=True
+        )
+        entry = {
+            "step": name,
+            "rc": proc.returncode,
+            "wall_s": round(time.time() - t0, 1),
+            "stdout": proc.stdout[-4000:],
+            "stderr": proc.stderr[-2000:],
+            "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+        }
+        record(entry)
+        print(f"[hw_measure] {name}: rc={proc.returncode} in {entry['wall_s']}s", flush=True)
+        if name == "probe" and '"ok": true' not in proc.stdout:
+            record({"step": "abort", "reason": "relay unhealthy at probe"})
+            print("[hw_measure] relay unhealthy — aborting sweep", flush=True)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
