@@ -149,3 +149,16 @@ def test_batch_inference_example():
     assert set(df.columns) == {"image_id", "prediction", "probability"}
     assert df["prediction"].between(0, 9).all()
     assert df["probability"].between(0.0, 1.0).all()
+
+
+def test_torch_example_through_launch_and_de():
+    """The launcher contract is framework-agnostic: a full torch program
+    runs through experiment.launch and differential_evolution unchanged
+    (reference PyTorch family, SURVEY.md §2.3)."""
+    pytest.importorskip("torch")
+    from examples import torch_mnist
+
+    result = torch_mnist.main(generations=1, population=4)
+    assert result["launch"]["accuracy"] > 0.85  # real digits, real training
+    assert result["de"]["best_metric"] > 0.85
+    assert 1e-4 <= result["de"]["best_config"]["lr"] <= 1e-2
