@@ -73,6 +73,7 @@ def run_bench(
     smoke: bool = False,
     scan_chunk: int = 16,
     multihost: bool = False,
+    remat: bool = False,
 ) -> dict:
     """Time the ResNet-50 train step with a device-side training loop.
 
@@ -88,10 +89,10 @@ def run_bench(
     from hops_tpu.parallel.strategy import CollectiveAllReduceStrategy, Strategy
 
     if smoke:
-        model = ResNet18ish(dtype=jnp.float32)
+        model = ResNet18ish(dtype=jnp.float32, remat=remat)
         per_chip_batch, image_size, steps, warmup, scan_chunk = 8, 32, 4, 2, 2
     else:
-        model = ResNet50(num_classes=1000)
+        model = ResNet50(num_classes=1000, remat=remat)
 
     scan_chunk = min(scan_chunk, steps)  # --steps 8 means 8 steps, not 16
     # --multihost: the whole-slice mesh (XLA AllReduce over ICI/DCN),
@@ -224,6 +225,11 @@ def main() -> None:
         "--no-probe", action="store_true",
         help="skip the pre-run relay health probe (saves ~20s when known-healthy)",
     )
+    parser.add_argument(
+        "--remat", action="store_true",
+        help="per-block rematerialization: trade recompute FLOPs for "
+        "activation HBM bytes (A/B lever on the bandwidth-bound step)",
+    )
     args = parser.parse_args()
 
     if args.probe:
@@ -255,6 +261,7 @@ def main() -> None:
         smoke=args.smoke,
         scan_chunk=args.scan_chunk,
         multihost=args.multihost,
+        remat=args.remat,
     )
     value = result["samples_per_sec_per_chip"]
     if args.multihost and jax.process_index() != 0:

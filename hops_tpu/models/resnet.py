@@ -16,7 +16,13 @@ bottleneck (BENCHMARKS.md roofline):
   conv over the 2x2-space-to-depth input (112x112x12), which uses the
   MXU's input rows 4x better while keeping the parameter a standard
   7x7x3xW kernel (checkpoint-compatible; the rewrite happens at apply
-  time).
+  time);
+- optional per-block rematerialization (``remat``): save only the
+  residual stream at block boundaries and recompute the 3-4 intra-block
+  conv/BN/relu activations during backward — on an HBM-bound step the
+  saved activation bytes buy more than the recompute FLOPs cost, since
+  the MXU has headroom (gradients are numerically identical; A/B via
+  ``bench.py --remat``).
 """
 
 from __future__ import annotations
@@ -95,6 +101,7 @@ class ResNet(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
     norm_dtype: jnp.dtype | None = None  # None = follow ``dtype``
     s2d_stem: bool = True
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -130,12 +137,21 @@ class ResNet(nn.Module):
         )(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        # static_argnums=(2,): the train flag is Python control flow
+        # inside the block, not a traceable input. Blocks carry explicit
+        # names so the parameter tree is identical with remat on or off
+        # (nn.remat would otherwise rename to CheckpointBottleneckBlock_n,
+        # making checkpoints non-interchangeable).
+        block_cls = nn.remat(BottleneckBlock, static_argnums=(2,)) if self.remat else BottleneckBlock
+        n = 0
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 strides = (2, 2) if i > 0 and j == 0 else (1, 1)
-                x = BottleneckBlock(
-                    self.width * 2**i, strides, self.dtype, norm_dtype=self.norm_dtype
-                )(x, train=train)
+                x = block_cls(
+                    self.width * 2**i, strides, self.dtype, norm_dtype=self.norm_dtype,
+                    name=f"BottleneckBlock_{n}",
+                )(x, train)
+                n += 1
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
         return x.astype(jnp.float32)
@@ -146,6 +162,7 @@ def ResNet50(
     dtype: jnp.dtype = jnp.bfloat16,
     norm_dtype: jnp.dtype | None = None,
     s2d_stem: bool = True,
+    remat: bool = False,
 ) -> ResNet:
     return ResNet(
         [3, 4, 6, 3],
@@ -153,9 +170,12 @@ def ResNet50(
         dtype=dtype,
         norm_dtype=norm_dtype,
         s2d_stem=s2d_stem,
+        remat=remat,
     )
 
 
-def ResNet18ish(num_classes: int = 10, dtype: jnp.dtype = jnp.bfloat16) -> ResNet:
+def ResNet18ish(
+    num_classes: int = 10, dtype: jnp.dtype = jnp.bfloat16, remat: bool = False
+) -> ResNet:
     """Small bottleneck variant for CI-scale tests."""
-    return ResNet([1, 1, 1, 1], num_classes=num_classes, width=16, dtype=dtype)
+    return ResNet([1, 1, 1, 1], num_classes=num_classes, width=16, dtype=dtype, remat=remat)

@@ -32,6 +32,7 @@ OUT = ROOT / "HW_MEASURE.jsonl"
 STEPS: list[tuple[str, list[str]]] = [
     ("probe", [sys.executable, "bench.py", "--probe"]),
     ("resnet50_bench", [sys.executable, "bench.py", "--no-probe"]),
+    ("resnet50_bench_remat", [sys.executable, "bench.py", "--no-probe", "--remat"]),
     ("decode_base", [sys.executable, "examples/decode_bench.py"]),
     ("decode_int8", [sys.executable, "examples/decode_bench.py", "--kv-dtype", "int8"]),
     ("decode_gqa", [sys.executable, "examples/decode_bench.py", "--kv-heads", "2"]),

@@ -136,3 +136,30 @@ class TestResNetTPUForm:
             assert leaf.dtype == jnp.float32
         for leaf in jax.tree.leaves(variables["batch_stats"]):
             assert leaf.dtype == jnp.float32
+
+    def test_remat_blocks_identical_values_and_grads(self):
+        """remat=True saves only block boundaries; values, grads, and
+        batch_stats updates must be numerically identical."""
+        def build(remat):
+            return ResNet50(num_classes=10, dtype=jnp.float32,
+                            norm_dtype=jnp.float32, remat=remat)
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+        variables = build(False).init(jax.random.PRNGKey(1), x, train=False)
+
+        def loss(model, params, stats):
+            def inner(p):
+                out, mut = model.apply(
+                    {"params": p, "batch_stats": stats}, x, train=True,
+                    mutable=["batch_stats"])
+                return out.sum(), mut["batch_stats"]
+            (val, new_stats), grads = jax.value_and_grad(inner, has_aux=True)(params)
+            return val, new_stats, grads
+
+        v0, s0, g0 = loss(build(False), variables["params"], variables["batch_stats"])
+        v1, s1, g1 = loss(build(True), variables["params"], variables["batch_stats"])
+        assert np.allclose(v0, v1, atol=1e-5)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+        for a, b in zip(jax.tree.leaves(s0), jax.tree.leaves(s1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
