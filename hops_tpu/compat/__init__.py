@@ -24,6 +24,8 @@ from hops_tpu.compat import (  # noqa: F401
     experiment,
     hdfs,
     hive,
+    numpy_helper,
+    pandas_helper,
     jobs,
     kafka,
     maggy,

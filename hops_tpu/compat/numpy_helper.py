@@ -1,0 +1,34 @@
+"""`hops.numpy_helper` shim (reference surface: ml/numpy/numpy-hdfs.ipynb).
+
+The reference wraps numpy IO so ``.npy`` files live in the project
+filesystem: ``numpy.load("TourData/numpy/C_test.npy")`` and
+``numpy.save("Resources/out.npy", arr)`` accept project-relative or
+full project paths. Same contract here over the workspace tree; paths
+resolve directly (so ``mmap_mode`` and all numpy kwargs work).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from hops_tpu.runtime import fs
+
+
+def load(path: str, **kwargs):
+    """np.load from a project-relative (or absolute workspace) path."""
+    return np.load(fs.resolve(path), **kwargs)
+
+
+def save(path: str, arr) -> str:
+    """np.save to a project-relative (or absolute workspace) path."""
+    dest = fs.resolve(path)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    np.save(dest, arr)
+    return str(dest)
+
+
+def savez(path: str, *args, **kwargs) -> str:
+    dest = fs.resolve(path)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(dest, *args, **kwargs)
+    return str(dest)

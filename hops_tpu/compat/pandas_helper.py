@@ -1,0 +1,36 @@
+"""`hops.pandas_helper` shim (reference surface: ml/pandas/pandas-hdfs.ipynb).
+
+``pandas.read_csv(hdfs.project_path() + "/TourData/census/adult.data",
+names=..., sep=...)`` and ``pandas.write_csv("Resources/out.csv", df)``
+in the reference route pandas IO through the project filesystem; here
+the paths resolve into the workspace tree and all pandas keyword
+arguments pass through.
+"""
+
+from __future__ import annotations
+
+import pandas as pd
+
+from hops_tpu.runtime import fs
+
+
+def read_csv(path: str, **kwargs) -> pd.DataFrame:
+    return pd.read_csv(fs.resolve(path), **kwargs)
+
+
+def write_csv(path: str, df: pd.DataFrame, **kwargs) -> str:
+    dest = fs.resolve(path)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    df.to_csv(dest, index=kwargs.pop("index", False), **kwargs)
+    return str(dest)
+
+
+def read_parquet(path: str, **kwargs) -> pd.DataFrame:
+    return pd.read_parquet(fs.resolve(path), **kwargs)
+
+
+def write_parquet(path: str, df: pd.DataFrame, **kwargs) -> str:
+    dest = fs.resolve(path)
+    dest.parent.mkdir(parents=True, exist_ok=True)
+    df.to_parquet(dest, index=kwargs.pop("index", False), **kwargs)
+    return str(dest)

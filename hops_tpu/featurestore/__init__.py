@@ -32,6 +32,7 @@ from hops_tpu.featurestore.query import Query  # noqa: F401
 from hops_tpu.featurestore.statistics import StatisticsConfig  # noqa: F401
 from hops_tpu.featurestore.training_dataset import TrainingDataset  # noqa: F401
 from hops_tpu.featurestore.validation import Expectation, Rule  # noqa: F401
+from hops_tpu.featurestore import bias  # noqa: F401
 
 __all__ = [
     "Connection",
@@ -45,4 +46,5 @@ __all__ = [
     "TrainingDataset",
     "Expectation",
     "Rule",
+    "bias",
 ]

@@ -60,9 +60,14 @@ def project_path(rel: str = "") -> str:
     return str(root / rel) if rel else str(root) + os.sep
 
 
-def _abs(path: str | Path) -> Path:
+def resolve(path: str | Path) -> Path:
+    """Absolute workspace path: relative inputs anchor at the project
+    root, absolute inputs pass through."""
     p = Path(path)
     return p if p.is_absolute() else Path(project_path(str(p)))
+
+
+_abs = resolve  # internal alias used throughout this module
 
 
 # -- basic ops (reference: HopsFSOperations.ipynb cells 3-19) ----------------

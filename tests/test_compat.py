@@ -126,3 +126,27 @@ def test_jobs_and_dataset_cells(tmp_path):
     ex = jobs.start_job("compat_job")
     done = jobs.wait_for_completion("compat_job", ex.execution_id, timeout_s=30)
     assert done.state == "FINISHED"
+
+
+def test_numpy_pandas_helper_cells(tmp_path):
+    """ml/numpy/numpy-hdfs.ipynb + ml/pandas/pandas-hdfs.ipynb: numpy
+    and pandas IO routed through project paths, relative or absolute."""
+    import pandas as pd
+
+    from hops_tpu.compat import hdfs, numpy_helper, pandas_helper
+
+    arr = np.arange(12.0).reshape(3, 4)
+    numpy_helper.save("Resources/project-relative-path.npy", arr)
+    np.testing.assert_array_equal(
+        numpy_helper.load("Resources/project-relative-path.npy"), arr)
+    # the notebook's second form: a full project path
+    numpy_helper.save(hdfs.project_path() + "Resources/full-path.npy", arr)
+    np.testing.assert_array_equal(
+        numpy_helper.load("Resources/full-path.npy"), arr)
+
+    df = pd.DataFrame({"Age": [39, 50], "Target": ["<=50K", ">50K"]})
+    pandas_helper.write_csv("Resources/adult.csv", df)
+    back = pandas_helper.read_csv(hdfs.project_path() + "Resources/adult.csv")
+    assert list(back["Age"]) == [39, 50]
+    pandas_helper.write_parquet("Resources/adult.parquet", df)
+    assert len(pandas_helper.read_parquet("Resources/adult.parquet")) == 2

@@ -865,3 +865,89 @@ class TestTrainingDatasetConnectorRegressions:
             again.read()
         again.delete()  # must not raise
         assert not again.meta_dir.exists()
+
+
+class TestBias:
+    """Slice/fairness analysis (feature-bias-whatif.ipynb role)."""
+
+    @staticmethod
+    def _frame():
+        import numpy as np
+
+        # Group A: perfect classifier. Group B: catches half the
+        # positives. Known-answer disparities follow.
+        n = 100
+        y = np.r_[np.ones(50), np.zeros(50), np.ones(50), np.zeros(50)].astype(int)
+        yhat = y.copy()
+        yhat[100:150] = np.r_[np.ones(25), np.zeros(25)].astype(int)  # B: tpr 0.5
+        return pd.DataFrame({
+            "group": ["A"] * n + ["B"] * n, "label": y, "pred": yhat,
+        })
+
+    def test_slice_metrics_known_answers(self):
+        from hops_tpu.featurestore import bias
+
+        m = bias.slice_metrics(self._frame(), "label", "pred", "group")
+        a = m[m["group"] == "A"].iloc[0]
+        b = m[m["group"] == "B"].iloc[0]
+        assert a["accuracy"] == 1.0 and a["tpr"] == 1.0 and a["acceptance_rate"] == 0.5
+        assert b["tpr"] == 0.5 and b["accuracy"] == 0.75 and b["acceptance_rate"] == 0.25
+
+    def test_disparity_and_report(self):
+        from hops_tpu.featurestore import bias
+
+        rep = bias.bias_report(self._frame(), "label", "pred", "group")
+        assert rep["demographic_parity"]["gap"] == pytest.approx(0.25)
+        assert rep["demographic_parity"]["max_group"] == "A"
+        assert rep["equal_opportunity"]["gap"] == pytest.approx(0.5)
+        assert rep["accuracy_gap"]["gap"] == pytest.approx(0.25)
+
+    def test_threshold_binarizes_scores(self):
+        import numpy as np
+        from hops_tpu.featurestore import bias
+
+        df = pd.DataFrame({
+            "g": ["x", "x", "y", "y"], "label": [1, 0, 1, 0],
+            "score": [0.9, 0.2, 0.4, 0.1],
+        })
+        m = bias.slice_metrics(df, "label", "score", "g", threshold=0.5)
+        assert m[m["g"] == "x"]["accuracy"].iloc[0] == 1.0
+        assert m[m["g"] == "y"]["tpr"].iloc[0] == 0.0  # 0.4 < 0.5 missed
+
+        sweep = bias.threshold_sweep(df, "label", "score", "g",
+                                     thresholds=[0.3, 0.5])
+        # At 0.3 both positives accepted (tpr gap 0); at 0.5 only x's.
+        assert sweep.loc[sweep["threshold"] == 0.3, "overall_accuracy"].iloc[0] == 1.0
+
+    def test_multi_column_slices(self):
+        from hops_tpu.featurestore import bias
+
+        df = self._frame()
+        df["age"] = (["young"] * 50 + ["old"] * 50) * 2
+        m = bias.slice_metrics(df, "label", "pred", ["group", "age"])
+        assert len(m) == 4
+        d = bias.disparity(m, "tpr")
+        # Positives live only in the young slices: A/young tpr=1.0 vs
+        # B/young tpr=0.5; the all-negative old slices are NaN-dropped.
+        assert d["gap"] == pytest.approx(0.5)
+        assert d["max_group"] == ("A", "young")
+
+    def test_non_binary_labels_fail_fast(self):
+        """Census-style string labels must be binarized, not silently
+        compared against 1 (which would report zero disparity)."""
+        from hops_tpu.featurestore import bias
+
+        df = pd.DataFrame({"g": ["A", "B"], "label": ["<=50K", ">50K"],
+                           "pred": [0, 1]})
+        with pytest.raises(ValueError, match="binarize"):
+            bias.slice_metrics(df, "label", "pred", "g")
+        df2 = pd.DataFrame({"g": ["A", "B"], "label": [0, 1], "pred": [0.7, 0.4]})
+        with pytest.raises(ValueError, match="threshold"):
+            bias.slice_metrics(df2, "label", "pred", "g")
+
+    def test_slice_column_name_collision_rejected(self):
+        from hops_tpu.featurestore import bias
+
+        df = pd.DataFrame({"count": ["A", "B"], "label": [0, 1], "pred": [0, 1]})
+        with pytest.raises(ValueError, match="collide"):
+            bias.slice_metrics(df, "label", "pred", "count")
