@@ -20,15 +20,18 @@ def load(path: str, **kwargs):
 
 
 def save(path: str, arr) -> str:
-    """np.save to a project-relative (or absolute workspace) path."""
+    """np.save to a project-relative (or absolute workspace) path.
+
+    Returns the path actually written: numpy appends ``.npy`` when the
+    input lacks it, and so does the return value."""
     dest = fs.resolve(path)
     dest.parent.mkdir(parents=True, exist_ok=True)
     np.save(dest, arr)
-    return str(dest)
+    return str(dest if dest.suffix == ".npy" else dest.with_name(dest.name + ".npy"))
 
 
 def savez(path: str, *args, **kwargs) -> str:
     dest = fs.resolve(path)
     dest.parent.mkdir(parents=True, exist_ok=True)
     np.savez(dest, *args, **kwargs)
-    return str(dest)
+    return str(dest if dest.suffix == ".npz" else dest.with_name(dest.name + ".npz"))

@@ -53,11 +53,12 @@ def slice_metrics(
     """
     if isinstance(slice_by, str):
         slice_by = [slice_by]
-    clash = set(slice_by) & set(_METRIC_COLUMNS)
+    clash = set(slice_by) & (set(_METRIC_COLUMNS) | {"_y", "_yhat"})
     if clash:
         raise ValueError(
-            f"slice column(s) {sorted(clash)} collide with metric column "
-            f"names {_METRIC_COLUMNS}; rename them before slicing")
+            f"slice column(s) {sorted(clash)} collide with metric/scratch "
+            f"column names {_METRIC_COLUMNS + ('_y', '_yhat')}; rename them "
+            "before slicing")
     y = df[label].to_numpy()
     _require_binary(y, label, "label")
     yhat = df[prediction].to_numpy()
@@ -103,6 +104,11 @@ def disparity(metrics: pd.DataFrame, metric: str = "acceptance_rate") -> dict[st
     slice_cols = metrics.attrs.get(
         "slice_by",
         [c for c in metrics.columns if c not in _METRIC_COLUMNS])
+    if not slice_cols:
+        raise ValueError(
+            "metrics frame has no slice columns (every column matches a "
+            "metric name); build it with slice_metrics or include the "
+            "group column")
     hi, lo = vals.idxmax(), vals.idxmin()
     name = lambda i: tuple(metrics.loc[i, c] for c in slice_cols)  # noqa: E731
     return {
