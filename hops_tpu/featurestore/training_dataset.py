@@ -471,4 +471,6 @@ def _read_recordio(path) -> pd.DataFrame:
     from hops_tpu.native.recordio import RecordReader
 
     with RecordReader(path) as r:
-        return pd.DataFrame([json.loads(rec) for rec in r])
+        return pd.DataFrame(
+            [json.loads(rec) for rec in r.read_batch(range(len(r)))]
+        )

@@ -11,12 +11,15 @@
 // Layout: <path>      = [u32 len][bytes]...
 //         <path>.idx  = [u64 offset]... (offset of each record's header)
 
+#include <unistd.h>
+
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -30,6 +33,7 @@ struct Writer {
 
 struct Reader {
   std::FILE* f;
+  int fd;  // for positioned (pread) batch reads
   std::vector<uint64_t> offsets;
   std::mutex mu;
 };
@@ -102,6 +106,7 @@ void* rio_reader_open(const char* path) {
       pos += sizeof len + len;
     }
   }
+  r->fd = fileno(r->f);
   return r;
 }
 
@@ -128,6 +133,88 @@ int rio_read(void* h, uint64_t i, char** out, uint32_t* out_len) {
 }
 
 void rio_free(char* p) { std::free(p); }
+
+// Gather the records named by ``indices`` into ONE malloc'd buffer,
+// packed back-to-back in the given order. Record lengths land in
+// ``lens`` (caller-allocated, n entries); *out_total is the packed
+// size. Positioned reads (pread) on the shared fd — thread-safe per
+// POSIX, no seek contention, no mutex — fanned over ``n_threads``
+// worker threads. This is the feeder's batch path: one ctypes call
+// per training batch instead of one per record.
+int rio_read_batch(void* h, const uint64_t* indices, uint32_t n,
+                   uint32_t n_threads, char** out, uint64_t* out_total,
+                   uint64_t* lens) {
+  auto* r = static_cast<Reader*>(h);
+  const uint64_t nrec = r->offsets.size();
+  uint64_t total = 0;
+  for (uint32_t i = 0; i < n; ++i) {
+    const uint64_t idx = indices[i];
+    if (idx >= nrec) return -1;
+    if (idx + 1 < nrec) {
+      // Records are contiguous, so consecutive offsets give the length
+      // without touching the disk.
+      if (r->offsets[idx + 1] < r->offsets[idx] + sizeof(uint32_t)) return -2;
+      lens[i] = r->offsets[idx + 1] - r->offsets[idx] - sizeof(uint32_t);
+    } else {
+      // Only the final record needs its header consulted: a stale .idx
+      // must not stretch it over trailing unindexed data.
+      uint32_t hdr;
+      if (pread(r->fd, &hdr, sizeof hdr, (off_t)r->offsets[idx]) !=
+          (ssize_t)sizeof hdr)
+        return -2;
+      lens[i] = hdr;
+    }
+    total += lens[i];
+  }
+  char* buf = (char*)std::malloc(total ? total : 1);
+  if (!buf) return -3;
+
+  // Prefix positions of each record inside the packed buffer.
+  std::vector<uint64_t> dst(n);
+  uint64_t pos = 0;
+  for (uint32_t i = 0; i < n; ++i) {
+    dst[i] = pos;
+    pos += lens[i];
+  }
+
+  const uint32_t workers =
+      n_threads == 0 ? 1 : (n_threads < n ? n_threads : (n ? n : 1));
+  std::vector<int> rcs(workers, 0);
+  auto work = [&](uint32_t w) {
+    for (uint32_t i = w; i < n; i += workers) {
+      uint64_t remaining = lens[i];
+      uint64_t src = r->offsets[indices[i]] + sizeof(uint32_t);
+      char* d = buf + dst[i];
+      while (remaining) {
+        ssize_t got = pread(r->fd, d, remaining, (off_t)src);
+        if (got <= 0) {
+          rcs[w] = -4;
+          return;
+        }
+        remaining -= (uint64_t)got;
+        src += (uint64_t)got;
+        d += got;
+      }
+    }
+  };
+  if (workers == 1) {
+    work(0);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(workers);
+    for (uint32_t w = 0; w < workers; ++w) threads.emplace_back(work, w);
+    for (auto& t : threads) t.join();
+  }
+  for (uint32_t w = 0; w < workers; ++w) {
+    if (rcs[w] != 0) {
+      std::free(buf);
+      return rcs[w];
+    }
+  }
+  *out = buf;
+  *out_total = total;
+  return 0;
+}
 
 void rio_reader_close(void* h) {
   auto* r = static_cast<Reader*>(h);
