@@ -71,6 +71,7 @@ class DataFeeder:
         transform: Callable[[np.ndarray, Any], Any] | None = None,
         process_sharded: bool = False,
         sharding: Any = None,
+        start_step: int = 0,
     ) -> Iterator:
         """Yield ``(x, y)`` (or ``x`` when no target) NumPy batches.
 
@@ -90,6 +91,14 @@ class DataFeeder:
         iterator assembles global ``jax.Array``s itself via
         ``jax.make_array_from_process_local_data``, so a
         ``collective_all_reduce`` step consumes the feeder directly.
+
+        Preemption resume (``start_step``): the stream is a pure
+        function of ``seed``, so ``start_step=k`` fast-forwards to
+        exactly the batch a fresh iterator would yield k-th — restored
+        training continues the same shuffle order mid-epoch instead of
+        re-seeing early batches (pair with
+        ``runtime.preemption.run_preemptible``, which knows the
+        restored step count).
         """
         if shuffle is None:
             shuffle = self.is_training
@@ -160,11 +169,20 @@ class DataFeeder:
                 batch,
             )
 
+        end = n - (n % batch_size) if drop_remainder else n
+        steps_per_epoch = max(1, (end + batch_size - 1) // batch_size)
+        skip_epochs, skip_steps = divmod(start_step, steps_per_epoch)
+
         epoch = 0
         while num_epochs is None or epoch < num_epochs:
             order = rng.permutation(n) if shuffle else np.arange(n)
-            end = n - (n % batch_size) if drop_remainder else n
-            for start in range(0, end, batch_size):
+            if epoch < skip_epochs:
+                # Consume this epoch's permutation draw and move on —
+                # the RNG stream must stay aligned with an unskipped run.
+                epoch += 1
+                continue
+            first = skip_steps * batch_size if epoch == skip_epochs else 0
+            for start in range(first, end, batch_size):
                 idx = order[start + lo:start + lo + local_bs]
                 bx = x[idx]
                 by = y[idx] if y is not None else None

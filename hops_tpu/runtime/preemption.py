@@ -129,10 +129,16 @@ def run_preemptible(
     """Checkpointed, preemption-safe training loop.
 
     Resumes from the latest checkpoint under ``directory`` (the active
-    run's ``checkpoints/`` by default), steps through ``batches``
-    (an iterable; steps already completed before resume are skipped),
+    run's ``checkpoints/`` by default), steps through ``batches``,
     saves every ``save_every`` steps, and on preemption saves once more
     and returns early. Returns ``(state, last_metrics, completed_steps)``.
+
+    ``batches`` is either a plain iterable — steps already completed
+    before resume are drawn and discarded — or a callable
+    ``batches(start_step) -> iterable`` that produces the stream
+    already fast-forwarded (e.g.
+    ``lambda k: feeder.numpy_iterator(..., start_step=k)``), so resume
+    skips no data materialization at all.
     """
     import jax
 
@@ -145,10 +151,14 @@ def run_preemptible(
     state, start = restore_or_init(state, directory)
     metrics = None
     step = start - 1
+    if callable(batches):
+        stream = enumerate(batches(start), start=start)
+    else:
+        stream = enumerate(batches)
     try:
         with CheckpointManager(directory, save_interval_steps=save_every) as ckpt:
             saved = ran = False
-            for step, batch in enumerate(batches):
+            for step, batch in stream:
                 if step < start:
                     continue  # consumed by a previous incarnation
                 ran = True

@@ -257,3 +257,40 @@ def test_restore_onto_smaller_mesh(tmp_path):
     leaf = jax.tree.leaves(restored.params)[0]
     assert set(leaf.sharding.device_set) == set(jax.devices()[:4])
     jax.tree.map(np.testing.assert_allclose, restored.params, state.params)
+
+
+def test_run_preemptible_callable_batches_fast_forward(tmp_path):
+    """batches may be callable(start_step) -> iterable: the resumed
+    incarnation's stream starts AT the restored step (no draw-and-
+    discard), and results match the plain-iterable path exactly."""
+    from hops_tpu.runtime.preemption import PreemptionGuard, run_preemptible
+
+    step_fn = jax.jit(common.make_train_step())
+    rs = np.random.RandomState(0)
+    all_batches = [
+        {"image": rs.rand(2, 28, 28, 1).astype(np.float32),
+         "label": rs.randint(0, 10, 2)}
+        for _ in range(6)
+    ]
+    requested = []
+
+    def make_stream(start):
+        requested.append(start)
+        return all_batches[start:]
+
+    guard = PreemptionGuard(install=False)
+    calls = []
+
+    def preempting_step(state, batch):
+        calls.append(1)
+        if len(calls) == 3:
+            guard.notice()
+        return step_fn(state, batch)
+
+    run_preemptible(preempting_step, _state(), make_stream,
+                    directory=str(tmp_path / "ck"), save_every=100, guard=guard)
+    state2, _, done2 = run_preemptible(
+        step_fn, _state(), make_stream, directory=str(tmp_path / "ck"),
+        save_every=100, guard=PreemptionGuard(install=False))
+    assert requested == [0, 3]  # second stream born fast-forwarded
+    assert done2 == 6 and int(state2.step) == 6

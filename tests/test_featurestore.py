@@ -383,6 +383,22 @@ class TestTrainingDataset:
         b = next(it)
         assert set(b) == {"image", "label"}
 
+    def test_feeder_start_step_resumes_exact_stream(self, fs):
+        """Preemption resume: start_step=k yields exactly what a fresh
+        iterator yields from its k-th batch on — same shuffle order,
+        across epoch boundaries (pairs with preemption.run_preemptible)."""
+        td = self.make_td(fs)
+        feeder = td.tf_data(target_name="sales")
+        kw = dict(batch_size=2, num_epochs=3, seed=7)  # 2 steps/epoch
+        full = list(feeder.numpy_iterator(**kw))
+        assert len(full) == 6
+        for k in (1, 2, 3, 5):  # mid-epoch, boundary, into later epochs
+            resumed = list(feeder.numpy_iterator(**kw, start_step=k))
+            assert len(resumed) == 6 - k
+            for (fx, fy), (rx, ry) in zip(full[k:], resumed):
+                np.testing.assert_array_equal(fx, rx)
+                np.testing.assert_array_equal(fy, ry)
+
     def test_feeder_process_sharded(self, fs):
         """VERDICT r3 item 6 (single-process leg; the two-process leg is
         tests/test_multihost_integration.py): process_sharded yields
