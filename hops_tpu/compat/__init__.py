@@ -18,6 +18,7 @@ first-class surface.
 """
 
 from hops_tpu.compat import (  # noqa: F401
+    beam,
     dataset,
     devices,
     elasticsearch,

@@ -1,0 +1,8 @@
+"""`hops.beam` shim (reference surface: jobs_flink_client.py:45-51).
+
+``beam.create_runner(name, ...)`` / ``beam.start_runner(name)`` manage
+a long-lived streaming runner; here they front the TPU build's
+streaming-job layer (`hops_tpu.jobs.streaming`).
+"""
+
+from hops_tpu.jobs.streaming import create_runner, start_runner  # noqa: F401

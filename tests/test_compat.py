@@ -150,3 +150,31 @@ def test_numpy_pandas_helper_cells(tmp_path):
     assert list(back["Age"]) == [39, 50]
     pandas_helper.write_parquet("Resources/adult.parquet", df)
     assert len(pandas_helper.read_parquet("Resources/adult.parquet")) == 2
+
+
+def test_beam_runner_cells(tmp_path):
+    """jobs_flink_client.py:45-51: beam.create_runner/start_runner keep
+    a named long-lived runner; reuse by name, stop via the runner."""
+    from hops_tpu.compat import beam, kafka
+
+    producer = kafka.Producer("beam-topic")
+    producer.send({"v": 1})
+    producer.send({"v": 2})
+    runner = beam.create_runner("fl", topic="beam-topic",
+                                sink_dir=str(tmp_path / "sink"))
+    assert beam.create_runner("fl", topic="beam-topic") is runner  # reuse
+    beam.start_runner("fl")
+    try:
+        import time
+        deadline = time.time() + 10
+        sink = tmp_path / "sink"
+        while time.time() < deadline and not list(sink.glob("part-*.parquet")):
+            time.sleep(0.05)
+    finally:
+        runner.stop()  # drains before stopping
+    import pandas as pd
+
+    parts = sorted(sink.glob("part-*.parquet"))
+    assert parts, "runner wrote no parquet parts"
+    rows = pd.concat([pd.read_parquet(p) for p in parts])
+    assert sorted(rows["v"]) == [1, 2]
