@@ -82,11 +82,18 @@ def attention_reference(
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
     s = s * sm_scale
     if causal:
-        q_pos = jnp.arange(q.shape[2])[:, None] + q_offset
+        # q_offset may be per-batch (shape (b,) — the ragged-decode
+        # path, each row's chunk at its own absolute position) or a
+        # scalar; the mask broadcasts to (b, 1, sq, sk) either way.
+        off = jnp.asarray(q_offset)
+        off = off[:, None, None] if off.ndim == 1 else off
+        q_pos = jnp.arange(q.shape[2])[:, None] + off
         k_pos = jnp.arange(k.shape[2])[None, :]
         visible = q_pos >= k_pos
         if window is not None:
             visible &= q_pos - k_pos < window
+        if visible.ndim == 3:
+            visible = visible[:, None]
         s = jnp.where(visible, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
@@ -530,20 +537,42 @@ def decode_attention_reference(
     this to a badly-tiled matvec fusion at s=1 (~90 GB/s measured;
     BENCHMARKS.md "KV-cached decoding") — kept only as ground truth
     and shape fallback. Fewer kv heads than q heads (GQA) broadcast.
+    ``valid_len`` may be a scalar or a (b,) vector (ragged decode).
     """
+    vl = _normalize_valid_len(valid_len, q.shape[0])
     k, v = repeat_kv(q, k, v)
-    return attention_reference(
+    out = attention_reference(
         q, k, v, causal=True, sm_scale=sm_scale,
-        q_offset=valid_len - q.shape[2], window=window,
+        q_offset=vl - q.shape[2], window=window,
     )
+    # Honor the kernel's free-slot contract on this path too: a vl == 0
+    # row has every key masked, which NaNs the XLA softmax — the kernel
+    # substitutes l = 1 and emits zeros, so do the same here.
+    return jnp.where((vl > 0)[:, None, None, None], out, 0.0)
 
 
-def _read_scalar(ref):
-    """First element of a scalar-prefetch operand. Kernel bodies get a
-    (1,)-shaped SMEM ref; BlockSpec index maps may receive the scalar
-    already unwrapped to 0-d depending on the Pallas version — accept
-    both (the rank is static, so this branches at trace time)."""
-    return ref if getattr(ref, "ndim", None) == 0 else ref[0]
+def _normalize_valid_len(valid_len: jax.Array, b: int) -> jax.Array:
+    """``valid_len`` as a (b,) int32 vector: a scalar broadcasts
+    (uniform decode), a (b,) vector passes through (ragged decode —
+    each batch row's cache at its own position). Anything else is a
+    caller bug."""
+    vl = jnp.asarray(valid_len, jnp.int32)
+    if vl.ndim == 0:
+        return jnp.broadcast_to(vl, (b,))
+    if vl.shape != (b,):
+        raise ValueError(
+            f"valid_len must be a scalar or shape ({b},), got {vl.shape}"
+        )
+    return vl
+
+
+def _read_vl(ref, i):
+    """``valid_len`` for grid row ``i`` from the scalar-prefetch
+    operand (pre-expanded to one entry per (batch, kv-head) grid row).
+    Some Pallas versions unwrap a 1-element operand to 0-d in BlockSpec
+    index maps — accept both (the rank is static, so this branches at
+    trace time)."""
+    return ref if getattr(ref, "ndim", None) == 0 else ref[i]
 
 
 def _decode_block_range(vl, *, block_k, s, window):
@@ -592,7 +621,7 @@ def _decode_kernel(
     """
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
-    vl = _read_scalar(vl_ref)
+    vl = _read_vl(vl_ref, pl.program_id(0))
 
     @pl.when(kj == 0)
     def _init():
@@ -637,9 +666,13 @@ def decode_attention(
 ) -> jax.Array:
     """Attention for KV-cached decoding: ``q`` (b, h, s, d) against
     fixed-capacity caches (b, h, capacity, d) of which the first
-    ``valid_len`` positions are written (``valid_len`` is a traced
-    scalar — the cache index AFTER the current chunk was stored; query
-    row i sits at absolute position ``valid_len - s + i``).
+    ``valid_len`` positions are written (``valid_len`` is traced — the
+    cache index AFTER the current chunk was stored; query row i sits at
+    absolute position ``valid_len - s + i``). A scalar ``valid_len``
+    is the uniform-batch case; a ``(b,)`` vector gives every row its
+    own position — the ragged/continuous-batching path, where each
+    grid row masks and clamps its DMA by its own length (a ``vl == 0``
+    row attends nothing and outputs zeros).
 
     The XLA formulation (:func:`decode_attention_reference`) lowers the
     s=1 matvec + mask + softmax chain to a fusion that sustains only
@@ -676,6 +709,7 @@ def decode_attention(
     # streams the SMALL cache once (no head-repeat materialization).
     g = h // hkv
     rows = g * s
+    valid_len = _normalize_valid_len(valid_len, b)  # scalar or (b,) ragged
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
     if block_k is None:
@@ -703,7 +737,10 @@ def decode_attention(
     qf = q.reshape(bh, rows, d)
     if q_rows != rows:
         qf = jnp.pad(qf, ((0, 0), (0, q_rows - rows), (0, 0)))
-    vl = jnp.asarray(valid_len, jnp.int32).reshape(1)
+    # One valid_len per (batch, kv-head) grid row — pre-expanding the
+    # (b,) vector to (bh,) keeps the index maps free of a batch/head
+    # division.
+    vl = jnp.repeat(valid_len, hkv)
 
     # Index maps receive (*grid_indices, *scalar_prefetch_refs); kernel
     # bodies receive the scalar refs FIRST — Pallas's convention.
@@ -711,7 +748,7 @@ def decode_attention(
         # Out-of-range grid steps revisit the range edge's block: same
         # window as an in-range neighbor step -> Mosaic issues no copy.
         first, last = _decode_block_range(
-            _read_scalar(vl_ref), block_k=block_k, s=s, window=window
+            _read_vl(vl_ref, bi), block_k=block_k, s=s, window=window
         )
         return bi, jnp.clip(kj, first, last), 0
 
@@ -791,7 +828,7 @@ def _decode_q8_kernel(
     shared online-softmax update — HBM sees half the bytes."""
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
-    vl = _read_scalar(vl_ref)
+    vl = _read_vl(vl_ref, pl.program_id(0))
 
     @pl.when(kj == 0)
     def _init():

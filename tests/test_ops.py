@@ -444,6 +444,117 @@ def test_decode_block_range_clamps_dma_to_valid_prefix():
     assert (int(first), int(last)) == (7, 7)   # only the newest block
 
 
+# -- ragged decode: per-row valid_len (continuous batching) ------------------
+
+
+def test_decode_attention_ragged_matches_per_row():
+    """A (b,) valid_len equals running each row alone with its scalar
+    length — the continuous-batching contract, on both the kernel and
+    the XLA reference path."""
+    from hops_tpu.ops.attention import decode_attention, decode_attention_reference
+
+    b = 4
+    k, v = _cache_inputs(batch=b, heads=4, cap=512)
+    q, _, _ = _inputs(batch=b, heads=4, seq=1, d=64, seed=2)
+    vls = jnp.array([1, 77, 300, 512], jnp.int32)
+    out = decode_attention(q, k, v, vls, block_k=128)
+    ref = decode_attention_reference(q, k, v, vls)
+    for i in range(b):
+        row = decode_attention(
+            q[i : i + 1], k[i : i + 1], v[i : i + 1], vls[i], block_k=128
+        )
+        np.testing.assert_allclose(out[i : i + 1], row, atol=2e-6, rtol=2e-6)
+        np.testing.assert_allclose(ref[i : i + 1], row, atol=2e-6, rtol=2e-6)
+
+
+def test_decode_attention_ragged_zero_rows_output_zero():
+    """vl == 0 marks a free slot: it attends nothing and outputs exact
+    zeros (no NaN from the empty softmax), while live rows are
+    untouched."""
+    from hops_tpu.ops.attention import decode_attention
+
+    k, v = _cache_inputs(batch=3, heads=2, cap=256)
+    q, _, _ = _inputs(batch=3, heads=2, seq=1, d=64, seed=2)
+    vls = jnp.array([128, 0, 7], jnp.int32)
+    out = decode_attention(q, k, v, vls, block_k=128)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    np.testing.assert_array_equal(out[1], jnp.zeros_like(out[1]))
+    alone = decode_attention(q[:1], k[:1], v[:1], jnp.int32(128), block_k=128)
+    np.testing.assert_allclose(out[:1], alone, atol=2e-6, rtol=2e-6)
+
+
+def test_decode_attention_ragged_gqa_q8_window():
+    """The ragged vector composes with every decode knob: GQA row
+    folding, int8 cache, sliding window — against the per-row scalar
+    runs."""
+    from hops_tpu.ops.attention import decode_attention_q8, quantize_kv
+
+    b, h, hkv = 3, 4, 2
+    k, v = _cache_inputs(batch=b, heads=hkv, cap=512)
+    q, _, _ = _inputs(batch=b, heads=h, seq=1, d=64, seed=5)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    vls = jnp.array([64, 411, 512], jnp.int32)
+    out = decode_attention_q8(q, kq, vq, ks, vs, vls, block_k=128, window=96)
+    for i in range(b):
+        row = decode_attention_q8(
+            q[i : i + 1], kq[i : i + 1], vq[i : i + 1],
+            ks[i : i + 1], vs[i : i + 1], vls[i], block_k=128, window=96,
+        )
+        np.testing.assert_allclose(out[i : i + 1], row, atol=1e-6, rtol=1e-6)
+
+
+def test_decode_attention_ragged_fallback_path():
+    """Odd capacity routes ragged calls to the XLA reference, which
+    must honor the per-row lengths too."""
+    from hops_tpu.ops.attention import decode_attention, decode_attention_reference
+
+    k, v = _cache_inputs(batch=3, heads=2, cap=100)
+    q, _, _ = _inputs(batch=3, heads=2, seq=1, d=64, seed=2)
+    vls = jnp.array([30, 99, 0], jnp.int32)
+    out = decode_attention(q, k, v, vls)
+    for i in range(2):
+        row = decode_attention_reference(
+            q[i : i + 1], k[i : i + 1], v[i : i + 1], vls[i]
+        )
+        np.testing.assert_allclose(out[i : i + 1], row, atol=2e-6, rtol=2e-6)
+    # The free-slot contract holds on the fallback path too: zeros, not
+    # the NaN an all-masked XLA softmax would produce.
+    np.testing.assert_array_equal(out[2], jnp.zeros_like(out[2]))
+
+
+def test_decode_attention_bad_valid_len_shape_raises():
+    from hops_tpu.ops.attention import decode_attention
+
+    k, v = _cache_inputs(batch=2, heads=2, cap=256)
+    q, _, _ = _inputs(batch=2, heads=2, seq=1, d=64, seed=2)
+    with pytest.raises(ValueError, match="valid_len"):
+        decode_attention(q, k, v, jnp.zeros((3,), jnp.int32), block_k=128)
+    with pytest.raises(ValueError, match="valid_len"):
+        decode_attention(q, k, v, jnp.zeros((2, 1), jnp.int32), block_k=128)
+
+
+def test_decode_attention_ragged_traced_under_scan():
+    """The ragged vector rides a scan carry — one compiled program, all
+    rows advancing independently."""
+    from hops_tpu.ops.attention import decode_attention, decode_attention_reference
+
+    k, v = _cache_inputs(batch=2, heads=2, cap=256)
+    q, _, _ = _inputs(batch=2, heads=2, seq=1, d=64, seed=2)
+    starts = jnp.array([3, 120], jnp.int32)
+
+    def run(fn):
+        def step(vls, _):
+            return vls + 1, fn(q, k, v, vls)
+
+        _, outs = jax.lax.scan(step, starts, None, length=20)
+        return outs
+
+    outs = run(lambda q, k, v, vl: decode_attention(q, k, v, vl, block_k=128))
+    refs = run(decode_attention_reference)
+    np.testing.assert_allclose(outs, refs, atol=2e-6, rtol=2e-6)
+
+
 # -- chunked-vocab cross-entropy (ops/xent.py) -------------------------------
 
 
