@@ -104,11 +104,20 @@ def run_bench(
     local_batch = per_chip_batch * (jax.local_device_count() if multihost else n_chips)
     _note(f"backend up: {n_chips} chip(s), platform={jax.devices()[0].platform}")
 
-    state = strategy.replicate(
-        common.create_bn_train_state(
-            model, jax.random.PRNGKey(0), (per_chip_batch, image_size, image_size, 3)
-        )
+    # Init under ONE jit at a tiny batch: params and BN stats are
+    # batch-independent, and an eager init dispatches every conv as its
+    # own relay compile round-trip — ~100 chances for a transient
+    # UNAVAILABLE to kill the run (observed: rc=1 after 27 min inside
+    # model.init, HW_MEASURE.jsonl 2026-07-31). One small compiled
+    # program leaves the train-step compile as the only big request.
+    import functools
+
+    init_fn = functools.partial(
+        common.create_bn_train_state,
+        model,
+        input_shape=(8, image_size, image_size, 3),
     )
+    state = strategy.replicate(jax.jit(init_fn)(jax.random.PRNGKey(0)))
     _note("params initialized")
     train_step = common.make_bn_train_step()
 
@@ -132,7 +141,21 @@ def run_bench(
     )
 
     _note(f"compiling + warmup ({max(1, warmup // scan_chunk)} dispatches of {scan_chunk} steps)")
-    for _ in range(max(1, warmup // scan_chunk)):
+    # The first dispatch carries the big train-step compile. The relay
+    # intermittently answers a long compile with a transient
+    # UNAVAILABLE (HW_MEASURE.jsonl 2026-07-31); one retry — with the
+    # state re-initialized, since step_fn donates it — salvages the
+    # run instead of losing a 27-minute attempt.
+    try:
+        state, loss = step_fn(state, batch)
+    except jax.errors.JaxRuntimeError as e:
+        if "UNAVAILABLE" not in str(e):
+            raise
+        _note(f"transient UNAVAILABLE on first compile; retrying once: {str(e)[:200]}")
+        time.sleep(30)
+        state = strategy.replicate(jax.jit(init_fn)(jax.random.PRNGKey(0)))
+        state, loss = step_fn(state, batch)
+    for _ in range(max(1, warmup // scan_chunk) - 1):
         state, loss = step_fn(state, batch)
     _sync(loss)
     _note("warmup done, timing")

@@ -1,0 +1,309 @@
+"""Continuous batching for LM serving — slot-based decode scheduling.
+
+Beyond-reference capability (the reference's serving is one-shot
+classifier REST calls — SURVEY.md §2.5): requests of different prompt
+lengths and generation budgets share one fixed set of decode *slots*.
+Each engine iteration runs ONE decode dispatch for every live slot;
+a request that finishes frees its slot immediately and the next queued
+request takes it — no head-of-line blocking on the longest generation,
+which is where static-batch serving loses its throughput.
+
+TPU-shaped throughout:
+
+- The per-layer KV caches are ONE ``(slots, heads, capacity, d)``
+  buffer per layer, alive across requests. The cache index is a
+  ``(slots,)`` vector (``TransformerLM(ragged_decode=True)``), so every
+  slot advances independently and ``decode_attention`` masks/clamps
+  each row's DMA by its own length (``ops/attention.py`` ragged path).
+- Exactly three compiled programs, all static-shape: *prefill* (one per
+  prompt-length bucket), *insert* (splice a prefilled b=1 cache into a
+  slot row), and *step* (one token for all slots). Admission and
+  completion are host-side bookkeeping — no recompiles at any request
+  mix.
+- Free slots stay in the batch: the step program clamps their cache
+  index to 0 (an ``active`` mask), so a free row writes one position,
+  attends one block, and its token is discarded host-side — noise,
+  regardless of how long the slot's previous occupant was.
+
+Greedy decoding (temperature 0) — the contract is that interleaved
+continuous batching emits EXACTLY what per-request ``generate(...,
+temperature=0)`` would (tests/test_lm_engine.py parity).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _map_cache(cache: Any, fn_kv, fn_idx, *rest: Any) -> Any:
+    """Apply ``fn_kv`` to k/v/scale leaves and ``fn_idx`` to the 'idx'
+    leaves of a transformer KV-cache pytree (the same layout contract
+    as generation._rewind). Extra trees in ``rest`` (same treedef) are
+    zipped leaf-for-leaf into the callbacks — the single definition of
+    "walk a cache by leaf role" in this module."""
+    import jax.tree_util as jtu
+
+    hits = 0
+
+    def fix(path, leaf, *others):
+        nonlocal hits
+        name = str(path[-1].key) if hasattr(path[-1], "key") else ""
+        if name == "idx":
+            hits += 1
+            return fn_idx(leaf, *others)
+        return fn_kv(leaf, *others)
+
+    out = jtu.tree_map_with_path(fix, cache, *rest)
+    if not hits:
+        raise ValueError(
+            "cache has no 'idx' leaves — LMEngine requires the "
+            "transformer KV-cache layout (transformer.py _decode_attend)"
+        )
+    return out
+
+
+@dataclasses.dataclass
+class _Request:
+    ticket: int
+    prompt: np.ndarray  # (L,) int32
+    max_new_tokens: int
+    eos_id: int | None
+
+
+@dataclasses.dataclass
+class _SlotState:
+    ticket: int
+    emitted: list[int]
+    remaining: int
+    eos_id: int | None
+
+
+class LMEngine:
+    """Continuous-batching scheduler over ``slots`` concurrent decodes.
+
+    ``model`` must be built with ``ragged_decode=True`` and its
+    ``max_decode_len`` must cover every request's prompt + generation.
+    ``submit()`` enqueues and returns a ticket; ``step()`` runs one
+    engine iteration (admit into free slots, then one decode dispatch);
+    ``run()`` drains everything and returns ``{ticket: tokens}``.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        slots: int = 4,
+        prefill_buckets: tuple[int, ...] | None = None,
+    ):
+        if not getattr(model, "ragged_decode", False):
+            raise ValueError(
+                "LMEngine requires TransformerLM(ragged_decode=True) — "
+                "the (slots,) cache index is what lets rows advance "
+                "independently"
+            )
+        self.model = model
+        self.params = params
+        self.slots = slots
+        cap = model.max_decode_len
+        if prefill_buckets is None:
+            prefill_buckets = tuple(
+                b for b in (16, 32, 64, 128, 256, 512, 1024, 2048, 4096) if b < cap
+            ) or (cap,)
+        self.prefill_buckets = tuple(sorted(prefill_buckets))
+
+        # The persistent cache: init with a (slots, 1) dummy step, then
+        # zero every leaf — idx zeros mark all slots free.
+        dummy = jnp.zeros((slots, 1), jnp.int32)
+        _, variables = model.apply(
+            {"params": params}, dummy, decode=True, mutable=["cache"]
+        )
+        self._cache = _map_cache(
+            variables["cache"], jnp.zeros_like, jnp.zeros_like
+        )
+
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._slot_state: list[_SlotState | None] = [None] * slots
+        self._results: dict[int, list[int]] = {}
+        self._next_ticket = 0
+
+        # --- the three compiled programs -------------------------------
+        @functools.partial(jax.jit, static_argnames=())
+        def prefill(params, padded_prompt, true_len):
+            # b=1 fresh cache; pad garbage beyond true_len is masked by
+            # the ragged valid_len forever after (kernel invariant:
+            # test_decode_attention_ignores_garbage_past_valid_len).
+            logits, variables = model.apply(
+                {"params": params}, padded_prompt, decode=True, mutable=["cache"]
+            )
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0], true_len - 1, axis=0, keepdims=False
+            )
+            first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            cache = _map_cache(
+                variables["cache"],
+                lambda leaf: leaf,
+                lambda idx: jnp.full_like(idx, true_len),
+            )
+            return first_tok, cache
+
+        def insert(big, one, row, true_len):
+            # The b=1 tree shares the big tree's treedef — only the
+            # leading dims differ — so _map_cache zips them.
+            return _map_cache(
+                big,
+                lambda big_leaf, one_leaf: jax.lax.dynamic_update_slice(
+                    big_leaf, one_leaf, (row,) + (0,) * (big_leaf.ndim - 1)
+                ),
+                lambda big_idx, _one: jax.lax.dynamic_update_slice(
+                    big_idx, jnp.asarray([true_len], big_idx.dtype), (row,)
+                ),
+                one,
+            )
+
+        def step(params, cache, tokens, active):
+            # Clamp free rows' cache index to 0 BEFORE the apply: the
+            # decode write advances every row's idx, so without this a
+            # freed slot would keep its final length (streaming its
+            # whole stale cache each dispatch) and then grow without
+            # bound. Clamped, a free row writes one position at offset
+            # 0 and attends one block — actually "noise".
+            cache = _map_cache(
+                cache, lambda leaf: leaf, lambda idx: jnp.where(active, idx, 0)
+            )
+            logits, variables = model.apply(
+                {"params": params, "cache": cache},
+                tokens[:, None],
+                decode=True,
+                mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return nxt, variables["cache"]
+
+        self._prefill = prefill
+        self._insert = jax.jit(insert, donate_argnums=(0,))
+        self._step = jax.jit(step, donate_argnums=(1,))
+        # Telemetry: dispatches vs tokens emitted say how well slots
+        # stayed occupied (the continuous-batching win).
+        self.dispatches = 0
+        self.tokens_emitted = 0
+
+    # --- public API -----------------------------------------------------
+
+    def submit(
+        self,
+        prompt: Any,
+        max_new_tokens: int = 32,
+        eos_id: int | None = None,
+    ) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("empty prompt")
+        total = prompt.size + max_new_tokens
+        if total > self.model.max_decode_len:
+            raise ValueError(
+                f"prompt {prompt.size} + {max_new_tokens} new tokens "
+                f"exceeds max_decode_len {self.model.max_decode_len}"
+            )
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        ticket = self._next_ticket
+        self._next_ticket += 1
+        self._queue.append(_Request(ticket, prompt, max_new_tokens, eos_id))
+        return ticket
+
+    def step(self) -> list[int]:
+        """One engine iteration: admit queued requests into free slots,
+        then one decode dispatch for all slots. Returns tickets that
+        finished this iteration."""
+        finished = []
+        for row in range(self.slots):
+            if self._slot_state[row] is None and self._queue:
+                req = self._queue.popleft()
+                done = self._admit(req, row)
+                if done is not None:
+                    finished.append(done)
+        if not any(st is not None for st in self._slot_state):
+            return finished
+
+        tokens = jnp.asarray(
+            [st.emitted[-1] if st else 0 for st in self._slot_state], jnp.int32
+        )
+        active = jnp.asarray(
+            [st is not None for st in self._slot_state], jnp.bool_
+        )
+        nxt, self._cache = self._step(self.params, self._cache, tokens, active)
+        self.dispatches += 1
+        nxt = np.asarray(nxt)
+        for row, st in enumerate(self._slot_state):
+            if st is None:
+                continue
+            # _admit finishes exhausted/eos'd requests on the spot, so
+            # every slot that reaches a dispatch has work left.
+            assert st.remaining >= 1
+            tok = int(nxt[row])
+            st.emitted.append(tok)
+            st.remaining -= 1
+            self.tokens_emitted += 1
+            if st.remaining == 0 or (st.eos_id is not None and tok == st.eos_id):
+                finished.append(self._finish(row))
+        return finished
+
+    def run(self) -> dict[int, list[int]]:
+        """Drain the queue and all live slots; returns every result
+        collected so far (including earlier iterations')."""
+        while self._queue or any(st is not None for st in self._slot_state):
+            self.step()
+        return dict(self._results)
+
+    def result(self, ticket: int) -> list[int] | None:
+        """Generated tokens (prompt excluded) or None if not finished."""
+        return self._results.get(ticket)
+
+    # --- internals ------------------------------------------------------
+
+    def _bucket(self, n: int) -> int:
+        for b in self.prefill_buckets:
+            if n <= b:
+                return b
+        return self.model.max_decode_len
+
+    def _admit(self, req: _Request, row: int) -> int | None:
+        """Prefill ``req`` and splice it into slot ``row``. Returns the
+        ticket if the request finished at admission (budget of 1)."""
+        L = req.prompt.size
+        bucket = min(self._bucket(L), self.model.max_decode_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :L] = req.prompt
+        first_tok, one_cache = self._prefill(
+            self.params, jnp.asarray(padded), jnp.int32(L)
+        )
+        self._cache = self._insert(
+            self._cache, one_cache, jnp.int32(row), jnp.int32(L)
+        )
+        tok = int(first_tok)
+        self.tokens_emitted += 1
+        st = _SlotState(
+            ticket=req.ticket,
+            emitted=[tok],
+            remaining=req.max_new_tokens - 1,
+            eos_id=req.eos_id,
+        )
+        self._slot_state[row] = st
+        if st.remaining == 0 or (req.eos_id is not None and tok == req.eos_id):
+            return self._finish(row)
+        return None
+
+    def _finish(self, row: int) -> int:
+        st = self._slot_state[row]
+        self._results[st.ticket] = st.emitted
+        self._slot_state[row] = None
+        # The slot's cache rows stay as-is; the next insert overwrites
+        # idx (and the ragged kernel never reads past idx).
+        return st.ticket

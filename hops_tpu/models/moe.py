@@ -182,6 +182,7 @@ class MoEBlock(nn.Module):
     kv_cache_dtype: str | None = None
     num_kv_heads: int | None = None
     window: int | None = None
+    ragged_decode: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -198,6 +199,7 @@ class MoEBlock(nn.Module):
             kv_cache_dtype=self.kv_cache_dtype,
             num_kv_heads=self.num_kv_heads,
             window=self.window,
+            ragged_decode=self.ragged_decode,
             name="attn",
         )(RMSNorm(dtype=self.dtype)(x), decode=decode)
         if self.dropout_rate:

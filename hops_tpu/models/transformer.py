@@ -38,11 +38,17 @@ from hops_tpu.ops.attention import (
 
 
 def rotary_embedding(x: jax.Array, positions: jax.Array, base: float = 10000.0) -> jax.Array:
-    """Apply RoPE over ``(batch, heads, seq, head_dim)``."""
+    """Apply RoPE over ``(batch, heads, seq, head_dim)``.
+
+    ``positions`` is ``(seq,)`` — or ``(batch, seq)`` for the ragged
+    decode path, where each batch row's chunk sits at its own absolute
+    position."""
     d = x.shape[-1]
     inv_freq = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = positions[:, None].astype(jnp.float32) * inv_freq[None, :]  # (seq, d/2)
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (..., d/2)
     cos, sin = jnp.cos(angles), jnp.sin(angles)
+    if positions.ndim == 2:  # (b, s, d/2) -> broadcast over heads
+        cos, sin = cos[:, None], sin[:, None]
     x1, x2 = x[..., 0::2], x[..., 1::2]
     out1 = x1 * cos - x2 * sin
     out2 = x2 * cos + x1 * sin
@@ -89,6 +95,11 @@ class Attention(nn.Module):
     # keys [p - window + 1, p]. Kernel skips out-of-window tiles, so
     # long-sequence compute is O(seq * window).
     window: int | None = None
+    # Ragged decode (continuous batching): the cache index is (batch,)
+    # instead of a scalar — every row advances independently, RoPE uses
+    # per-row positions, and cache writes land at per-row offsets. The
+    # serving engine (modelrepo/lm_engine.py) drives this.
+    ragged_decode: bool = False
 
     @nn.compact
     def __call__(self, x, decode: bool = False):
@@ -222,26 +233,46 @@ class Attention(nn.Module):
             cvs = self.variable(
                 "cache", "v_scale", jnp.ones, cache_shape[:3], jnp.float32
             )
-        idx = self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+        idx_shape = (b,) if self.ragged_decode else ()
+        idx = self.variable("cache", "idx", lambda: jnp.zeros(idx_shape, jnp.int32))
         offset = idx.value
 
-        pos = offset + jnp.arange(s)
+        if self.ragged_decode:
+            # Per-row positions and per-row cache writes: each batch
+            # row's chunk lands at its own offset (vmapped
+            # dynamic_update_slice — b is the slot count, small).
+            pos = offset[:, None] + jnp.arange(s)[None, :]
+
+            def put(cache, update, starts):  # (h, cap, d) <- (h, s, d)
+                return jax.vmap(
+                    lambda c, u, o: jax.lax.dynamic_update_slice(c, u, (0, o, 0))
+                )(cache, update, starts)
+
+            def put2(cache, update, starts):  # (h, cap) <- (h, s)
+                return jax.vmap(
+                    lambda c, u, o: jax.lax.dynamic_update_slice(c, u, (0, o))
+                )(cache, update, starts)
+        else:
+            pos = offset + jnp.arange(s)
+
+            def put(cache, update, starts):
+                return jax.lax.dynamic_update_slice(cache, update, (0, 0, starts, 0))
+
+            def put2(cache, update, starts):
+                return jax.lax.dynamic_update_slice(cache, update, (0, 0, starts))
+
         q = rotary_embedding(q, pos)
         k = rotary_embedding(k, pos)
         if int8_cache:
             k_q, k_s = quantize_kv(k)
             v_q, v_s = quantize_kv(v)
-            ck.value = jax.lax.dynamic_update_slice(ck.value, k_q, (0, 0, offset, 0))
-            cv.value = jax.lax.dynamic_update_slice(cv.value, v_q, (0, 0, offset, 0))
-            cks.value = jax.lax.dynamic_update_slice(cks.value, k_s, (0, 0, offset))
-            cvs.value = jax.lax.dynamic_update_slice(cvs.value, v_s, (0, 0, offset))
+            ck.value = put(ck.value, k_q, offset)
+            cv.value = put(cv.value, v_q, offset)
+            cks.value = put2(cks.value, k_s, offset)
+            cvs.value = put2(cvs.value, v_s, offset)
         else:
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(self.dtype), (0, 0, offset, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(self.dtype), (0, 0, offset, 0)
-            )
+            ck.value = put(ck.value, k.astype(self.dtype), offset)
+            cv.value = put(cv.value, v.astype(self.dtype), offset)
         idx.value = offset + s
 
         if s > 1 and fresh_cache:
@@ -318,6 +349,7 @@ class Block(nn.Module):
     kv_cache_dtype: str | None = None
     num_kv_heads: int | None = None
     window: int | None = None
+    ragged_decode: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -334,6 +366,7 @@ class Block(nn.Module):
             kv_cache_dtype=self.kv_cache_dtype,
             num_kv_heads=self.num_kv_heads,
             window=self.window,
+            ragged_decode=self.ragged_decode,
             name="attn",
         )(RMSNorm(dtype=self.dtype)(x), decode=decode)
         if self.dropout_rate:
@@ -371,6 +404,7 @@ class TransformerLM(nn.Module):
     kv_cache_dtype: str | None = None  # "int8": quantized decode cache
     num_kv_heads: int | None = None  # GQA: shrink the decode cache
     window: int | None = None  # sliding-window causal attention
+    ragged_decode: bool = False  # (b,) cache index: continuous batching
 
     @nn.compact
     def __call__(
@@ -401,6 +435,7 @@ class TransformerLM(nn.Module):
                     kv_cache_dtype=self.kv_cache_dtype,
                     num_kv_heads=self.num_kv_heads,
                     window=self.window,
+                    ragged_decode=self.ragged_decode,
                     name=f"block_{i}",
                 )(x, train, decode)
                 continue
@@ -416,6 +451,7 @@ class TransformerLM(nn.Module):
                 kv_cache_dtype=self.kv_cache_dtype,
                 num_kv_heads=self.num_kv_heads,
                 window=self.window,
+                ragged_decode=self.ragged_decode,
                 name=f"block_{i}",
             )(x, train, decode)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)
