@@ -29,10 +29,13 @@ from pathlib import Path
 ROOT = Path(__file__).parent
 OUT = ROOT / "HW_MEASURE.jsonl"
 
+# Small compiles FIRST: the relay has twice answered a ResNet-50-sized
+# compile with a 25-min UNAVAILABLE and wedged itself afterwards
+# (HW_MEASURE.jsonl 2026-07-31), so the decode measurements — tiny
+# TransformerLM programs — must be banked before the big compile gets
+# a chance to take the relay down.
 STEPS: list[tuple[str, list[str]]] = [
     ("probe", [sys.executable, "bench.py", "--probe"]),
-    ("resnet50_bench", [sys.executable, "bench.py", "--no-probe"]),
-    ("resnet50_bench_remat", [sys.executable, "bench.py", "--no-probe", "--remat"]),
     ("decode_base", [sys.executable, "examples/decode_bench.py"]),
     ("decode_int8", [sys.executable, "examples/decode_bench.py", "--kv-dtype", "int8"]),
     ("decode_gqa", [sys.executable, "examples/decode_bench.py", "--kv-heads", "2"]),
@@ -40,6 +43,8 @@ STEPS: list[tuple[str, list[str]]] = [
     ("decode_all_knobs", [sys.executable, "examples/decode_bench.py",
                           "--kv-dtype", "int8", "--kv-heads", "2", "--window", "256"]),
     ("valid_sweep", [sys.executable, "examples/decode_bench.py", "--valid-sweep"]),
+    ("resnet50_bench", [sys.executable, "bench.py", "--no-probe"]),
+    ("resnet50_bench_remat", [sys.executable, "bench.py", "--no-probe", "--remat"]),
 ]
 
 
@@ -49,11 +54,21 @@ def record(entry: dict) -> None:
 
 
 def main() -> int:
+    import os
+
+    # Children run scripts from examples/ — python puts the SCRIPT's
+    # dir on sys.path, not the cwd, so the repo root must ride
+    # PYTHONPATH (appended: /root/.axon_site must stay first or the
+    # TPU plugin fails to register).
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ":".join(
+        p for p in (env.get("PYTHONPATH"), str(ROOT)) if p
+    )
     for name, cmd in STEPS:
         t0 = time.time()
         print(f"[hw_measure] {name}: {' '.join(cmd[1:])}", flush=True)
         proc = subprocess.run(  # no timeout, ever: let the relay finish
-            cmd, cwd=ROOT, capture_output=True, text=True
+            cmd, cwd=ROOT, env=env, capture_output=True, text=True
         )
         entry = {
             "step": name,
