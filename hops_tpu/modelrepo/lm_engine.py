@@ -69,12 +69,38 @@ def _map_cache(cache: Any, fn_kv, fn_idx, *rest: Any) -> Any:
     return out
 
 
+def _sample_rows(logits, temps, topks, seeds, ns):
+    """Per-row sampling over (rows, vocab) logits: ``temps[i] <= 0`` is
+    greedy; ``topks[i] > 0`` keeps the top-k logits. Keys derive
+    in-graph from (request seed, token index) — a pure function, so a
+    request's output is independent of slot placement and of what else
+    shares the batch, and the host never touches the backend to build
+    keys. Vectorized so greedy and sampled requests share one
+    dispatch."""
+    keys = jax.vmap(
+        lambda sd, n: jax.random.fold_in(jax.random.PRNGKey(sd), n)
+    )(seeds, ns)
+    v = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    srt = jnp.sort(logits, axis=-1)  # ascending
+    k_eff = jnp.clip(jnp.where(topks > 0, topks, v), 1, v)
+    kth = jnp.take_along_axis(srt, (v - k_eff)[:, None], axis=-1)
+    masked = jnp.where(logits < kth, -jnp.inf, logits)
+    scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
 @dataclasses.dataclass
 class _Request:
     ticket: int
     prompt: np.ndarray  # (L,) int32
     max_new_tokens: int
     eos_id: int | None
+    temperature: float = 0.0
+    top_k: int = 0  # 0 = no top-k truncation
+    seed: int = 0
 
 
 @dataclasses.dataclass
@@ -83,6 +109,10 @@ class _SlotState:
     emitted: list[int]
     remaining: int
     eos_id: int | None
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    n_sampled: int = 1  # tokens drawn so far (prefill's counts as #0)
 
 
 class LMEngine:
@@ -134,8 +164,8 @@ class LMEngine:
         self._next_ticket = 0
 
         # --- the three compiled programs -------------------------------
-        @functools.partial(jax.jit, static_argnames=())
-        def prefill(params, padded_prompt, true_len):
+        @functools.partial(jax.jit, static_argnames=("sampled",))
+        def prefill(params, padded_prompt, true_len, temp, topk, seed, sampled=False):
             # b=1 fresh cache; pad garbage beyond true_len is masked by
             # the ragged valid_len forever after (kernel invariant:
             # test_decode_attention_ignores_garbage_past_valid_len).
@@ -145,7 +175,13 @@ class LMEngine:
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], true_len - 1, axis=0, keepdims=False
             )
-            first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            if sampled:
+                first_tok = _sample_rows(
+                    last[None], temp[None], topk[None], seed[None],
+                    jnp.zeros((1,), jnp.int32),
+                )[0]
+            else:
+                first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
             cache = _map_cache(
                 variables["cache"],
                 lambda leaf: leaf,
@@ -167,7 +203,7 @@ class LMEngine:
                 one,
             )
 
-        def step(params, cache, tokens, active):
+        def _step_logits(params, cache, tokens, active):
             # Clamp free rows' cache index to 0 BEFORE the apply: the
             # decode write advances every row's idx, so without this a
             # freed slot would keep its final length (streaming its
@@ -183,12 +219,24 @@ class LMEngine:
                 decode=True,
                 mutable=["cache"],
             )
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            return nxt, variables["cache"]
+            return logits[:, -1], variables["cache"]
+
+        # Two step programs: the all-greedy dispatch (the default
+        # workload) pays one argmax, not a full-vocab sort + discarded
+        # Gumbel draw; the sampled program serves mixed batches (its
+        # greedy rows selected inside _sample_rows).
+        def step_greedy(params, cache, tokens, active):
+            last, cache = _step_logits(params, cache, tokens, active)
+            return jnp.argmax(last, axis=-1).astype(jnp.int32), cache
+
+        def step_sampled(params, cache, tokens, active, temps, topks, seeds, ns):
+            last, cache = _step_logits(params, cache, tokens, active)
+            return _sample_rows(last, temps, topks, seeds, ns), cache
 
         self._prefill = prefill
         self._insert = jax.jit(insert, donate_argnums=(0,))
-        self._step = jax.jit(step, donate_argnums=(1,))
+        self._step_greedy = jax.jit(step_greedy, donate_argnums=(1,))
+        self._step_sampled = jax.jit(step_sampled, donate_argnums=(1,))
         # Telemetry: dispatches vs tokens emitted say how well slots
         # stayed occupied (the continuous-batching win).
         self.dispatches = 0
@@ -201,7 +249,15 @@ class LMEngine:
         prompt: Any,
         max_new_tokens: int = 32,
         eos_id: int | None = None,
+        temperature: float = 0.0,
+        top_k: int | None = None,
+        seed: int = 0,
     ) -> int:
+        """Enqueue a request. ``temperature=0`` is greedy; otherwise
+        tokens draw from the (optionally top-k-truncated) scaled
+        distribution, with a key chain that depends only on ``seed``
+        and token index — reproducible regardless of slot placement or
+        batch company."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -213,9 +269,18 @@ class LMEngine:
             )
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0")
+        seed = int(seed) & 0x7FFFFFFF  # fold into int32 before it hits jit
         ticket = self._next_ticket
         self._next_ticket += 1
-        self._queue.append(_Request(ticket, prompt, max_new_tokens, eos_id))
+        self._queue.append(
+            _Request(
+                ticket, prompt, max_new_tokens, eos_id,
+                temperature=float(temperature), top_k=int(top_k or 0),
+                seed=int(seed),
+            )
+        )
         return ticket
 
     def step(self) -> list[int]:
@@ -238,7 +303,27 @@ class LMEngine:
         active = jnp.asarray(
             [st is not None for st in self._slot_state], jnp.bool_
         )
-        nxt, self._cache = self._step(self.params, self._cache, tokens, active)
+        if any(st is not None and st.temperature > 0 for st in self._slot_state):
+            temps = jnp.asarray(
+                [st.temperature if st else 0.0 for st in self._slot_state],
+                jnp.float32,
+            )
+            topks = jnp.asarray(
+                [st.top_k if st else 0 for st in self._slot_state], jnp.int32
+            )
+            seeds = jnp.asarray(
+                [st.seed if st else 0 for st in self._slot_state], jnp.int32
+            )
+            ns = jnp.asarray(
+                [st.n_sampled if st else 0 for st in self._slot_state], jnp.int32
+            )
+            nxt, self._cache = self._step_sampled(
+                self.params, self._cache, tokens, active, temps, topks, seeds, ns
+            )
+        else:
+            nxt, self._cache = self._step_greedy(
+                self.params, self._cache, tokens, active
+            )
         self.dispatches += 1
         nxt = np.asarray(nxt)
         for row, st in enumerate(self._slot_state):
@@ -250,6 +335,7 @@ class LMEngine:
             tok = int(nxt[row])
             st.emitted.append(tok)
             st.remaining -= 1
+            st.n_sampled += 1
             self.tokens_emitted += 1
             if st.remaining == 0 or (st.eos_id is not None and tok == st.eos_id):
                 finished.append(self._finish(row))
@@ -282,7 +368,9 @@ class LMEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :L] = req.prompt
         first_tok, one_cache = self._prefill(
-            self.params, jnp.asarray(padded), jnp.int32(L)
+            self.params, jnp.asarray(padded), jnp.int32(L),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.int32(req.seed), sampled=req.temperature > 0,
         )
         self._cache = self._insert(
             self._cache, one_cache, jnp.int32(row), jnp.int32(L)
@@ -294,6 +382,9 @@ class LMEngine:
             emitted=[tok],
             remaining=req.max_new_tokens - 1,
             eos_id=req.eos_id,
+            temperature=req.temperature,
+            top_k=req.top_k,
+            seed=req.seed,
         )
         self._slot_state[row] = st
         if st.remaining == 0 or (req.eos_id is not None and tok == req.eos_id):

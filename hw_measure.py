@@ -5,9 +5,11 @@ the relay — BENCHMARKS.md operational note), every measurement the round
 needs on real hardware:
 
   1. relay health probe (kill-safe subprocess, bench.py --probe)
-  2. headline ResNet-50 bench (bench.py)
-  3. decode_bench: base / int8 / GQA / window / int8+GQA+window
-  4. decode_bench --valid-sweep (valid-length-proportional DMA check)
+  2. decode_bench: base / int8 / GQA / window / int8+GQA+window
+  3. decode_bench --valid-sweep (valid-length-proportional DMA check)
+  4. headline ResNet-50 bench (bench.py), then its --remat A/B — LAST,
+     because the relay has wedged itself on ResNet-sized compiles; the
+     small decode measurements must already be banked by then
 
 Each step's stdout+stderr and wall time append to HW_MEASURE.jsonl so a
 later session (or a human) can transcribe the numbers into

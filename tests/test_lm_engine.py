@@ -167,6 +167,57 @@ def test_engine_rejects_non_ragged_model_and_oversize():
         engine.submit(np.zeros(60, np.int32), max_new_tokens=10)
 
 
+def test_engine_sampling_deterministic_and_placement_independent():
+    """Sampled requests: same seed → same tokens, regardless of what
+    else shares the batch or which slot they land in; greedy requests
+    in the same batch are unaffected."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    rs = np.random.RandomState(4)
+    p_sam = rs.randint(0, 64, (5,))
+    p_greedy = rs.randint(0, 64, (7,))
+
+    # Run 1: sampled alone, lands in slot 0.
+    e1 = LMEngine(model, params, slots=2, prefill_buckets=(8,))
+    t1 = e1.submit(p_sam, max_new_tokens=6, temperature=0.8, top_k=8, seed=13)
+    r1 = e1.run()[t1]
+
+    # Run 2: a greedy request admitted FIRST (sampled lands in slot 1,
+    # different company) — sampled output must be identical.
+    e2 = LMEngine(model, params, slots=2, prefill_buckets=(8,))
+    tg = e2.submit(p_greedy, max_new_tokens=6)
+    t2 = e2.submit(p_sam, max_new_tokens=6, temperature=0.8, top_k=8, seed=13)
+    r2 = e2.run()
+    assert r2[t2] == r1
+    ref = generate(
+        plain, params, jnp.asarray(p_greedy)[None], jax.random.PRNGKey(0),
+        max_new_tokens=6, temperature=0.0,
+    )
+    assert r2[tg] == list(np.asarray(ref[0, 7:]))
+
+    # Different seed → (almost surely) different rollout; tokens in range.
+    e3 = LMEngine(model, params, slots=2, prefill_buckets=(8,))
+    t3 = e3.submit(p_sam, max_new_tokens=6, temperature=0.8, top_k=8, seed=14)
+    r3 = e3.run()[t3]
+    assert all(0 <= t < 64 for t in r3)
+
+
+def test_engine_top_k_one_is_greedy():
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    p = np.random.RandomState(5).randint(0, 64, (6,))
+    engine = LMEngine(model, params, slots=1, prefill_buckets=(8,))
+    t = engine.submit(p, max_new_tokens=5, temperature=1.0, top_k=1, seed=3)
+    out = engine.run()[t]
+    ref = generate(
+        plain, params, jnp.asarray(p)[None], jax.random.PRNGKey(0),
+        max_new_tokens=5, temperature=0.0,
+    )
+    assert out == list(np.asarray(ref[0, 6:]))
+
+
 def test_engine_budget_one_finishes_at_admission():
     """max_new_tokens=1: the prefill's argmax is the whole answer."""
     model = TransformerLM(**TINY, ragged_decode=True)
