@@ -352,6 +352,33 @@ class LMEngine:
         """Generated tokens (prompt excluded) or None if not finished."""
         return self._results.get(ticket)
 
+    def take_result(self, ticket: int) -> list[int] | None:
+        """Like :meth:`result` but consuming — long-lived servers must
+        use this or ``_results`` grows without bound."""
+        return self._results.pop(ticket, None)
+
+    def cancel(self, ticket: int) -> bool:
+        """Remove a still-QUEUED request (admitted requests run to
+        completion). Returns whether anything was removed. Callers that
+        share the engine across threads hold their lock around
+        submit/cancel, which makes cancel-on-partial-failure exact:
+        nothing can have been admitted in between."""
+        for req in self._queue:
+            if req.ticket == ticket:
+                self._queue.remove(req)
+                return True
+        return False
+
+    @property
+    def has_work(self) -> bool:
+        """Anything queued or decoding? (The serving driver thread
+        sleeps on this.) The engine itself is NOT thread-safe — callers
+        that share it across threads serialize on their own lock
+        (serving.LMEnginePredictor)."""
+        return bool(self._queue) or any(
+            st is not None for st in self._slot_state
+        )
+
     # --- internals ------------------------------------------------------
 
     def _bucket(self, n: int) -> int:

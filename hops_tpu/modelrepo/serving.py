@@ -40,6 +40,7 @@ log = get_logger(__name__)
 
 FLAX = "FLAX"
 PYTHON = "PYTHON"
+LM = "LM"  # continuous-batching text generation (lm_engine.LMEngine)
 # Accepted for reference parity; flax bundles are the native path.
 TENSORFLOW_SERVING = FLAX
 
@@ -135,8 +136,110 @@ class PythonPredictor:
         return self._impl.predict(instances)
 
 
+class LMEnginePredictor:
+    """Continuous-batching text generation behind the serving contract.
+
+    Loads a ``save_flax`` TransformerLM bundle, clones the module with
+    ``ragged_decode=True`` (params are layout-identical), and drives an
+    ``LMEngine`` from a single driver thread. Handler threads submit
+    requests and sleep on a condition variable; every engine iteration
+    serves ALL live requests in one decode dispatch, so concurrent
+    ragged requests share the device instead of queueing behind each
+    other — continuous batching at the HTTP surface.
+
+    Instance format: ``{"prompt": [ids], "max_new_tokens": 32,
+    "eos_id": null, "temperature": 0.0, "top_k": null, "seed": 0}``
+    (a bare token list is shorthand for just the prompt). Predictions
+    are generated-token lists, prompt excluded.
+    """
+
+    def __init__(self, artifact_dir: Path, lm_config: dict[str, Any] | None = None):
+        from hops_tpu.modelrepo.lm_engine import LMEngine  # defers jax
+
+        cfg = lm_config or {}
+        bundle = pickle.loads((artifact_dir / "flax_model.pkl").read_bytes())
+        module = bundle["module"].clone(ragged_decode=True)
+        self._engine = LMEngine(
+            module,
+            bundle["params"],
+            slots=int(cfg.get("slots", 4)),
+            prefill_buckets=(
+                tuple(cfg["prefill_buckets"]) if "prefill_buckets" in cfg else None
+            ),
+        )
+        self._cv = threading.Condition()
+        self._stopping = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stopping and not self._engine.has_work:
+                    self._cv.wait()
+                if self._stopping:
+                    return
+                # The dispatch runs under the lock: admissions only
+                # land at iteration boundaries anyway, and waiters are
+                # woken the moment their ticket finishes.
+                if self._engine.step():
+                    self._cv.notify_all()
+
+    @staticmethod
+    def _parse(instance: Any) -> dict[str, Any]:
+        if isinstance(instance, dict):
+            return {
+                "prompt": instance["prompt"],
+                "max_new_tokens": int(instance.get("max_new_tokens", 32)),
+                "eos_id": instance.get("eos_id"),
+                "temperature": float(instance.get("temperature", 0.0)),
+                "top_k": instance.get("top_k"),
+                "seed": int(instance.get("seed", 0)),
+            }
+        return {"prompt": instance}
+
+    def predict(self, instances: list[Any]) -> list[Any]:
+        parsed = [self._parse(i) for i in instances]
+        with self._cv:
+            if self._stopping:
+                raise RuntimeError("serving stopped")
+            # All-or-nothing submission: a bad instance mid-batch must
+            # not leave earlier ones burning slots with no reader. The
+            # cancels are exact because the driver thread steps under
+            # this same lock — nothing got admitted in between.
+            tickets: list[int] = []
+            try:
+                for kw in parsed:
+                    tickets.append(self._engine.submit(**kw))
+            except Exception:
+                for t in tickets:
+                    self._engine.cancel(t)
+                raise
+            self._cv.notify_all()  # wake the driver thread
+            while any(self._engine.result(t) is None for t in tickets):
+                if self._stopping:
+                    # The driver thread is gone; nothing will ever
+                    # finish these. Fail the request instead of hanging
+                    # the handler (and its HTTP connection) forever.
+                    for t in tickets:
+                        self._engine.take_result(t)
+                    raise RuntimeError("serving stopped")
+                self._cv.wait()
+            # take_result (consuming): one engine serves the process
+            # lifetime — result() would leak every request's tokens.
+            return [self._engine.take_result(t) for t in tickets]
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+
+
 def _build_predictor(cfg: dict[str, Any]) -> Any:
     artifact_dir = Path(cfg["artifact_path"])
+    if cfg["model_server"] == LM:
+        return LMEnginePredictor(artifact_dir, cfg.get("lm_config"))
     if cfg["model_server"] == PYTHON:
         scripts = sorted(artifact_dir.rglob("*.py"))
         if not scripts:
@@ -330,6 +433,8 @@ class _RunningServing:
         self.server.server_close()
         if self.batcher is not None:
             self.batcher.stop()
+        if hasattr(self.predictor, "stop"):  # LMEnginePredictor's driver thread
+            self.predictor.stop()
 
 
 # -- public API (reference surface) ------------------------------------------
@@ -345,13 +450,22 @@ def create_or_update(
     instances: int = 1,
     batching_enabled: bool = False,
     batching_config: dict[str, Any] | None = None,
+    lm_config: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """Create/update a serving endpoint definition (reference:
     ``serving.create_or_update``; ``batching_enabled`` mirrors the
     platform's server-side request batching). ``model_path`` may be a
     registry path or omitted in favor of ``model_name``+``model_version``.
     ``batching_config`` knobs: ``max_batch_size`` (default 64),
-    ``timeout_ms`` (default 5)."""
+    ``timeout_ms`` (default 5). ``model_server="LM"`` serves a saved
+    TransformerLM with continuous batching (``lm_config`` knobs:
+    ``slots``, ``prefill_buckets``); it does its own cross-request
+    scheduling, so it composes with ``batching_enabled=False`` only."""
+    if model_server.upper() == LM and batching_enabled:
+        raise ValueError(
+            "model_server='LM' schedules requests itself (continuous "
+            "batching) — batching_enabled would double-batch; leave it off"
+        )
     reg = _load_registry()
     if model_path is None:
         meta = registry.get_model(model_name or name, model_version)
@@ -371,6 +485,7 @@ def create_or_update(
         "instances": instances,
         "batching_enabled": batching_enabled,
         "batching_config": batching_config or {},
+        "lm_config": lm_config or {},
         "status": reg.get(name, {}).get("status", "Stopped"),
         "topic": f"serving-{name}-inference",
     }
