@@ -45,6 +45,8 @@ STEPS: list[tuple[str, list[str]]] = [
     ("decode_all_knobs", [sys.executable, "examples/decode_bench.py",
                           "--kv-dtype", "int8", "--kv-heads", "2", "--window", "256"]),
     ("valid_sweep", [sys.executable, "examples/decode_bench.py", "--valid-sweep"]),
+    ("decode_continuous", [sys.executable, "examples/decode_bench.py", "--continuous",
+                           "--batch", "4", "--tokens", "32", "--layers", "4"]),
     ("resnet50_bench", [sys.executable, "bench.py", "--no-probe"]),
     ("resnet50_bench_remat", [sys.executable, "bench.py", "--no-probe", "--remat"]),
 ]
