@@ -101,6 +101,10 @@ class _Request:
     temperature: float = 0.0
     top_k: int = 0  # 0 = no top-k truncation
     seed: int = 0
+    # (cache, length) snapshot taken at submit time: re-registering the
+    # name later must not invalidate this request's capacity validation
+    # or swap its prefix mid-queue.
+    prefix: tuple[Any, int] | None = None
 
 
 @dataclasses.dataclass
@@ -164,14 +168,13 @@ class LMEngine:
         self._next_ticket = 0
 
         # --- the three compiled programs -------------------------------
-        @functools.partial(jax.jit, static_argnames=("sampled",))
-        def prefill(params, padded_prompt, true_len, temp, topk, seed, sampled=False):
-            # b=1 fresh cache; pad garbage beyond true_len is masked by
-            # the ragged valid_len forever after (kernel invariant:
-            # test_decode_attention_ignores_garbage_past_valid_len).
-            logits, variables = model.apply(
-                {"params": params}, padded_prompt, decode=True, mutable=["cache"]
-            )
+        def _admit_tail(logits, variables, true_len, end_len, temp, topk,
+                        seed, sampled):
+            """Shared tail of both admission programs: pick the last
+            true row's logits, draw/argmax the first token, rewind the
+            cache index to the true end (pad garbage past it stays
+            masked forever — kernel invariant:
+            test_decode_attention_ignores_garbage_past_valid_len)."""
             last = jax.lax.dynamic_index_in_dim(
                 logits[0], true_len - 1, axis=0, keepdims=False
             )
@@ -185,9 +188,39 @@ class LMEngine:
             cache = _map_cache(
                 variables["cache"],
                 lambda leaf: leaf,
-                lambda idx: jnp.full_like(idx, true_len),
+                lambda idx: jnp.full_like(idx, end_len),
             )
             return first_tok, cache
+
+        @functools.partial(jax.jit, static_argnames=("sampled",))
+        def prefill(params, padded_prompt, true_len, temp, topk, seed, sampled=False):
+            # b=1 fresh cache.
+            logits, variables = model.apply(
+                {"params": params}, padded_prompt, decode=True, mutable=["cache"]
+            )
+            return _admit_tail(
+                logits, variables, true_len, true_len, temp, topk, seed, sampled
+            )
+
+        @functools.partial(jax.jit, static_argnames=("sampled",))
+        def append(params, cache, padded_suffix, base_len, true_len, temp,
+                   topk, seed, sampled=False):
+            # Warm-cache chunk append onto a COPY of a registered
+            # prefix cache (not donated — the stored prefix is reused
+            # by every request that names it). The apply writes the
+            # whole padded bucket at offset base_len; garbage rows past
+            # true_len are causally invisible to true rows during the
+            # append.
+            logits, variables = model.apply(
+                {"params": params, "cache": cache},
+                padded_suffix,
+                decode=True,
+                mutable=["cache"],
+            )
+            return _admit_tail(
+                logits, variables, true_len, base_len + true_len,
+                temp, topk, seed, sampled,
+            )
 
         def insert(big, one, row, true_len):
             # The b=1 tree shares the big tree's treedef — only the
@@ -234,15 +267,44 @@ class LMEngine:
             return _sample_rows(last, temps, topks, seeds, ns), cache
 
         self._prefill = prefill
+        self._append = append
         self._insert = jax.jit(insert, donate_argnums=(0,))
+        self._prefixes: dict[str, tuple[Any, int]] = {}
         self._step_greedy = jax.jit(step_greedy, donate_argnums=(1,))
         self._step_sampled = jax.jit(step_sampled, donate_argnums=(1,))
         # Telemetry: dispatches vs tokens emitted say how well slots
-        # stayed occupied (the continuous-batching win).
+        # stayed occupied (the continuous-batching win); prefix_hits
+        # counts admissions that skipped a shared-prefix recompute.
         self.dispatches = 0
         self.tokens_emitted = 0
+        self.prefix_hits = 0
 
     # --- public API -----------------------------------------------------
+
+    def register_prefix(self, name: str, tokens: Any) -> str:
+        """Prefill a shared prompt prefix ONCE (a system prompt, a
+        few-shot header) and cache its KV state; requests that
+        ``submit(..., prefix_id=name)`` start from it and only compute
+        their own suffix — the standard prefix-caching serving
+        optimization. Re-registering a name replaces it."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        if tokens.size == 0:
+            raise ValueError("empty prefix")
+        if tokens.size >= self.model.max_decode_len:
+            raise ValueError(
+                f"prefix {tokens.size} leaves no room in "
+                f"max_decode_len {self.model.max_decode_len}"
+            )
+        L = tokens.size
+        bucket = min(self._bucket(L), self.model.max_decode_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :L] = tokens
+        _, cache = self._prefill(
+            self.params, jnp.asarray(padded), jnp.int32(L),
+            jnp.float32(0.0), jnp.int32(0), jnp.int32(0), sampled=False,
+        )
+        self._prefixes[name] = (cache, L)
+        return name
 
     def submit(
         self,
@@ -252,19 +314,33 @@ class LMEngine:
         temperature: float = 0.0,
         top_k: int | None = None,
         seed: int = 0,
+        prefix_id: str | None = None,
     ) -> int:
         """Enqueue a request. ``temperature=0`` is greedy; otherwise
         tokens draw from the (optionally top-k-truncated) scaled
         distribution, with a key chain that depends only on ``seed``
         and token index — reproducible regardless of slot placement or
-        batch company."""
+        batch company. With ``prefix_id``, ``prompt`` is the SUFFIX
+        after a prefix registered via :meth:`register_prefix`."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
-        total = prompt.size + max_new_tokens
+        prefix = None
+        prefix_len = 0
+        if prefix_id is not None:
+            if prefix_id not in self._prefixes:
+                raise ValueError(
+                    f"unknown prefix_id {prefix_id!r} — register_prefix first"
+                )
+            # Snapshot: re-registering the name later must not swap the
+            # prefix (or invalidate this validation) for queued work.
+            prefix = self._prefixes[prefix_id]
+            prefix_len = prefix[1]
+        total = prefix_len + prompt.size + max_new_tokens
         if total > self.model.max_decode_len:
             raise ValueError(
-                f"prompt {prompt.size} + {max_new_tokens} new tokens "
+                f"prefix {prefix_len} + prompt {prompt.size} + "
+                f"{max_new_tokens} new tokens "
                 f"exceeds max_decode_len {self.model.max_decode_len}"
             )
         if max_new_tokens < 1:
@@ -278,7 +354,7 @@ class LMEngine:
             _Request(
                 ticket, prompt, max_new_tokens, eos_id,
                 temperature=float(temperature), top_k=int(top_k or 0),
-                seed=int(seed),
+                seed=int(seed), prefix=prefix,
             )
         )
         return ticket
@@ -391,16 +467,31 @@ class LMEngine:
         """Prefill ``req`` and splice it into slot ``row``. Returns the
         ticket if the request finished at admission (budget of 1)."""
         L = req.prompt.size
-        bucket = min(self._bucket(L), self.model.max_decode_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :L] = req.prompt
-        first_tok, one_cache = self._prefill(
-            self.params, jnp.asarray(padded), jnp.int32(L),
-            jnp.float32(req.temperature), jnp.int32(req.top_k),
-            jnp.int32(req.seed), sampled=req.temperature > 0,
-        )
+        if req.prefix is not None:
+            base_cache, base_len = req.prefix
+            bucket = min(self._bucket(L), self.model.max_decode_len - base_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :L] = req.prompt
+            first_tok, one_cache = self._append(
+                self.params, base_cache, jnp.asarray(padded),
+                jnp.int32(base_len), jnp.int32(L),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.int32(req.seed), sampled=req.temperature > 0,
+            )
+            total_len = base_len + L
+            self.prefix_hits += 1
+        else:
+            bucket = min(self._bucket(L), self.model.max_decode_len)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :L] = req.prompt
+            first_tok, one_cache = self._prefill(
+                self.params, jnp.asarray(padded), jnp.int32(L),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.int32(req.seed), sampled=req.temperature > 0,
+            )
+            total_len = L
         self._cache = self._insert(
-            self._cache, one_cache, jnp.int32(row), jnp.int32(L)
+            self._cache, one_cache, jnp.int32(row), jnp.int32(total_len)
         )
         tok = int(first_tok)
         self.tokens_emitted += 1

@@ -167,23 +167,36 @@ class LMEnginePredictor:
                 tuple(cfg["prefill_buckets"]) if "prefill_buckets" in cfg else None
             ),
         )
+        # Shared prompt prefixes (system prompts): prefilled once at
+        # startup; instances opt in with {"prefix_id": name}.
+        for pname, ptokens in (cfg.get("prefixes") or {}).items():
+            self._engine.register_prefix(pname, ptokens)
         self._cv = threading.Condition()
         self._stopping = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     def _loop(self) -> None:
-        while True:
+        try:
+            while True:
+                with self._cv:
+                    while not self._stopping and not self._engine.has_work:
+                        self._cv.wait()
+                    if self._stopping:
+                        return
+                    # The dispatch runs under the lock: admissions only
+                    # land at iteration boundaries anyway, and waiters
+                    # are woken the moment their ticket finishes.
+                    if self._engine.step():
+                        self._cv.notify_all()
+        except BaseException:  # noqa: BLE001
+            # A dying driver thread must fail the waiters, not strand
+            # them on cv.wait() forever with hung HTTP connections.
             with self._cv:
-                while not self._stopping and not self._engine.has_work:
-                    self._cv.wait()
-                if self._stopping:
-                    return
-                # The dispatch runs under the lock: admissions only
-                # land at iteration boundaries anyway, and waiters are
-                # woken the moment their ticket finishes.
-                if self._engine.step():
-                    self._cv.notify_all()
+                self._stopping = True
+                self._cv.notify_all()
+            log.exception("LM engine driver thread died")
+            raise
 
     @staticmethod
     def _parse(instance: Any) -> dict[str, Any]:
@@ -195,6 +208,7 @@ class LMEnginePredictor:
                 "temperature": float(instance.get("temperature", 0.0)),
                 "top_k": instance.get("top_k"),
                 "seed": int(instance.get("seed", 0)),
+                "prefix_id": instance.get("prefix_id"),
             }
         return {"prompt": instance}
 
