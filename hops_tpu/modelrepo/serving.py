@@ -31,6 +31,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Any
 
+import numpy as np
+
 from hops_tpu.messaging import pubsub
 from hops_tpu.modelrepo import registry
 from hops_tpu.runtime import fs
@@ -473,13 +475,40 @@ def create_or_update(
     ``batching_config`` knobs: ``max_batch_size`` (default 64),
     ``timeout_ms`` (default 5). ``model_server="LM"`` serves a saved
     TransformerLM with continuous batching (``lm_config`` knobs:
-    ``slots``, ``prefill_buckets``); it does its own cross-request
-    scheduling, so it composes with ``batching_enabled=False`` only."""
+    ``slots``, ``prefill_buckets``, and ``prefixes`` — a
+    ``{name: token_ids}`` dict of shared prompt prefixes prefilled once
+    at startup); it does its own cross-request scheduling, so it
+    composes with ``batching_enabled=False`` only."""
     if model_server.upper() == LM and batching_enabled:
         raise ValueError(
             "model_server='LM' schedules requests itself (continuous "
             "batching) — batching_enabled would double-batch; leave it off"
         )
+    if lm_config:
+        # The registry round-trips through JSON with default=str: a
+        # numpy/jnp array anywhere in lm_config would be silently
+        # stringified and break start(). Normalize every array-valued
+        # knob to plain int lists here, rejecting non-integral values
+        # loudly instead of truncating them.
+        def int_list(x: Any, what: str) -> list[int]:
+            out = []
+            for t in np.asarray(x).reshape(-1):
+                i = int(t)
+                if i != t:
+                    raise ValueError(f"{what} must be integers, got {t!r}")
+                out.append(i)
+            return out
+
+        lm_config = dict(lm_config)
+        if lm_config.get("prefill_buckets") is not None:
+            lm_config["prefill_buckets"] = int_list(
+                lm_config["prefill_buckets"], "lm_config prefill_buckets"
+            )
+        if lm_config.get("prefixes"):
+            lm_config["prefixes"] = {
+                pname: int_list(ptokens, f"prefix {pname!r} tokens")
+                for pname, ptokens in lm_config["prefixes"].items()
+            }
     reg = _load_registry()
     if model_path is None:
         meta = registry.get_model(model_name or name, model_version)

@@ -130,14 +130,18 @@ def _block_runs(qi, kj, block_q, block_k, q_offset, causal, window):
 # ---------------------------------------------------------------------------
 
 
-def _online_softmax_update(sc, vb, m_scr, l_scr, acc_scr):
+def _online_softmax_update(sc, vb, m_scr, l_scr, acc_scr, p_scale=None):
     """Fold one masked score block ``sc`` (fp32, -inf at masked entries)
     and its value tile ``vb`` into the running (m, l, acc)
     online-softmax scratch. The NEG_INF guards keep fully-masked rows
     at l == 0 (finalize substitutes 1) instead of NaN. Shared by the
     training forward kernel and both decode kernels — this rescaling
-    is the subtlest numerics in the file and must exist exactly
-    once."""
+    is the subtlest numerics in the file and must exist exactly once.
+
+    ``p_scale`` (1, block_k) folds a per-key scale into the prob@value
+    dot ONLY (the int8 path's v_scale — ``vb`` then holds raw int8
+    values cast to its dtype); the softmax denominator ``l`` always
+    sums the UNSCALED probs."""
     m = m_scr[:, :1]  # (rows, 1), broadcast across lanes
     l = l_scr[:, :1]
     m_new = jnp.maximum(m, jnp.max(sc, axis=-1, keepdims=True))
@@ -146,7 +150,7 @@ def _online_softmax_update(sc, vb, m_scr, l_scr, acc_scr):
     alpha = jnp.exp(jnp.where(m == NEG_INF, NEG_INF, m - m_safe))
     l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
     pv = jax.lax.dot_general(
-        p.astype(vb.dtype), vb,
+        (p if p_scale is None else p * p_scale).astype(vb.dtype), vb,
         (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32,
     )
     acc_scr[...] = acc_scr[...] * alpha + pv
@@ -606,22 +610,42 @@ def _decode_mask(vl, qi, kj, *, block_q, block_k, s, rows, window):
     return visible
 
 
+def _group_block_range(vl_ref, bi, *, block_bh, block_k, s, window):
+    """(first, last) k-block range covering EVERY row of grid group
+    ``bi`` (``block_bh`` consecutive (batch, kv-head) rows): the union
+    of the per-row `_decode_block_range`s. The DMA clamp coarsens to
+    this union — per-row visibility still comes from `_decode_mask`, so
+    grouping trades some over-fetch on ragged batches for ``block_bh``×
+    fewer grid steps (the per-step fixed cost was the measured
+    bottleneck: ~2.3 us/step vs 0.2 us of DMA at block_k=512)."""
+    firsts, lasts = [], []
+    for g in range(block_bh):
+        f, l = _decode_block_range(
+            _read_vl(vl_ref, bi * block_bh + g),
+            block_k=block_k, s=s, window=window,
+        )
+        firsts.append(f)
+        lasts.append(l)
+    return functools.reduce(jnp.minimum, firsts), functools.reduce(jnp.maximum, lasts)
+
+
 def _decode_kernel(
     vl_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-    *, sm_scale, block_q, block_k, s, rows, window,
+    *, sm_scale, block_bh, block_q, block_k, s, rows, window,
 ):
-    """One (bh, qi, kj) grid step of cache attention.
+    """One (bh-group, qi, kj) grid step of cache attention.
 
     ``vl_ref`` is the scalar-prefetched ``valid_len`` (SMEM): the
     causal/validity mask is computed in-kernel from it, and grid steps
-    whose k block lies outside ``_decode_block_range`` skip compute —
-    their BlockSpec index maps clamp to the range edge, so Mosaic
-    revisits the previous block window and issues no HBM copy. HBM
-    traffic is therefore O(valid_len), not O(capacity).
+    whose k block lies outside the group's `_group_block_range` skip
+    compute — their BlockSpec index maps clamp to the range edge, so
+    Mosaic revisits the previous block window and issues no HBM copy.
+    HBM traffic is therefore O(max valid_len in the group), not
+    O(capacity). Each step streams ``block_bh`` rows' tiles in one DMA
+    and loops the (tiny) per-row attention math over them in-VMEM.
     """
-    qi, kj = pl.program_id(1), pl.program_id(2)
+    bi, qi, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
-    vl = _read_vl(vl_ref, pl.program_id(0))
 
     @pl.when(kj == 0)
     def _init():
@@ -629,26 +653,32 @@ def _decode_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    first, last = _decode_block_range(vl, block_k=block_k, s=s, window=window)
+    first, last = _group_block_range(
+        vl_ref, bi, block_bh=block_bh, block_k=block_k, s=s, window=window
+    )
 
     @pl.when((kj >= first) & (kj <= last))
     def _body():
-        sc = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        visible = _decode_mask(
-            vl, qi, kj, block_q=block_q, block_k=block_k, s=s, rows=rows,
-            window=window,
-        )
-        sc = jnp.where(visible, sc * sm_scale, NEG_INF)
-        _online_softmax_update(sc, v_ref[0], m_scr, l_scr, acc_scr)
+        for g in range(block_bh):
+            vl = _read_vl(vl_ref, bi * block_bh + g)
+            sc = jax.lax.dot_general(
+                q_ref[g], k_ref[g], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            visible = _decode_mask(
+                vl, qi, kj, block_q=block_q, block_k=block_k, s=s,
+                rows=rows, window=window,
+            )
+            sc = jnp.where(visible, sc * sm_scale, NEG_INF)
+            _online_softmax_update(
+                sc, v_ref[g], m_scr.at[g], l_scr.at[g], acc_scr.at[g]
+            )
 
     @pl.when(kj == nk - 1)
     def _finalize():
-        l = l_scr[:, :1]
+        l = l_scr[...][:, :, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
 
 
 def decode_attention(
@@ -661,6 +691,7 @@ def decode_attention(
     v_scale: jax.Array | None = None,
     sm_scale: float | None = None,
     block_k: int | None = None,
+    block_bh: int | None = None,
     window: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
@@ -734,6 +765,17 @@ def decode_attention(
         interpret = jax.default_backend() != "tpu"
 
     bh = b * hkv
+    if block_bh is None:
+        # Default 1: grouping rows per grid step was hypothesized to
+        # amortize per-step cost, but hardware says otherwise — at
+        # (b8, h8, d128, cap 16k) block_bh=8 measured 5.9 ms vs 4.9 ms
+        # for block_bh=1, and the marginal streaming rate at block_bh=1
+        # is already ~1 ms/GB (the HBM roofline; the fixed ~1 ms floor
+        # is per-dispatch latency, not kernel time). The knob stays for
+        # experimentation on other topologies.
+        block_bh = 1
+    elif bh % block_bh:
+        raise ValueError(f"block_bh {block_bh} must divide b*kv_heads {bh}")
     qf = q.reshape(bh, rows, d)
     if q_rows != rows:
         qf = jnp.pad(qf, ((0, 0), (0, q_rows - rows), (0, 0)))
@@ -747,42 +789,53 @@ def decode_attention(
     def kv_index(bi, qi, kj, vl_ref):
         # Out-of-range grid steps revisit the range edge's block: same
         # window as an in-range neighbor step -> Mosaic issues no copy.
-        first, last = _decode_block_range(
-            _read_vl(vl_ref, bi), block_k=block_k, s=s, window=window
+        first, last = _group_block_range(
+            vl_ref, bi, block_bh=block_bh, block_k=block_k, s=s, window=window
         )
         return bi, jnp.clip(kj, first, last), 0
 
     kv_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bi, qi, kj, vl_ref: (bi, qi, 0)),
-        pl.BlockSpec((1, block_k, d), kv_index),
-        pl.BlockSpec((1, block_k, d), kv_index),
+        pl.BlockSpec(
+            (block_bh, block_q, d), lambda bi, qi, kj, vl_ref: (bi, qi, 0)
+        ),
+        pl.BlockSpec((block_bh, block_k, d), kv_index),
+        pl.BlockSpec((block_bh, block_k, d), kv_index),
     ]
+    # Scales ride as (bh, 1, cap): a 2-D (bh, cap) operand with block
+    # (1, block_k) fails Mosaic's block-shape rule on real TPU (the
+    # second-to-last block dim must divide 8 or equal the array dim —
+    # interpret mode never checks). The lane-major layout also hands
+    # the kernel (1, block_k) tiles that broadcast over score columns
+    # with no relayout.
+    def scale_index(bi, qi, kj, vl_ref):
+        return bi, 0, kv_index(bi, qi, kj, vl_ref)[1]
+
     scale_specs = [
-        pl.BlockSpec((1, block_k), lambda bi, qi, kj, vl_ref: kv_index(bi, qi, kj, vl_ref)[:2]),
-        pl.BlockSpec((1, block_k), lambda bi, qi, kj, vl_ref: kv_index(bi, qi, kj, vl_ref)[:2]),
+        pl.BlockSpec((block_bh, 1, block_k), scale_index),
+        pl.BlockSpec((block_bh, 1, block_k), scale_index),
     ]
     args = (qf, _flat(k), _flat(v))
     if quantized:
         kernel, in_specs = _decode_q8_kernel, kv_specs + scale_specs
-        args += (k_scale.reshape(bh, cap), v_scale.reshape(bh, cap))
+        args += (k_scale.reshape(bh, 1, cap), v_scale.reshape(bh, 1, cap))
     else:
         kernel, in_specs = _decode_kernel, kv_specs
     out = pl.pallas_call(
         functools.partial(
-            kernel, sm_scale=sm_scale, block_q=block_q, block_k=block_k,
-            s=s, rows=rows, window=window,
+            kernel, sm_scale=sm_scale, block_bh=block_bh, block_q=block_q,
+            block_k=block_k, s=s, rows=rows, window=window,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(bh, q_rows // block_q, cap // block_k),
+            grid=(bh // block_bh, q_rows // block_q, cap // block_k),
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, block_q, d), lambda bi, qi, kj, vl_ref: (bi, qi, 0)
+                (block_bh, block_q, d), lambda bi, qi, kj, vl_ref: (bi, qi, 0)
             ),
             scratch_shapes=[
-                pltpu.VMEM((block_q, _LANES), jnp.float32),
-                pltpu.VMEM((block_q, _LANES), jnp.float32),
-                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_bh, block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_bh, block_q, _LANES), jnp.float32),
+                pltpu.VMEM((block_bh, block_q, d), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((bh, q_rows, d), q.dtype),
@@ -821,14 +874,17 @@ def dequantize_kv(values: jax.Array, scales: jax.Array, dtype: Any = jnp.float32
 
 def _decode_q8_kernel(
     vl_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr,
-    *, sm_scale, block_q, block_k, s, rows, window,
+    *, sm_scale, block_bh, block_q, block_k, s, rows, window,
 ):
-    """:func:`_decode_kernel` over int8 K/V blocks: dequantize each
-    streamed tile in VMEM (one multiply per element) and reuse the
-    shared online-softmax update — HBM sees half the bytes."""
-    qi, kj = pl.program_id(1), pl.program_id(2)
+    """:func:`_decode_kernel` over int8 K/V blocks. int8 values are
+    EXACT in bf16 (|x| <= 127), so the MXU dots run on raw casts and
+    the fp32 scales fold into the score columns (k_scale) and the
+    prob@value dot (v_scale) — no dequantized (block_k, d) tile is
+    ever materialized, which is what made the first hardware
+    measurement of this kernel slower than the bf16 cache it was meant
+    to beat. HBM sees half the bytes."""
+    bi, qi, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
-    vl = _read_vl(vl_ref, pl.program_id(0))
 
     @pl.when(kj == 0)
     def _init():
@@ -836,32 +892,36 @@ def _decode_q8_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    first, last = _decode_block_range(vl, block_k=block_k, s=s, window=window)
+    first, last = _group_block_range(
+        vl_ref, bi, block_bh=block_bh, block_k=block_k, s=s, window=window
+    )
 
     @pl.when((kj >= first) & (kj <= last))
     def _body():
-        # Dequantize to the query dtype (bf16 in production) so both
-        # dot_generals keep MXU-native input precision with fp32
-        # accumulation — the bf16 rounding of value*scale is the same
-        # order as the int8 quantization error itself.
-        kb = (k_ref[0].astype(jnp.float32) * ks_ref[0][:, None]).astype(q_ref.dtype)
-        sc = jax.lax.dot_general(
-            q_ref[0], kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        visible = _decode_mask(
-            vl, qi, kj, block_q=block_q, block_k=block_k, s=s, rows=rows,
-            window=window,
-        )
-        sc = jnp.where(visible, sc * sm_scale, NEG_INF)
-        vb = (v_ref[0].astype(jnp.float32) * vs_ref[0][:, None]).astype(q_ref.dtype)
-        _online_softmax_update(sc, vb, m_scr, l_scr, acc_scr)
+        for g in range(block_bh):
+            vl = _read_vl(vl_ref, bi * block_bh + g)
+            kb = k_ref[g].astype(q_ref.dtype)
+            sc = jax.lax.dot_general(
+                q_ref[g], kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            sc = sc * ks_ref[g]  # (1, block_k) broadcasts over q rows
+            visible = _decode_mask(
+                vl, qi, kj, block_q=block_q, block_k=block_k, s=s,
+                rows=rows, window=window,
+            )
+            sc = jnp.where(visible, sc * sm_scale, NEG_INF)
+            _online_softmax_update(
+                sc, v_ref[g].astype(q_ref.dtype),
+                m_scr.at[g], l_scr.at[g], acc_scr.at[g],
+                p_scale=vs_ref[g],
+            )
 
     @pl.when(kj == nk - 1)
     def _finalize():
-        l = l_scr[:, :1]
+        l = l_scr[...][:, :, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
 
 
 def decode_attention_q8(

@@ -216,11 +216,15 @@ def test_lm_server_prefix_over_http():
     params = _params(plain)
     registry.save_flax(plain, params, "cb-lm3", metrics={"loss": 1.0})
     prefix = list(range(1, 9))
-    serving.create_or_update(
+    # Pass the tokens as a numpy array: the registry round-trips config
+    # through JSON (default=str), so create_or_update must normalize
+    # arrays to int lists or start() would receive a stringified array.
+    cfg = serving.create_or_update(
         "cb-lm3", model_name="cb-lm3", model_server="LM",
         lm_config={"slots": 1, "prefill_buckets": [8],
-                   "prefixes": {"sys": prefix}},
+                   "prefixes": {"sys": np.asarray(prefix, np.int32)}},
     )
+    assert cfg["lm_config"]["prefixes"]["sys"] == prefix
     serving.start("cb-lm3")
     try:
         sfx = [9, 10, 11]
