@@ -43,6 +43,11 @@ def main() -> None:
         "LMEngine slots vs the same workload as padded static batches",
     )
     parser.add_argument(
+        "--horizon", type=int, default=1,
+        help="LMEngine decode_horizon: device-side steps per dispatch "
+        "(amortizes host-dispatch latency; only used with --continuous)",
+    )
+    parser.add_argument(
         "--valid-sweep", action="store_true",
         help="time raw decode_attention vs valid_len at fixed capacity: "
         "flat times mean capacity-proportional DMA, linear-in-valid times "
@@ -214,7 +219,8 @@ def _continuous_bench(args) -> None:
     # ONE engine across runs: its jitted programs are per-instance, so
     # a fresh engine would recompile and the timing would be compile,
     # not serving.
-    engine = LMEngine(model, params, slots=slots)
+    engine = LMEngine(model, params, slots=slots,
+                      decode_horizon=args.horizon)
 
     def run_engine():
         d0 = engine.dispatches

@@ -127,6 +127,14 @@ class LMEngine:
     ``submit()`` enqueues and returns a ticket; ``step()`` runs one
     engine iteration (admit into free slots, then one decode dispatch);
     ``run()`` drains everything and returns ``{ticket: tokens}``.
+
+    ``decode_horizon`` scans that many decode steps on-device per
+    dispatch, amortizing host-dispatch latency (the measured serving
+    bottleneck — BENCHMARKS.md round-4 hardware notes) at the cost of
+    admitting new requests only at horizon boundaries and of wasted
+    steps for rows that retire mid-horizon. Output tokens are
+    IDENTICAL for any horizon (an in-graph live mask retires rows at
+    their budget/eos exactly as the host loop would).
     """
 
     def __init__(
@@ -135,6 +143,7 @@ class LMEngine:
         params: Any,
         slots: int = 4,
         prefill_buckets: tuple[int, ...] | None = None,
+        decode_horizon: int = 1,
     ):
         if not getattr(model, "ragged_decode", False):
             raise ValueError(
@@ -145,6 +154,9 @@ class LMEngine:
         self.model = model
         self.params = params
         self.slots = slots
+        if decode_horizon < 1:
+            raise ValueError(f"decode_horizon must be >= 1, got {decode_horizon}")
+        self.decode_horizon = decode_horizon
         cap = model.max_decode_len
         if prefill_buckets is None:
             prefill_buckets = tuple(
@@ -266,12 +278,44 @@ class LMEngine:
             last, cache = _step_logits(params, cache, tokens, active)
             return _sample_rows(last, temps, topks, seeds, ns), cache
 
+        # Horizon program: ``horizon`` decode steps in ONE dispatch via
+        # lax.scan — the host-dispatch-latency amortization (measured
+        # on the relay: per-token dispatch cost ~84 ms RTT dominated
+        # engine throughput, BENCHMARKS.md "decode knobs, hardware").
+        # An in-graph ``live`` mask retires rows at their budget or
+        # eos: a dead row's cache index clamps to 0 (the free-slot
+        # convention), so caches can never overrun max_decode_len
+        # mid-horizon. Returns (horizon, slots) tokens plus the
+        # live-going-in mask saying which of them are real.
+        def step_horizon(params, cache, tokens, live0, rems, eos_ids,
+                         temps, topks, seeds, ns, *, horizon, sampled):
+            def body(carry, _):
+                cache, tok, live, n, rem = carry
+                last, cache = _step_logits(params, cache, tok, live)
+                if sampled:
+                    nxt = _sample_rows(last, temps, topks, seeds, n)
+                else:
+                    nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                n2 = n + live.astype(jnp.int32)
+                rem2 = rem - live.astype(jnp.int32)
+                live2 = live & (rem2 > 0) & (nxt != eos_ids)
+                return (cache, nxt, live2, n2, rem2), (nxt, live)
+
+            (cache, _, _, _, _), (toks, lives) = jax.lax.scan(
+                body, (cache, tokens, live0, ns, rems), None, length=horizon
+            )
+            return toks, lives, cache
+
         self._prefill = prefill
         self._append = append
         self._insert = jax.jit(insert, donate_argnums=(0,))
         self._prefixes: dict[str, tuple[Any, int]] = {}
         self._step_greedy = jax.jit(step_greedy, donate_argnums=(1,))
         self._step_sampled = jax.jit(step_sampled, donate_argnums=(1,))
+        self._step_horizon = jax.jit(
+            step_horizon, donate_argnums=(1,),
+            static_argnames=("horizon", "sampled"),
+        )
         # Telemetry: dispatches vs tokens emitted say how well slots
         # stayed occupied (the continuous-batching win); prefix_hits
         # counts admissions that skipped a shared-prefix recompute.
@@ -361,8 +405,10 @@ class LMEngine:
 
     def step(self) -> list[int]:
         """One engine iteration: admit queued requests into free slots,
-        then one decode dispatch for all slots. Returns tickets that
-        finished this iteration."""
+        then one decode dispatch for all slots (``decode_horizon``
+        device-side steps — admission happens only at horizon
+        boundaries, the standard latency/throughput trade). Returns
+        tickets that finished this iteration."""
         finished = []
         for row in range(self.slots):
             if self._slot_state[row] is None and self._queue:
@@ -379,22 +425,71 @@ class LMEngine:
         active = jnp.asarray(
             [st is not None for st in self._slot_state], jnp.bool_
         )
-        if any(st is not None and st.temperature > 0 for st in self._slot_state):
-            temps = jnp.asarray(
-                [st.temperature if st else 0.0 for st in self._slot_state],
-                jnp.float32,
+        sampled = any(
+            st is not None and st.temperature > 0 for st in self._slot_state
+        )
+        # _admit finishes exhausted/eos'd requests on the spot, so
+        # every slot that reaches a dispatch has work left.
+        assert all(
+            st is None or st.remaining >= 1 for st in self._slot_state
+        )
+
+        def sampling_vectors():
+            return (
+                jnp.asarray(
+                    [st.temperature if st else 0.0 for st in self._slot_state],
+                    jnp.float32,
+                ),
+                jnp.asarray(
+                    [st.top_k if st else 0 for st in self._slot_state], jnp.int32
+                ),
+                jnp.asarray(
+                    [st.seed if st else 0 for st in self._slot_state], jnp.int32
+                ),
+                jnp.asarray(
+                    [st.n_sampled if st else 0 for st in self._slot_state],
+                    jnp.int32,
+                ),
             )
-            topks = jnp.asarray(
-                [st.top_k if st else 0 for st in self._slot_state], jnp.int32
+
+        def account(row: int, tok: int) -> None:
+            # The one emit-and-finish bookkeeping path, shared by the
+            # single-step and horizon loops (must mirror the in-graph
+            # live-mask retirement exactly).
+            st = self._slot_state[row]
+            st.emitted.append(tok)
+            st.remaining -= 1
+            st.n_sampled += 1
+            self.tokens_emitted += 1
+            if st.remaining == 0 or (st.eos_id is not None and tok == st.eos_id):
+                finished.append(self._finish(row))
+
+        if self.decode_horizon > 1:
+            rems = jnp.asarray(
+                [st.remaining if st else 0 for st in self._slot_state],
+                jnp.int32,
             )
-            seeds = jnp.asarray(
-                [st.seed if st else 0 for st in self._slot_state], jnp.int32
+            eos_ids = jnp.asarray(
+                [st.eos_id if st and st.eos_id is not None else -1
+                 for st in self._slot_state],
+                jnp.int32,
             )
-            ns = jnp.asarray(
-                [st.n_sampled if st else 0 for st in self._slot_state], jnp.int32
+            toks, lives, self._cache = self._step_horizon(
+                self.params, self._cache, tokens, active, rems, eos_ids,
+                *sampling_vectors(),
+                horizon=self.decode_horizon, sampled=sampled,
             )
+            self.dispatches += 1
+            toks, lives = np.asarray(toks), np.asarray(lives)
+            for i in range(self.decode_horizon):
+                for row in range(self.slots):
+                    if self._slot_state[row] is not None and lives[i, row]:
+                        account(row, int(toks[i, row]))
+            return finished
+
+        if sampled:
             nxt, self._cache = self._step_sampled(
-                self.params, self._cache, tokens, active, temps, topks, seeds, ns
+                self.params, self._cache, tokens, active, *sampling_vectors()
             )
         else:
             nxt, self._cache = self._step_greedy(
@@ -402,19 +497,9 @@ class LMEngine:
             )
         self.dispatches += 1
         nxt = np.asarray(nxt)
-        for row, st in enumerate(self._slot_state):
-            if st is None:
-                continue
-            # _admit finishes exhausted/eos'd requests on the spot, so
-            # every slot that reaches a dispatch has work left.
-            assert st.remaining >= 1
-            tok = int(nxt[row])
-            st.emitted.append(tok)
-            st.remaining -= 1
-            st.n_sampled += 1
-            self.tokens_emitted += 1
-            if st.remaining == 0 or (st.eos_id is not None and tok == st.eos_id):
-                finished.append(self._finish(row))
+        for row in range(self.slots):
+            if self._slot_state[row] is not None:
+                account(row, int(nxt[row]))
         return finished
 
     def run(self) -> dict[int, list[int]]:

@@ -168,6 +168,7 @@ class LMEnginePredictor:
             prefill_buckets=(
                 tuple(cfg["prefill_buckets"]) if "prefill_buckets" in cfg else None
             ),
+            decode_horizon=int(cfg.get("decode_horizon", 1)),
         )
         # Shared prompt prefixes (system prompts): prefilled once at
         # startup; instances opt in with {"prefix_id": name}.
@@ -475,10 +476,11 @@ def create_or_update(
     ``batching_config`` knobs: ``max_batch_size`` (default 64),
     ``timeout_ms`` (default 5). ``model_server="LM"`` serves a saved
     TransformerLM with continuous batching (``lm_config`` knobs:
-    ``slots``, ``prefill_buckets``, and ``prefixes`` — a
-    ``{name: token_ids}`` dict of shared prompt prefixes prefilled once
-    at startup); it does its own cross-request scheduling, so it
-    composes with ``batching_enabled=False`` only."""
+    ``slots``, ``prefill_buckets``, ``decode_horizon`` — device-side
+    steps per dispatch, amortizing host-dispatch latency — and
+    ``prefixes``, a ``{name: token_ids}`` dict of shared prompt
+    prefixes prefilled once at startup); it does its own cross-request
+    scheduling, so it composes with ``batching_enabled=False`` only."""
     if model_server.upper() == LM and batching_enabled:
         raise ValueError(
             "model_server='LM' schedules requests itself (continuous "

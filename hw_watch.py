@@ -1,0 +1,75 @@
+"""Relay-recovery watcher: probe periodically, then run queued hardware
+measurements exactly once.
+
+The queue is the decode-horizon continuous-batching A/B (the rest of the
+round-4 agenda was banked by ``hw_measure.py`` — `HW_MEASURE.jsonl`).
+Measurements run with NO timeout and are never killed: a SIGTERM'd
+client is what wedges the single-tenant relay in the first place
+(BENCHMARKS.md relay incident log).
+
+Usage: nohup python hw_watch.py >> hw_watch.log 2>&1 &
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).parent
+OUT = ROOT / "HW_MEASURE.jsonl"
+PROBE_EVERY_S = 900
+
+STEPS: list[tuple[str, list[str]]] = [
+    ("decode_continuous_h1", [sys.executable, "examples/decode_bench.py",
+                              "--continuous", "--batch", "4", "--tokens", "32",
+                              "--layers", "4"]),
+    ("decode_continuous_h8", [sys.executable, "examples/decode_bench.py",
+                              "--continuous", "--batch", "4", "--tokens", "32",
+                              "--layers", "4", "--horizon", "8"]),
+]
+
+
+def record(entry: dict) -> None:
+    with OUT.open("a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ":".join(
+        p for p in (env.get("PYTHONPATH"), str(ROOT)) if p
+    )
+    while True:
+        proc = subprocess.run(
+            [sys.executable, "bench.py", "--probe"],
+            cwd=ROOT, env=env, capture_output=True, text=True,
+        )
+        if '"ok": true' in proc.stdout:
+            print("[hw_watch] relay recovered — running queue", flush=True)
+            break
+        print(f"[hw_watch] relay still wedged; sleeping {PROBE_EVERY_S}s", flush=True)
+        time.sleep(PROBE_EVERY_S)
+    for name, cmd in STEPS:
+        t0 = time.time()
+        print(f"[hw_watch] {name}", flush=True)
+        proc = subprocess.run(  # no timeout, ever
+            cmd, cwd=ROOT, env=env, capture_output=True, text=True
+        )
+        record({
+            "step": name,
+            "rc": proc.returncode,
+            "wall_s": round(time.time() - t0, 1),
+            "stdout": proc.stdout[-4000:],
+            "stderr": proc.stderr[-2000:],
+            "ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+        })
+        print(f"[hw_watch] {name}: rc={proc.returncode}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -221,7 +221,7 @@ def test_lm_server_prefix_over_http():
     # arrays to int lists or start() would receive a stringified array.
     cfg = serving.create_or_update(
         "cb-lm3", model_name="cb-lm3", model_server="LM",
-        lm_config={"slots": 1, "prefill_buckets": [8],
+        lm_config={"slots": 1, "prefill_buckets": [8], "decode_horizon": 4,
                    "prefixes": {"sys": np.asarray(prefix, np.int32)}},
     )
     assert cfg["lm_config"]["prefixes"]["sys"] == prefix
@@ -468,3 +468,70 @@ def test_engine_budget_one_finishes_at_admission():
         max_new_tokens=1, temperature=0.0,
     )
     assert results[t] == [int(np.asarray(ref[0, -1]))]
+
+
+def test_engine_decode_horizon_output_identical_fewer_dispatches():
+    """decode_horizon scans k steps per dispatch: outputs must be
+    IDENTICAL to the horizon=1 engine on a workload mixing ragged
+    budgets, eos mid-horizon, sampling, and a shared prefix — while
+    using strictly fewer decode dispatches."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    rs = np.random.RandomState(7)
+
+    # An eos that actually fires early in one rollout (mid-horizon for
+    # horizon=4), as in test_engine_eos_frees_slot_early.
+    probe = rs.randint(0, 64, (5,))
+    roll = generate(
+        plain, params, jnp.asarray(probe)[None], jax.random.PRNGKey(0),
+        max_new_tokens=8, temperature=0.0,
+    )
+    eos = int(np.asarray(roll[0, 5:])[2])
+
+    prefix = list(range(1, 9))
+
+    def workload(engine):
+        engine.register_prefix("sys", prefix)
+        ts = [
+            engine.submit(probe, max_new_tokens=8, eos_id=eos),
+            engine.submit(rs.randint(0, 64, (3,)), max_new_tokens=10),
+            engine.submit([9, 10, 11], max_new_tokens=5, prefix_id="sys"),
+            engine.submit(rs.randint(0, 64, (7,)), max_new_tokens=6,
+                          temperature=0.8, top_k=8, seed=42),
+            engine.submit(rs.randint(0, 64, (2,)), max_new_tokens=1),
+        ]
+        return ts, engine.run(), engine.dispatches
+
+    rs_state = rs.get_state()
+    e1 = LMEngine(model, params, slots=2, prefill_buckets=(8, 16))
+    t1, r1, d1 = workload(e1)
+    rs.set_state(rs_state)  # same prompts for the second engine
+    e4 = LMEngine(model, params, slots=2, prefill_buckets=(8, 16),
+                  decode_horizon=4)
+    t4, r4, d4 = workload(e4)
+
+    assert [r1[t] for t in t1] == [r4[t] for t in t4]
+    assert d4 < d1, (d4, d1)
+    # eos semantics survived the horizon: stops at and includes eos.
+    assert r4[t4[0]][-1] == eos and len(r4[t4[0]]) <= 8
+
+
+def test_engine_decode_horizon_cache_never_overruns():
+    """A request whose budget ends mid-horizon must freeze its cache
+    row (live-mask retirement): totals at max_decode_len capacity work
+    with any horizon."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    p = np.random.RandomState(8).randint(0, 64, (4,))
+    # 4 + 60 == max_decode_len exactly; horizon 7 does not divide 60.
+    engine = LMEngine(model, params, slots=1, prefill_buckets=(8,),
+                      decode_horizon=7)
+    t = engine.submit(p, max_new_tokens=60)
+    results = engine.run()
+    ref = generate(
+        plain, params, jnp.asarray(p)[None], jax.random.PRNGKey(0),
+        max_new_tokens=60, temperature=0.0,
+    )
+    assert results[t] == list(np.asarray(ref[0, 4:]))
