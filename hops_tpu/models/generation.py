@@ -33,6 +33,7 @@ def generate(
     top_k: int | None = None,
     eos_id: int | None = None,
     pad_id: int = 0,
+    row_offset: jax.Array | int = 0,
 ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` (b, L).
 
@@ -43,7 +44,9 @@ def generate(
     "KV-cached decoding"). With ``eos_id`` set, rows that have emitted
     it produce ``pad_id`` from the next step on (shapes stay static —
     the scan still runs ``max_new_tokens`` steps, the TPU-idiomatic
-    trade for per-row early exit).
+    trade for per-row early exit). ``row_offset`` is the global id of
+    row 0 — sampling keys fold in global row ids, so a dp-sharded call
+    (each shard passing its offset) reproduces the unsharded draws.
     """
     b, prompt_len = prompt.shape
     if max_new_tokens < 1:
@@ -60,6 +63,15 @@ def generate(
     )
     cache = variables["cache"]
 
+    # Per-row keys fold the GLOBAL row id into the step key, so a
+    # rollout depends only on (rng, row, step) — not on batch layout.
+    # Under a dp-sharded shard_map (parallel/tp_inference.py passes
+    # row_offset = axis_index * local_batch) every shard draws its own
+    # rows' stream and the output is bit-identical to the unsharded
+    # call; a shared `categorical(key, batch)` would replay shard 0's
+    # Gumbel noise on every shard.
+    row_ids = row_offset + jnp.arange(b)
+
     def sample(logits_row, key):
         if temperature == 0.0 or top_k == 1:
             return jnp.argmax(logits_row, axis=-1)
@@ -67,7 +79,10 @@ def generate(
         if top_k is not None:
             kth = jnp.sort(logits_row, axis=-1)[:, -top_k][:, None]
             logits_row = jnp.where(logits_row < kth, -jnp.inf, logits_row)
-        return jax.random.categorical(key, logits_row, axis=-1)
+        keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(row_ids)
+        return jax.vmap(
+            lambda kk, lr: jax.random.categorical(kk, lr, axis=-1)
+        )(keys, logits_row)
 
     rng, key = jax.random.split(rng)
     first = sample(logits[:, -1], key)

@@ -405,6 +405,13 @@ class TransformerLM(nn.Module):
     num_kv_heads: int | None = None  # GQA: shrink the decode cache
     window: int | None = None  # sliding-window causal attention
     ragged_decode: bool = False  # (b,) cache index: continuous batching
+    # Megatron tensor parallelism: params hold num_heads/tp_shards
+    # heads (gate/up shard hidden columns), one psum per block over
+    # tp_axis. Apply inside a shard_map whose param specs slice the
+    # DENSE checkpoint's head-major axes (parallel/tp_inference.py) —
+    # the local shapes line up with a tp_shards-configured module.
+    tp_axis: str | None = None
+    tp_shards: int = 1
 
     @nn.compact
     def __call__(
@@ -416,6 +423,12 @@ class TransformerLM(nn.Module):
     ):
         from hops_tpu.models.moe import MoEBlock
 
+        if self.tp_shards > 1 and self.moe_every:
+            raise NotImplementedError(
+                "tensor parallelism composes with dense TransformerLMs; "
+                "shard MoE models over an expert axis instead "
+                "(parallel/pipeline.py expert_axis, models/moe.py)"
+            )
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")(tokens)
         block_cls = nn.remat(Block, static_argnums=(2, 3)) if self.remat else Block
         moe_cls = nn.remat(MoEBlock, static_argnums=(2, 3)) if self.remat else MoEBlock
@@ -448,6 +461,8 @@ class TransformerLM(nn.Module):
                 batch_axis=self.batch_axis,
                 dropout_rate=self.dropout_rate,
                 max_decode_len=self.max_decode_len,
+                tp_axis=self.tp_axis,
+                tp_shards=self.tp_shards,
                 kv_cache_dtype=self.kv_cache_dtype,
                 num_kv_heads=self.num_kv_heads,
                 window=self.window,

@@ -390,24 +390,14 @@ def pipelined_lm_apply(
         # shard input rows; gate/up (S,K,dm,hidden) shard output
         # columns. Everything else stays stage-sharded (replicated
         # over tp).
+        from hops_tpu.parallel.tp_inference import tp_leaf_partition
+
         def tp_leaf_spec(path, _):
             names = [str(k.key) for k in path if hasattr(k, "key")]
-            leaf = names[-1] if names else ""
-            if "qkv" in names and leaf == "kernel":
-                return P(axis, None, None, None, tp_axis, None)
-            if "q" in names and leaf == "kernel":
-                # GQA split projections: q (S,K,dm,H,hd) shards heads,
-                # kv (S,K,dm,2,Hkv,hd) shards kv heads.
-                return P(axis, None, None, tp_axis, None)
-            if "kv" in names and leaf == "kernel":
-                return P(axis, None, None, None, tp_axis, None)
-            if "out" in names and leaf == "kernel":
-                return P(axis, None, tp_axis, None)
-            if leaf == "kernel" and ("gate" in names or "up" in names):
-                return P(axis, None, None, tp_axis)
-            if "down" in names and leaf == "kernel":
-                return P(axis, None, tp_axis, None)
-            return P(axis)
+            part = tp_leaf_partition(names, tp_axis)
+            # Stacked leaves are (S, K, *param.shape): prepend the
+            # stage and layer dims to the shared per-param partition.
+            return P(axis, None, *part) if part else P(axis)
 
         param_specs = jax.tree_util.tree_map_with_path(tp_leaf_spec, stacked)
     if expert_axis:

@@ -1,0 +1,129 @@
+"""Tensor-parallel inference: serve a dense-checkpoint TransformerLM
+sharded over attention heads / MLP hidden columns.
+
+Beyond-reference capability (the reference's serving is single-process
+TF-Serving REST — SURVEY.md §2.5; nothing in it shards a model): a
+model too big for one chip's HBM decodes across a ``tp_axis`` mesh
+dimension the Megatron way — each device holds ``1/tp`` of every qkv /
+out / gate / up / down kernel and its own head-shard of the KV cache,
+and ONE psum per block (attention out + MLP down) combines the partial
+sums over ICI. The TPU-shaped part: the whole ``generate()`` loop —
+prefill, the ``lax.scan`` of decode steps, the Pallas decode kernel,
+sampling — runs INSIDE a single ``shard_map``, so the only
+cross-device traffic is those per-block psums; the cache lives
+device-local for the entire generation.
+
+No weight repacking: ``tp_param_specs`` slices the DENSE checkpoint's
+existing head-major axes (qkv kernels are ``(dm, 3, H, hd)``, out is
+head-major ``(dm, dm)``), so the shards a ``tp_shards``-configured
+module expects are exactly what ``shard_map`` hands it. Output is
+token-identical to single-device ``generate`` (tests/test_parallel.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def tp_leaf_partition(names: list[str], tp_axis: str) -> tuple | None:
+    """Which per-param axis Megatron-shards, by param path ``names``:
+    the partition tuple for the UNSTACKED leaf shape, or None for
+    replicated. The single source of truth for the leaf-role
+    classification — ``parallel/pipeline.py`` prepends its (stage,
+    layer) dims to these same tuples, so the two paths cannot
+    disagree."""
+    tail = names[-1] if names else ""
+    if tail == "kernel":
+        if "qkv" in names:  # (dm, 3, H, hd)
+            return (None, None, tp_axis, None)
+        if "q" in names:  # GQA q: (dm, H, hd)
+            return (None, tp_axis, None)
+        if "kv" in names:  # GQA kv: (dm, 2, Hkv, hd)
+            return (None, None, tp_axis, None)
+        if "out" in names:  # (dm, dm), rows head-major
+            return (tp_axis, None)
+        if "gate" in names or "up" in names:  # (dm, hidden)
+            return (None, tp_axis)
+        if "down" in names:  # (hidden, dm)
+            return (tp_axis, None)
+    return None
+
+
+def tp_param_specs(params: Any, tp_axis: str) -> Any:
+    """PartitionSpecs sharding a dense TransformerLM param tree the
+    Megatron way over ``tp_axis``: qkv/q/kv kernels on their head axis,
+    attention-out and mlp-down kernels on input rows (head-major, so
+    row slices are head slices), gate/up on output columns; embeds,
+    norms, and the unembed replicate."""
+
+    def leaf_spec(path, leaf):
+        names = [str(k.key) for k in path if hasattr(k, "key")]
+        part = tp_leaf_partition(names, tp_axis)
+        return P(*part) if part else P()
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+def tp_generate(
+    model: Any,
+    params: Any,
+    prompt: jax.Array,
+    rng: jax.Array,
+    mesh: Mesh,
+    tp_axis: str = "model",
+    batch_axis: str | None = None,
+    **generate_kwargs: Any,
+) -> jax.Array:
+    """:func:`hops_tpu.models.generation.generate` over a tensor-
+    parallel mesh: same signature plus ``mesh``/``tp_axis``, same
+    token-identical output. ``model`` is the DENSE module (its
+    ``num_heads``, and ``num_kv_heads`` if set, must divide the tp
+    degree evenly); ``params`` a dense checkpoint, resident sharded or
+    not — jit moves it to the ``tp_param_specs`` layout. With
+    ``batch_axis``, prompt rows additionally shard over that mesh axis
+    (dp x tp serving on one mesh).
+    """
+    fn = _compiled(
+        model, mesh, tp_axis, batch_axis,
+        tuple(sorted(generate_kwargs.items())),
+    )
+    return fn(params, prompt, rng)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled(model, mesh, tp_axis, batch_axis, kw_items):
+    """The jitted shard_mapped generate loop, cached on everything
+    static — a per-call ``jax.jit(closure)`` would be a fresh callable
+    every time and re-trace/recompile the whole decode program on
+    every request batch."""
+    from hops_tpu.models.generation import generate
+
+    generate_kwargs = dict(kw_items)
+    local = model.clone(tp_axis=tp_axis, tp_shards=mesh.shape[tp_axis])
+    data_spec = P(batch_axis) if batch_axis else P()
+
+    def run(p, prompt, rng):
+        # Global row id of this shard's row 0, so sampled rollouts are
+        # bit-identical to the unsharded call (generate folds global
+        # row ids into its per-row sampling keys).
+        row_offset = (
+            jax.lax.axis_index(batch_axis) * prompt.shape[0]
+            if batch_axis else 0
+        )
+        return generate(
+            local, p, prompt, rng, row_offset=row_offset, **generate_kwargs
+        )
+
+    def mapped(params, prompt, rng):
+        specs = tp_param_specs(params, tp_axis)
+        return shard_map(
+            run, mesh=mesh, in_specs=(specs, data_spec, P()),
+            out_specs=data_spec, check_rep=False,
+        )(params, prompt, rng)
+
+    return jax.jit(mapped)
