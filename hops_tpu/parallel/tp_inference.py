@@ -82,8 +82,8 @@ def tp_generate(
     """:func:`hops_tpu.models.generation.generate` over a tensor-
     parallel mesh: same signature plus ``mesh``/``tp_axis``, same
     token-identical output. ``model`` is the DENSE module (its
-    ``num_heads``, and ``num_kv_heads`` if set, must divide the tp
-    degree evenly); ``params`` a dense checkpoint, resident sharded or
+    ``num_heads``, and ``num_kv_heads`` if set, must be divisible by
+    the tp degree); ``params`` a dense checkpoint, resident sharded or
     not — jit moves it to the ``tp_param_specs`` layout. With
     ``batch_axis``, prompt rows additionally shard over that mesh axis
     (dp x tp serving on one mesh).
