@@ -41,6 +41,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from hops_tpu.models.generation import top_p_mask
+
 
 def _map_cache(cache: Any, fn_kv, fn_idx, *rest: Any) -> Any:
     """Apply ``fn_kv`` to k/v/scale leaves and ``fn_idx`` to the 'idx'
@@ -69,14 +71,17 @@ def _map_cache(cache: Any, fn_kv, fn_idx, *rest: Any) -> Any:
     return out
 
 
-def _sample_rows(logits, temps, topks, seeds, ns):
+def _sample_rows(logits, temps, topks, topps, seeds, ns, use_top_p=False):
     """Per-row sampling over (rows, vocab) logits: ``temps[i] <= 0`` is
-    greedy; ``topks[i] > 0`` keeps the top-k logits. Keys derive
-    in-graph from (request seed, token index) — a pure function, so a
-    request's output is independent of slot placement and of what else
-    shares the batch, and the host never touches the backend to build
-    keys. Vectorized so greedy and sampled requests share one
-    dispatch."""
+    greedy; ``topks[i] > 0`` keeps the top-k logits; ``0 < topps[i] <
+    1`` applies the nucleus filter on top. Keys derive in-graph from
+    (request seed, token index) — a pure function, so a request's
+    output is independent of slot placement and of what else shares
+    the batch, and the host never touches the backend to build keys.
+    Vectorized so greedy and sampled requests share one dispatch.
+    ``use_top_p`` is static: the nucleus filter costs a second
+    full-vocab sort + softmax + cumsum, so workloads with no top_p
+    request never pay it."""
     keys = jax.vmap(
         lambda sd, n: jax.random.fold_in(jax.random.PRNGKey(sd), n)
     )(seeds, ns)
@@ -88,6 +93,8 @@ def _sample_rows(logits, temps, topks, seeds, ns):
     kth = jnp.take_along_axis(srt, (v - k_eff)[:, None], axis=-1)
     masked = jnp.where(logits < kth, -jnp.inf, logits)
     scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
+    if use_top_p:
+        scaled = top_p_mask(scaled, topps)  # out-of-(0,1) rows pass through
     sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
     return jnp.where(temps <= 0.0, greedy, sampled)
 
@@ -100,6 +107,7 @@ class _Request:
     eos_id: int | None
     temperature: float = 0.0
     top_k: int = 0  # 0 = no top-k truncation
+    top_p: float = 0.0  # 0 = no nucleus truncation
     seed: int = 0
     # (cache, length) snapshot taken at submit time: re-registering the
     # name later must not invalidate this request's capacity validation
@@ -115,6 +123,7 @@ class _SlotState:
     eos_id: int | None
     temperature: float = 0.0
     top_k: int = 0
+    top_p: float = 0.0
     seed: int = 0
     n_sampled: int = 1  # tokens drawn so far (prefill's counts as #0)
 
@@ -181,7 +190,7 @@ class LMEngine:
 
         # --- the three compiled programs -------------------------------
         def _admit_tail(logits, variables, true_len, end_len, temp, topk,
-                        seed, sampled):
+                        topp, seed, sampled, nucleus):
             """Shared tail of both admission programs: pick the last
             true row's logits, draw/argmax the first token, rewind the
             cache index to the true end (pad garbage past it stays
@@ -192,8 +201,9 @@ class LMEngine:
             )
             if sampled:
                 first_tok = _sample_rows(
-                    last[None], temp[None], topk[None], seed[None],
-                    jnp.zeros((1,), jnp.int32),
+                    last[None], temp[None], topk[None], topp[None],
+                    seed[None], jnp.zeros((1,), jnp.int32),
+                    use_top_p=nucleus,
                 )[0]
             else:
                 first_tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
@@ -204,19 +214,21 @@ class LMEngine:
             )
             return first_tok, cache
 
-        @functools.partial(jax.jit, static_argnames=("sampled",))
-        def prefill(params, padded_prompt, true_len, temp, topk, seed, sampled=False):
+        @functools.partial(jax.jit, static_argnames=("sampled", "nucleus"))
+        def prefill(params, padded_prompt, true_len, temp, topk, topp, seed,
+                    sampled=False, nucleus=False):
             # b=1 fresh cache.
             logits, variables = model.apply(
                 {"params": params}, padded_prompt, decode=True, mutable=["cache"]
             )
             return _admit_tail(
-                logits, variables, true_len, true_len, temp, topk, seed, sampled
+                logits, variables, true_len, true_len, temp, topk, topp,
+                seed, sampled, nucleus,
             )
 
-        @functools.partial(jax.jit, static_argnames=("sampled",))
+        @functools.partial(jax.jit, static_argnames=("sampled", "nucleus"))
         def append(params, cache, padded_suffix, base_len, true_len, temp,
-                   topk, seed, sampled=False):
+                   topk, topp, seed, sampled=False, nucleus=False):
             # Warm-cache chunk append onto a COPY of a registered
             # prefix cache (not donated — the stored prefix is reused
             # by every request that names it). The apply writes the
@@ -231,7 +243,7 @@ class LMEngine:
             )
             return _admit_tail(
                 logits, variables, true_len, base_len + true_len,
-                temp, topk, seed, sampled,
+                temp, topk, topp, seed, sampled, nucleus,
             )
 
         def insert(big, one, row, true_len):
@@ -274,9 +286,12 @@ class LMEngine:
             last, cache = _step_logits(params, cache, tokens, active)
             return jnp.argmax(last, axis=-1).astype(jnp.int32), cache
 
-        def step_sampled(params, cache, tokens, active, temps, topks, seeds, ns):
+        def step_sampled(params, cache, tokens, active, temps, topks, topps,
+                         seeds, ns, nucleus=False):
             last, cache = _step_logits(params, cache, tokens, active)
-            return _sample_rows(last, temps, topks, seeds, ns), cache
+            return _sample_rows(
+                last, temps, topks, topps, seeds, ns, use_top_p=nucleus
+            ), cache
 
         # Horizon program: ``horizon`` decode steps in ONE dispatch via
         # lax.scan — the host-dispatch-latency amortization (measured
@@ -288,12 +303,16 @@ class LMEngine:
         # mid-horizon. Returns (horizon, slots) tokens plus the
         # live-going-in mask saying which of them are real.
         def step_horizon(params, cache, tokens, live0, rems, eos_ids,
-                         temps, topks, seeds, ns, *, horizon, sampled):
+                         temps, topks, topps, seeds, ns, *, horizon, sampled,
+                         nucleus=False):
             def body(carry, _):
                 cache, tok, live, n, rem = carry
                 last, cache = _step_logits(params, cache, tok, live)
                 if sampled:
-                    nxt = _sample_rows(last, temps, topks, seeds, n)
+                    nxt = _sample_rows(
+                        last, temps, topks, topps, seeds, n,
+                        use_top_p=nucleus,
+                    )
                 else:
                     nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
                 n2 = n + live.astype(jnp.int32)
@@ -311,10 +330,12 @@ class LMEngine:
         self._insert = jax.jit(insert, donate_argnums=(0,))
         self._prefixes: dict[str, tuple[Any, int]] = {}
         self._step_greedy = jax.jit(step_greedy, donate_argnums=(1,))
-        self._step_sampled = jax.jit(step_sampled, donate_argnums=(1,))
+        self._step_sampled = jax.jit(
+            step_sampled, donate_argnums=(1,), static_argnames=("nucleus",)
+        )
         self._step_horizon = jax.jit(
             step_horizon, donate_argnums=(1,),
-            static_argnames=("horizon", "sampled"),
+            static_argnames=("horizon", "sampled", "nucleus"),
         )
         # Telemetry: dispatches vs tokens emitted say how well slots
         # stayed occupied (the continuous-batching win); prefix_hits
@@ -345,7 +366,8 @@ class LMEngine:
         padded[0, :L] = tokens
         _, cache = self._prefill(
             self.params, jnp.asarray(padded), jnp.int32(L),
-            jnp.float32(0.0), jnp.int32(0), jnp.int32(0), sampled=False,
+            jnp.float32(0.0), jnp.int32(0), jnp.float32(0.0), jnp.int32(0),
+            sampled=False,
         )
         self._prefixes[name] = (cache, L)
         return name
@@ -357,12 +379,13 @@ class LMEngine:
         eos_id: int | None = None,
         temperature: float = 0.0,
         top_k: int | None = None,
+        top_p: float | None = None,
         seed: int = 0,
         prefix_id: str | None = None,
     ) -> int:
         """Enqueue a request. ``temperature=0`` is greedy; otherwise
-        tokens draw from the (optionally top-k-truncated) scaled
-        distribution, with a key chain that depends only on ``seed``
+        tokens draw from the (optionally top-k- and/or top-p-truncated)
+        scaled distribution, with a key chain that depends only on ``seed``
         and token index — reproducible regardless of slot placement or
         batch company. With ``prefix_id``, ``prompt`` is the SUFFIX
         after a prefix registered via :meth:`register_prefix`."""
@@ -391,6 +414,8 @@ class LMEngine:
             raise ValueError("max_new_tokens must be >= 1")
         if temperature < 0:
             raise ValueError("temperature must be >= 0")
+        if top_p is not None and not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         seed = int(seed) & 0x7FFFFFFF  # fold into int32 before it hits jit
         ticket = self._next_ticket
         self._next_ticket += 1
@@ -398,7 +423,7 @@ class LMEngine:
             _Request(
                 ticket, prompt, max_new_tokens, eos_id,
                 temperature=float(temperature), top_k=int(top_k or 0),
-                seed=int(seed), prefix=prefix,
+                top_p=float(top_p or 0.0), seed=int(seed), prefix=prefix,
             )
         )
         return ticket
@@ -428,6 +453,10 @@ class LMEngine:
         sampled = any(
             st is not None and st.temperature > 0 for st in self._slot_state
         )
+        nucleus = any(
+            st is not None and 0.0 < st.top_p < 1.0
+            for st in self._slot_state
+        )
         # _admit finishes exhausted/eos'd requests on the spot, so
         # every slot that reaches a dispatch has work left.
         assert all(
@@ -442,6 +471,10 @@ class LMEngine:
                 ),
                 jnp.asarray(
                     [st.top_k if st else 0 for st in self._slot_state], jnp.int32
+                ),
+                jnp.asarray(
+                    [st.top_p if st else 0.0 for st in self._slot_state],
+                    jnp.float32,
                 ),
                 jnp.asarray(
                     [st.seed if st else 0 for st in self._slot_state], jnp.int32
@@ -478,6 +511,7 @@ class LMEngine:
                 self.params, self._cache, tokens, active, rems, eos_ids,
                 *sampling_vectors(),
                 horizon=self.decode_horizon, sampled=sampled,
+                nucleus=nucleus,
             )
             self.dispatches += 1
             toks, lives = np.asarray(toks), np.asarray(lives)
@@ -489,7 +523,8 @@ class LMEngine:
 
         if sampled:
             nxt, self._cache = self._step_sampled(
-                self.params, self._cache, tokens, active, *sampling_vectors()
+                self.params, self._cache, tokens, active,
+                *sampling_vectors(), nucleus=nucleus,
             )
         else:
             nxt, self._cache = self._step_greedy(
@@ -561,7 +596,9 @@ class LMEngine:
                 self.params, base_cache, jnp.asarray(padded),
                 jnp.int32(base_len), jnp.int32(L),
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
-                jnp.int32(req.seed), sampled=req.temperature > 0,
+                jnp.float32(req.top_p), jnp.int32(req.seed),
+                sampled=req.temperature > 0,
+                nucleus=0.0 < req.top_p < 1.0,
             )
             total_len = base_len + L
             self.prefix_hits += 1
@@ -572,7 +609,9 @@ class LMEngine:
             first_tok, one_cache = self._prefill(
                 self.params, jnp.asarray(padded), jnp.int32(L),
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
-                jnp.int32(req.seed), sampled=req.temperature > 0,
+                jnp.float32(req.top_p), jnp.int32(req.seed),
+                sampled=req.temperature > 0,
+                nucleus=0.0 < req.top_p < 1.0,
             )
             total_len = L
         self._cache = self._insert(
@@ -587,6 +626,7 @@ class LMEngine:
             eos_id=req.eos_id,
             temperature=req.temperature,
             top_k=req.top_k,
+            top_p=req.top_p,
             seed=req.seed,
         )
         self._slot_state[row] = st

@@ -150,7 +150,8 @@ class LMEnginePredictor:
     other — continuous batching at the HTTP surface.
 
     Instance format: ``{"prompt": [ids], "max_new_tokens": 32,
-    "eos_id": null, "temperature": 0.0, "top_k": null, "seed": 0}``
+    "eos_id": null, "temperature": 0.0, "top_k": null, "top_p": null,
+    "seed": 0}``
     (a bare token list is shorthand for just the prompt). Predictions
     are generated-token lists, prompt excluded.
     """
@@ -210,6 +211,7 @@ class LMEnginePredictor:
                 "eos_id": instance.get("eos_id"),
                 "temperature": float(instance.get("temperature", 0.0)),
                 "top_k": instance.get("top_k"),
+                "top_p": instance.get("top_p"),
                 "seed": int(instance.get("seed", 0)),
                 "prefix_id": instance.get("prefix_id"),
             }

@@ -19,9 +19,35 @@ import jax
 import jax.numpy as jnp
 
 
+def top_p_mask(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus filter: ``-inf`` everywhere except the smallest
+    descending-probability prefix whose cumulative mass reaches
+    ``top_p``. ``logits`` (rows, vocab) should already be
+    temperature-scaled/top-k-masked; ``top_p`` is a scalar or (rows,)
+    vector — entries outside (0, 1) disable filtering for that row
+    (used by the engine's per-request knob). Ties at the threshold
+    probability are kept."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    srt = jnp.sort(probs, axis=-1)[..., ::-1]
+    cum = jnp.cumsum(srt, axis=-1)
+    reached = cum >= jnp.asarray(top_p)[..., None]
+    idx = jnp.argmax(reached, axis=-1)
+    thresh = jnp.take_along_axis(srt, idx[..., None], axis=-1)[..., 0]
+    # Out-of-range rows disable filtering: p <= 0 would "reach" at the
+    # top token (thresh = max prob, nearly-greedy — wrong for a
+    # disable sentinel) and p > 1 never reaches (argmax of all-False
+    # is 0, same wrong thresh), so both zero the threshold instead.
+    enabled = (jnp.asarray(top_p) > 0.0) & (jnp.asarray(top_p) < 1.0)
+    thresh = jnp.where(enabled & jnp.any(reached, axis=-1), thresh, 0.0)
+    return jnp.where(probs < thresh[..., None], -jnp.inf, logits)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "top_k", "temperature", "eos_id", "pad_id"),
+    static_argnames=(
+        "model", "max_new_tokens", "top_k", "top_p", "temperature",
+        "eos_id", "pad_id",
+    ),
 )
 def generate(
     model: Any,
@@ -31,13 +57,16 @@ def generate(
     max_new_tokens: int = 32,
     temperature: float = 1.0,
     top_k: int | None = None,
+    top_p: float | None = None,
     eos_id: int | None = None,
     pad_id: int = 0,
     row_offset: jax.Array | int = 0,
 ) -> jax.Array:
     """Sample ``max_new_tokens`` continuations of ``prompt`` (b, L).
 
-    ``temperature=0`` (or ``top_k=1``) is greedy decoding. Returns
+    ``temperature=0`` (or ``top_k=1``) is greedy decoding; ``top_k``
+    and ``top_p`` (nucleus) truncations compose, applied in that
+    order on the temperature-scaled logits. Returns
     ``(b, L + max_new_tokens)`` token ids. ``model.max_decode_len`` must
     cover the full final length — size it to the final length, not
     "big enough": decode cost scales with cache capacity (BENCHMARKS.md
@@ -56,6 +85,8 @@ def generate(
             f"prompt {prompt_len} + {max_new_tokens} new tokens exceeds "
             f"max_decode_len {model.max_decode_len}"
         )
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
     # Prefill: write the whole prompt into the caches in one pass.
     logits, variables = model.apply(
@@ -79,6 +110,8 @@ def generate(
         if top_k is not None:
             kth = jnp.sort(logits_row, axis=-1)[:, -top_k][:, None]
             logits_row = jnp.where(logits_row < kth, -jnp.inf, logits_row)
+        if top_p is not None and top_p < 1.0:
+            logits_row = top_p_mask(logits_row, jnp.float32(top_p))
         keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(row_ids)
         return jax.vmap(
             lambda kk, lr: jax.random.categorical(kk, lr, axis=-1)

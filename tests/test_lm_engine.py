@@ -535,3 +535,48 @@ def test_engine_decode_horizon_cache_never_overruns():
         max_new_tokens=60, temperature=0.0,
     )
     assert results[t] == list(np.asarray(ref[0, 4:]))
+
+
+def test_engine_top_p_restricts_support_and_reproduces():
+    """Nucleus sampling: with a tiny top_p, every drawn token must come
+    from the smallest probability prefix (here: near-greedy), and the
+    same (seed, top_p) reproduces; top_p composes with the horizon."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    p = np.random.RandomState(11).randint(0, 64, (6,))
+
+    # top_p small enough that only the argmax token survives the filter
+    # -> sampled output equals greedy, which we can check exactly.
+    greedy_ref = generate(
+        plain, params, jnp.asarray(p)[None], jax.random.PRNGKey(0),
+        max_new_tokens=6, temperature=0.0,
+    )
+    engine = LMEngine(model, params, slots=1, prefill_buckets=(8,))
+    t = engine.submit(p, max_new_tokens=6, temperature=0.9, top_p=1e-6,
+                      seed=3)
+    r = engine.run()
+    assert r[t] == list(np.asarray(greedy_ref[0, 6:]))
+
+    # Same seed+knobs reproduce through a horizon engine too.
+    eng2 = LMEngine(model, params, slots=1, prefill_buckets=(8,),
+                    decode_horizon=3)
+    t2 = eng2.submit(p, max_new_tokens=6, temperature=0.9, top_p=0.8, seed=3)
+    t3 = engine.submit(p, max_new_tokens=6, temperature=0.9, top_p=0.8, seed=3)
+    assert eng2.run()[t2] == engine.run()[t3]
+
+    with pytest.raises(ValueError, match="top_p"):
+        engine.submit(p, max_new_tokens=2, top_p=1.5)
+
+
+def test_generate_top_p_near_zero_is_greedy():
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    p = jnp.asarray(np.random.RandomState(12).randint(0, 64, (2, 5)))
+    greedy = generate(plain, params, p, jax.random.PRNGKey(1),
+                      max_new_tokens=5, temperature=0.0)
+    nucleus = generate(plain, params, p, jax.random.PRNGKey(1),
+                       max_new_tokens=5, temperature=1.0, top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(nucleus), np.asarray(greedy))
+    with pytest.raises(ValueError, match="top_p"):
+        generate(plain, params, p, jax.random.PRNGKey(1), top_p=0.0)
