@@ -40,6 +40,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from hops_tpu.models.generation import top_p_mask
 
@@ -94,7 +95,13 @@ def _sample_rows(logits, temps, topks, topps, seeds, ns, use_top_p=False):
     masked = jnp.where(logits < kth, -jnp.inf, logits)
     scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
     if use_top_p:
-        scaled = top_p_mask(scaled, topps)  # out-of-(0,1) rows pass through
+        # Reuse the ascending top-k sort: value-mask (ties kept, same
+        # multiset as `masked`) and temperature-scale it descending —
+        # top_p_mask then skips its own full-vocab sort.
+        srt_desc = srt[:, ::-1]
+        srt_desc = jnp.where(srt_desc >= kth, srt_desc, -jnp.inf)
+        srt_desc = srt_desc / jnp.maximum(temps, 1e-6)[:, None]
+        scaled = top_p_mask(scaled, topps, sorted_desc=srt_desc)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
     return jnp.where(temps <= 0.0, greedy, sampled)
 
@@ -144,6 +151,12 @@ class LMEngine:
     steps for rows that retire mid-horizon. Output tokens are
     IDENTICAL for any horizon (an in-graph live mask retires rows at
     their budget/eos exactly as the host loop would).
+
+    ``mesh`` serves a model too big for one chip: every program runs
+    tensor-parallel over ``tp_axis`` (Megatron head/hidden sharding,
+    ``parallel/tp_inference.py`` — the dense checkpoint is sliced in
+    place, the KV caches live head-sharded, and output is identical to
+    the unsharded engine for the full knob surface).
     """
 
     def __init__(
@@ -153,6 +166,8 @@ class LMEngine:
         slots: int = 4,
         prefill_buckets: tuple[int, ...] | None = None,
         decode_horizon: int = 1,
+        mesh: Any = None,
+        tp_axis: str = "model",
     ):
         if not getattr(model, "ragged_decode", False):
             raise ValueError(
@@ -166,6 +181,35 @@ class LMEngine:
         if decode_horizon < 1:
             raise ValueError(f"decode_horizon must be >= 1, got {decode_horizon}")
         self.decode_horizon = decode_horizon
+        # Tensor parallelism: every engine program runs inside a
+        # shard_map over ``tp_axis`` — params and KV caches shard on
+        # their head axes (parallel/tp_inference.py layout), scalars
+        # and token vectors replicate, and the per-block psums are the
+        # only cross-device traffic. Output is identical to the
+        # unsharded engine.
+        self.mesh = mesh
+        local_model = model
+        param_specs = cache_specs = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from hops_tpu.parallel.tp_inference import tp_param_specs
+
+            local_model = model.clone(
+                tp_axis=tp_axis, tp_shards=mesh.shape[tp_axis]
+            )
+            param_specs = tp_param_specs(params, tp_axis)
+            # Shard the checkpoint NOW: the whole point of mesh= is a
+            # model too big for one chip, so the weights must live in
+            # the Megatron layout rather than be re-laid-out from a
+            # single-device resident on every dispatch.
+            params = jax.tree.map(
+                lambda leaf, spec: jax.device_put(
+                    leaf, NamedSharding(mesh, spec)
+                ),
+                params, param_specs,
+            )
+            self.params = params
         cap = model.max_decode_len
         if prefill_buckets is None:
             prefill_buckets = tuple(
@@ -182,6 +226,28 @@ class LMEngine:
         self._cache = _map_cache(
             variables["cache"], jnp.zeros_like, jnp.zeros_like
         )
+        if mesh is not None:
+            # (slots, heads, ...) k/v/scale leaves shard on the head
+            # dim; the (slots,) index replicates.
+            cache_specs = _map_cache(
+                self._cache, lambda leaf: P(None, tp_axis), lambda idx: P()
+            )
+            self._cache = jax.tree.map(
+                lambda leaf, spec: jax.device_put(
+                    leaf, NamedSharding(mesh, spec)
+                ),
+                self._cache, cache_specs,
+            )
+
+        def sharded(body, in_specs, out_specs):
+            if mesh is None:
+                return body
+            from jax.experimental.shard_map import shard_map
+
+            return shard_map(
+                body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=False,
+            )
 
         self._queue: collections.deque[_Request] = collections.deque()
         self._slot_state: list[_SlotState | None] = [None] * slots
@@ -217,34 +283,50 @@ class LMEngine:
         @functools.partial(jax.jit, static_argnames=("sampled", "nucleus"))
         def prefill(params, padded_prompt, true_len, temp, topk, topp, seed,
                     sampled=False, nucleus=False):
-            # b=1 fresh cache.
-            logits, variables = model.apply(
-                {"params": params}, padded_prompt, decode=True, mutable=["cache"]
+            def body(params, padded_prompt, true_len, temp, topk, topp, seed):
+                # b=1 fresh cache.
+                logits, variables = local_model.apply(
+                    {"params": params}, padded_prompt, decode=True,
+                    mutable=["cache"],
+                )
+                return _admit_tail(
+                    logits, variables, true_len, true_len, temp, topk, topp,
+                    seed, sampled, nucleus,
+                )
+
+            body = sharded(
+                body, (param_specs,) + (P(),) * 6, (P(), cache_specs)
             )
-            return _admit_tail(
-                logits, variables, true_len, true_len, temp, topk, topp,
-                seed, sampled, nucleus,
-            )
+            return body(params, padded_prompt, true_len, temp, topk, topp, seed)
 
         @functools.partial(jax.jit, static_argnames=("sampled", "nucleus"))
         def append(params, cache, padded_suffix, base_len, true_len, temp,
                    topk, topp, seed, sampled=False, nucleus=False):
-            # Warm-cache chunk append onto a COPY of a registered
-            # prefix cache (not donated — the stored prefix is reused
-            # by every request that names it). The apply writes the
-            # whole padded bucket at offset base_len; garbage rows past
-            # true_len are causally invisible to true rows during the
-            # append.
-            logits, variables = model.apply(
-                {"params": params, "cache": cache},
-                padded_suffix,
-                decode=True,
-                mutable=["cache"],
+            def body(params, cache, padded_suffix, base_len, true_len, temp,
+                     topk, topp, seed):
+                # Warm-cache chunk append onto a COPY of a registered
+                # prefix cache (not donated — the stored prefix is
+                # reused by every request that names it). The apply
+                # writes the whole padded bucket at offset base_len;
+                # garbage rows past true_len are causally invisible to
+                # true rows during the append.
+                logits, variables = local_model.apply(
+                    {"params": params, "cache": cache},
+                    padded_suffix,
+                    decode=True,
+                    mutable=["cache"],
+                )
+                return _admit_tail(
+                    logits, variables, true_len, base_len + true_len,
+                    temp, topk, topp, seed, sampled, nucleus,
+                )
+
+            body = sharded(
+                body, (param_specs, cache_specs) + (P(),) * 7,
+                (P(), cache_specs),
             )
-            return _admit_tail(
-                logits, variables, true_len, base_len + true_len,
-                temp, topk, topp, seed, sampled, nucleus,
-            )
+            return body(params, cache, padded_suffix, base_len, true_len,
+                        temp, topk, topp, seed)
 
         def insert(big, one, row, true_len):
             # The b=1 tree shares the big tree's treedef — only the
@@ -270,7 +352,7 @@ class LMEngine:
             cache = _map_cache(
                 cache, lambda leaf: leaf, lambda idx: jnp.where(active, idx, 0)
             )
-            logits, variables = model.apply(
+            logits, variables = local_model.apply(
                 {"params": params, "cache": cache},
                 tokens[:, None],
                 decode=True,
@@ -283,15 +365,31 @@ class LMEngine:
         # Gumbel draw; the sampled program serves mixed batches (its
         # greedy rows selected inside _sample_rows).
         def step_greedy(params, cache, tokens, active):
-            last, cache = _step_logits(params, cache, tokens, active)
-            return jnp.argmax(last, axis=-1).astype(jnp.int32), cache
+            def body(params, cache, tokens, active):
+                last, cache2 = _step_logits(params, cache, tokens, active)
+                return jnp.argmax(last, axis=-1).astype(jnp.int32), cache2
+
+            body = sharded(
+                body, (param_specs, cache_specs, P(), P()),
+                (P(), cache_specs),
+            )
+            return body(params, cache, tokens, active)
 
         def step_sampled(params, cache, tokens, active, temps, topks, topps,
                          seeds, ns, nucleus=False):
-            last, cache = _step_logits(params, cache, tokens, active)
-            return _sample_rows(
-                last, temps, topks, topps, seeds, ns, use_top_p=nucleus
-            ), cache
+            def body(params, cache, tokens, active, temps, topks, topps,
+                     seeds, ns):
+                last, cache2 = _step_logits(params, cache, tokens, active)
+                return _sample_rows(
+                    last, temps, topks, topps, seeds, ns, use_top_p=nucleus
+                ), cache2
+
+            body = sharded(
+                body, (param_specs, cache_specs) + (P(),) * 7,
+                (P(), cache_specs),
+            )
+            return body(params, cache, tokens, active, temps, topks, topps,
+                        seeds, ns)
 
         # Horizon program: ``horizon`` decode steps in ONE dispatch via
         # lax.scan — the host-dispatch-latency amortization (measured
@@ -305,25 +403,35 @@ class LMEngine:
         def step_horizon(params, cache, tokens, live0, rems, eos_ids,
                          temps, topks, topps, seeds, ns, *, horizon, sampled,
                          nucleus=False):
-            def body(carry, _):
-                cache, tok, live, n, rem = carry
-                last, cache = _step_logits(params, cache, tok, live)
-                if sampled:
-                    nxt = _sample_rows(
-                        last, temps, topks, topps, seeds, n,
-                        use_top_p=nucleus,
-                    )
-                else:
-                    nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-                n2 = n + live.astype(jnp.int32)
-                rem2 = rem - live.astype(jnp.int32)
-                live2 = live & (rem2 > 0) & (nxt != eos_ids)
-                return (cache, nxt, live2, n2, rem2), (nxt, live)
+            def run(params, cache, tokens, live0, rems, eos_ids, temps,
+                    topks, topps, seeds, ns):
+                def body(carry, _):
+                    cache, tok, live, n, rem = carry
+                    last, cache = _step_logits(params, cache, tok, live)
+                    if sampled:
+                        nxt = _sample_rows(
+                            last, temps, topks, topps, seeds, n,
+                            use_top_p=nucleus,
+                        )
+                    else:
+                        nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                    n2 = n + live.astype(jnp.int32)
+                    rem2 = rem - live.astype(jnp.int32)
+                    live2 = live & (rem2 > 0) & (nxt != eos_ids)
+                    return (cache, nxt, live2, n2, rem2), (nxt, live)
 
-            (cache, _, _, _, _), (toks, lives) = jax.lax.scan(
-                body, (cache, tokens, live0, ns, rems), None, length=horizon
+                (cache2, _, _, _, _), (toks, lives) = jax.lax.scan(
+                    body, (cache, tokens, live0, ns, rems), None,
+                    length=horizon,
+                )
+                return toks, lives, cache2
+
+            run = sharded(
+                run, (param_specs, cache_specs) + (P(),) * 9,
+                (P(), P(), cache_specs),
             )
-            return toks, lives, cache
+            return run(params, cache, tokens, live0, rems, eos_ids, temps,
+                       topks, topps, seeds, ns)
 
         self._prefill = prefill
         self._append = append
@@ -453,8 +561,11 @@ class LMEngine:
         sampled = any(
             st is not None and st.temperature > 0 for st in self._slot_state
         )
+        # A greedy request's top_p is inert (argmax path): gating the
+        # static flag on temperature too avoids compiling a second,
+        # graph-identical program variant for it.
         nucleus = any(
-            st is not None and 0.0 < st.top_p < 1.0
+            st is not None and st.temperature > 0 and 0.0 < st.top_p < 1.0
             for st in self._slot_state
         )
         # _admit finishes exhausted/eos'd requests on the spot, so
@@ -598,7 +709,7 @@ class LMEngine:
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
                 jnp.float32(req.top_p), jnp.int32(req.seed),
                 sampled=req.temperature > 0,
-                nucleus=0.0 < req.top_p < 1.0,
+                nucleus=req.temperature > 0 and 0.0 < req.top_p < 1.0,
             )
             total_len = base_len + L
             self.prefix_hits += 1
@@ -611,7 +722,7 @@ class LMEngine:
                 jnp.float32(req.temperature), jnp.int32(req.top_k),
                 jnp.float32(req.top_p), jnp.int32(req.seed),
                 sampled=req.temperature > 0,
-                nucleus=0.0 < req.top_p < 1.0,
+                nucleus=req.temperature > 0 and 0.0 < req.top_p < 1.0,
             )
             total_len = L
         self._cache = self._insert(

@@ -19,27 +19,44 @@ import jax
 import jax.numpy as jnp
 
 
-def top_p_mask(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+def top_p_mask(
+    logits: jax.Array, top_p: jax.Array, sorted_desc: jax.Array | None = None
+) -> jax.Array:
     """Nucleus filter: ``-inf`` everywhere except the smallest
     descending-probability prefix whose cumulative mass reaches
     ``top_p``. ``logits`` (rows, vocab) should already be
     temperature-scaled/top-k-masked; ``top_p`` is a scalar or (rows,)
     vector — entries outside (0, 1) disable filtering for that row
     (used by the engine's per-request knob). Ties at the threshold
-    probability are kept."""
-    probs = jax.nn.softmax(logits, axis=-1)
-    srt = jnp.sort(probs, axis=-1)[..., ::-1]
-    cum = jnp.cumsum(srt, axis=-1)
+    probability are kept. A caller that already holds the rows sorted
+    descending (the engine's top-k path) passes them as
+    ``sorted_desc`` — same multiset as ``logits`` — to skip this
+    function's own O(V log V) sort.
+
+    The threshold is taken and compared in LOGIT space from the same
+    sorted array (softmax is monotone, so prob- and logit-thresholds
+    select identical sets). Comparing ``softmax(logits)`` against a
+    threshold drawn from ``softmax(sorted)`` would compare across two
+    differently-ordered normalizer sums, and a one-ulp mismatch can
+    put the argmax itself below its own threshold — an all-masked row
+    (observed: the engine emitting token 0 on alternate steps)."""
+    if sorted_desc is None:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs_desc = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs_desc, axis=-1)
     reached = cum >= jnp.asarray(top_p)[..., None]
     idx = jnp.argmax(reached, axis=-1)
-    thresh = jnp.take_along_axis(srt, idx[..., None], axis=-1)[..., 0]
+    thresh = jnp.take_along_axis(sorted_desc, idx[..., None], axis=-1)[..., 0]
     # Out-of-range rows disable filtering: p <= 0 would "reach" at the
-    # top token (thresh = max prob, nearly-greedy — wrong for a
-    # disable sentinel) and p > 1 never reaches (argmax of all-False
-    # is 0, same wrong thresh), so both zero the threshold instead.
+    # top token (a nearly-greedy threshold — wrong for a disable
+    # sentinel) and p > 1 never reaches (argmax of all-False is 0,
+    # same wrong threshold), so both drop the threshold to -inf
+    # (keeps every entry; already--inf entries stay -inf).
     enabled = (jnp.asarray(top_p) > 0.0) & (jnp.asarray(top_p) < 1.0)
-    thresh = jnp.where(enabled & jnp.any(reached, axis=-1), thresh, 0.0)
-    return jnp.where(probs < thresh[..., None], -jnp.inf, logits)
+    thresh = jnp.where(
+        enabled & jnp.any(reached, axis=-1), thresh, -jnp.inf
+    )
+    return jnp.where(logits < thresh[..., None], -jnp.inf, logits)
 
 
 @functools.partial(
@@ -107,11 +124,18 @@ def generate(
         if temperature == 0.0 or top_k == 1:
             return jnp.argmax(logits_row, axis=-1)
         logits_row = logits_row / max(temperature, 1e-6)
+        sorted_desc = None
         if top_k is not None:
-            kth = jnp.sort(logits_row, axis=-1)[:, -top_k][:, None]
+            srt = jnp.sort(logits_row, axis=-1)
+            kth = srt[:, -top_k][:, None]
             logits_row = jnp.where(logits_row < kth, -jnp.inf, logits_row)
+            # Same multiset as the masked row (>= kth keeps ties):
+            # hands top_p_mask its sort so it doesn't redo it.
+            sorted_desc = jnp.where(srt[:, ::-1] >= kth, srt[:, ::-1], -jnp.inf)
         if top_p is not None and top_p < 1.0:
-            logits_row = top_p_mask(logits_row, jnp.float32(top_p))
+            logits_row = top_p_mask(
+                logits_row, jnp.float32(top_p), sorted_desc=sorted_desc
+            )
         keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(row_ids)
         return jax.vmap(
             lambda kk, lr: jax.random.categorical(kk, lr, axis=-1)
