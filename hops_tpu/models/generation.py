@@ -59,6 +59,33 @@ def top_p_mask(
     return jnp.where(logits < thresh[..., None], -jnp.inf, logits)
 
 
+def _filter_logits(
+    logits_row: jax.Array,
+    temperature: float,
+    top_k: int | None,
+    top_p: float | None,
+) -> jax.Array:
+    """Temperature-scale then top-k/top-p-truncate ``(rows, vocab)``
+    logits — the one definition of the sampling filter chain, shared
+    by :func:`generate` and the speculative path (both models in
+    rejection sampling MUST filter identically or losslessness
+    breaks)."""
+    logits_row = logits_row / max(temperature, 1e-6)
+    sorted_desc = None
+    if top_k is not None:
+        srt = jnp.sort(logits_row, axis=-1)
+        kth = srt[:, -top_k][:, None]
+        logits_row = jnp.where(logits_row < kth, -jnp.inf, logits_row)
+        # Same multiset as the masked row (>= kth keeps ties): hands
+        # top_p_mask its sort so it doesn't redo it.
+        sorted_desc = jnp.where(srt[:, ::-1] >= kth, srt[:, ::-1], -jnp.inf)
+    if top_p is not None and top_p < 1.0:
+        logits_row = top_p_mask(
+            logits_row, jnp.float32(top_p), sorted_desc=sorted_desc
+        )
+    return logits_row
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -123,19 +150,7 @@ def generate(
     def sample(logits_row, key):
         if temperature == 0.0 or top_k == 1:
             return jnp.argmax(logits_row, axis=-1)
-        logits_row = logits_row / max(temperature, 1e-6)
-        sorted_desc = None
-        if top_k is not None:
-            srt = jnp.sort(logits_row, axis=-1)
-            kth = srt[:, -top_k][:, None]
-            logits_row = jnp.where(logits_row < kth, -jnp.inf, logits_row)
-            # Same multiset as the masked row (>= kth keeps ties):
-            # hands top_p_mask its sort so it doesn't redo it.
-            sorted_desc = jnp.where(srt[:, ::-1] >= kth, srt[:, ::-1], -jnp.inf)
-        if top_p is not None and top_p < 1.0:
-            logits_row = top_p_mask(
-                logits_row, jnp.float32(top_p), sorted_desc=sorted_desc
-            )
+        logits_row = _filter_logits(logits_row, temperature, top_k, top_p)
         keys = jax.vmap(lambda r: jax.random.fold_in(key, r))(row_ids)
         return jax.vmap(
             lambda kk, lr: jax.random.categorical(kk, lr, axis=-1)
@@ -198,7 +213,11 @@ def _rewind(cache: Any, valid: jax.Array) -> Any:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("model", "draft_model", "max_new_tokens", "k")
+    jax.jit,
+    static_argnames=(
+        "model", "draft_model", "max_new_tokens", "k", "temperature",
+        "top_k", "top_p",
+    ),
 )
 def generate_speculative(
     model: Any,
@@ -208,21 +227,40 @@ def generate_speculative(
     prompt: jax.Array,
     max_new_tokens: int = 32,
     k: int = 4,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    top_p: float | None = None,
+    rng: jax.Array | None = None,
 ) -> jax.Array:
-    """Greedy speculative decoding: ``draft_model`` proposes ``k - 1``
+    """Lossless speculative decoding: ``draft_model`` proposes ``k - 1``
     tokens autoregressively, ``model`` scores the whole chunk in ONE
-    warm-cache append (the ``decode_attention`` s>1 path), and the
-    longest matching prefix plus the target's own next token are
-    accepted — each target pass yields 1..k tokens while the output is
-    EXACTLY the target's greedy decoding
-    (tests/test_generation.py::test_speculative_matches_greedy).
+    warm-cache append (the ``decode_attention`` s>1 path), and each
+    target pass yields 1..k tokens.
+
+    ``temperature=0`` (default) is the greedy variant — accept the
+    longest prefix where the draft matches the target's argmax, plus
+    the target's own next token; output is EXACTLY the target's greedy
+    decoding (tests/test_generation.py::test_speculative_matches_greedy).
+
+    ``temperature>0`` is rejection-sampling speculation (Leviathan et
+    al.): the draft SAMPLES x_i ~ q_i from its filtered distribution,
+    the target accepts x_i with prob ``min(1, p_i(x_i)/q_i(x_i))``,
+    and the first rejected position resamples from the residual
+    ``norm(max(p - q, 0))`` — the output is distributed EXACTLY as
+    sampling from the target's filtered distribution, whatever the
+    draft proposes (the draft only controls speed). Both distributions
+    run the SAME filter chain (temperature/top_k/top_p —
+    ``_filter_logits``). ``rng`` is required; draws fold (row, absolute
+    position, purpose) into it, so output is batch-layout independent.
 
     TPU-shaped throughout: the accept count is data-dependent, so the
     loop is a ``lax.while_loop`` over static-shape state — both KV
     caches ride the carry, and a rejection "rollback" is one scalar
     index rewind per layer (stale slots stay in HBM, masked by the
     kernel). Acceptance is the minimum across batch rows (a scalar
-    cache index serves the whole batch). Both models must share the
+    cache index serves the whole batch; rows whose acceptance went
+    further simply re-emit their accepted token at the boundary, which
+    preserves the per-row output law). Both models must share the
     tokenizer/vocab; ``max_decode_len`` of each must cover the final
     length (+k slack for the target).
     """
@@ -231,6 +269,10 @@ def generate_speculative(
         raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     if k < 2:
         raise ValueError(f"speculation depth k must be >= 2, got {k}")
+    if temperature > 0 and rng is None:
+        raise ValueError("sampled speculative decoding requires rng")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     total = prompt_len + max_new_tokens
     if total + k > model.max_decode_len or total + k > draft_model.max_decode_len:
         raise ValueError(
@@ -272,6 +314,27 @@ def generate_speculative(
         nxt = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
         return (variables["cache"], nxt), nxt
 
+    def _emit_advance(out, n, drafts, bonus, a, t_cache, d_cache):
+        """Shared tail of both round variants — the advance invariant
+        exists once: write all k candidate slots (static shape;
+        positions past a+1 are garbage the next round overwrites),
+        splice the bonus at slot a, and rewind both caches so they
+        hold 0..pos+a-1 with the bonus as the not-yet-written token."""
+        pos = prompt_len + n
+        emitted = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), prompt.dtype)], axis=1
+        )
+        emitted = jax.lax.dynamic_update_slice(
+            emitted, bonus[:, None], (jnp.zeros((), jnp.int32), a)
+        )
+        out = jax.lax.dynamic_update_slice(
+            out, emitted, (jnp.zeros((), jnp.int32), pos)
+        )
+        return (
+            out, n + a + 1, bonus,
+            _rewind(t_cache, pos + a), _rewind(d_cache, pos + a),
+        )
+
     def round_(state):
         out, n, cur, t_cache, d_cache = state
         # 1) draft proposes d_1..d_{k-1}. The scan runs k steps: the
@@ -299,23 +362,108 @@ def generate_speculative(
         )
         a = jnp.min(a_rows).astype(jnp.int32)
         bonus = preds[:, a]
-        # 4) emit d_1..d_a then the bonus: write all k candidates
-        #    (static shape) — positions past a+1 are garbage that the
-        #    next round overwrites — then splice the bonus at a.
-        emitted = jnp.concatenate([drafts, jnp.zeros((b, 1), prompt.dtype)], axis=1)
-        emitted = jax.lax.dynamic_update_slice(
-            emitted, bonus[:, None], (jnp.zeros((), jnp.int32), a)
-        )
+        return _emit_advance(out, n, drafts, bonus, a, t_cache, d_cache)
+
+    def round_sampled(state):
+        out, n, cur, t_cache, d_cache = state
         pos = prompt_len + n
-        out = jax.lax.dynamic_update_slice(out, emitted, (jnp.zeros((), jnp.int32), pos))
-        # 5) advance: caches hold 0..pos+a-1 (rewind the target's k and
-        #    the draft's k-1 writes back to the accepted prefix).
-        t_cache = _rewind(t_cache, pos + a)
-        d_cache = _rewind(d_cache, pos + a)
-        return out, n + a + 1, bonus, t_cache, d_cache
+        rows = jnp.arange(b)
+
+        def fold3(purpose, row, t):
+            # Distinct streams for draft-draw / accept-u / residual-draw
+            # at every (row, absolute position): reproducible and
+            # batch-layout independent, like generate()'s keying.
+            key = jax.random.fold_in(rng, purpose)
+            key = jax.random.fold_in(key, row)
+            return jax.random.fold_in(key, t)
+
+        def draft_step_s(carry, _):
+            cache, tok, p_ = carry
+            logits, variables = draft_model.apply(
+                {"params": draft_params, "cache": cache},
+                tok[:, None],
+                decode=True,
+                mutable=["cache"],
+            )
+            q = jax.nn.softmax(
+                _filter_logits(
+                    logits[:, -1].astype(jnp.float32), temperature, top_k, top_p
+                ),
+                axis=-1,
+            )
+            keys = jax.vmap(lambda r: fold3(0, r, p_))(rows)
+            nxt = jax.vmap(
+                lambda kk, qq: jax.random.categorical(kk, jnp.log(qq))
+            )(keys, q).astype(prompt.dtype)
+            return (variables["cache"], nxt, p_ + 1), (nxt, q)
+
+        # 1) draft samples d_1..d_{k-1} from its filtered q (the k-th
+        #    step's proposal is discarded but its cache write is needed,
+        #    as in the greedy round).
+        (d_cache, _, _), (drafts_t, q_t) = jax.lax.scan(
+            draft_step_s, (d_cache, cur, pos), None, length=k
+        )
+        drafts = jnp.moveaxis(drafts_t, 0, 1)[:, : k - 1]  # (b, k-1)
+        q_probs = jnp.moveaxis(q_t, 0, 1)[:, : k - 1]  # (b, k-1, V)
+        # 2) target scores the chunk in one warm append; identical
+        #    filter chain, so acceptance is against the distribution
+        #    generate() itself would sample from.
+        chunk = jnp.concatenate([cur[:, None], drafts], axis=1)
+        logits, t_vars = model.apply(
+            {"params": params, "cache": t_cache}, chunk, decode=True,
+            mutable=["cache"],
+        )
+        t_cache = t_vars["cache"]
+        v = logits.shape[-1]
+        p_probs = jax.nn.softmax(
+            _filter_logits(
+                logits.reshape(b * k, v).astype(jnp.float32),
+                temperature, top_k, top_p,
+            ).reshape(b, k, v),
+            axis=-1,
+        )
+        # 3) accept d_{i+1} iff u * q_i(x_i) < p_i(x_i) — the
+        #    division-free form of u < min(1, p/q); a q=0 proposal
+        #    (undrawable) auto-rejects against p=0.
+        idx = drafts[..., None].astype(jnp.int32)
+        px = jnp.take_along_axis(p_probs[:, : k - 1], idx, axis=-1)[..., 0]
+        qx = jnp.take_along_axis(q_probs, idx, axis=-1)[..., 0]
+        us = jax.vmap(
+            lambda r: jax.vmap(
+                lambda i: jax.random.uniform(fold3(1, r, pos + i))
+            )(jnp.arange(k - 1))
+        )(rows)
+        accepts = us * qx < px  # (b, k-1)
+        acc_pad = jnp.concatenate([accepts, jnp.zeros((b, 1), bool)], axis=1)
+        a_rows = jnp.argmin(acc_pad, axis=1)  # first rejection (k-1 if none)
+        a = jnp.min(a_rows).astype(jnp.int32)
+        # 4) the slot-a token, per row: a row that ACCEPTED d_{a+1}
+        #    (its own rejection came later) re-emits it; a row that
+        #    rejected there resamples from the residual
+        #    norm(max(p - q, 0)). Padding q with zeros makes the
+        #    all-accepted bonus slot (a == k-1, no proposal) reduce to
+        #    sampling from p exactly.
+        p_a = jax.lax.dynamic_index_in_dim(p_probs, a, axis=1, keepdims=False)
+        q_pad = jnp.concatenate([q_probs, jnp.zeros((b, 1, v))], axis=1)
+        q_a = jax.lax.dynamic_index_in_dim(q_pad, a, axis=1, keepdims=False)
+        res = jnp.maximum(p_a - q_a, 0.0)
+        ssum = jnp.sum(res, axis=-1, keepdims=True)
+        res = jnp.where(ssum > 0, res / jnp.where(ssum > 0, ssum, 1.0), p_a)
+        rkeys = jax.vmap(lambda r: fold3(2, r, pos + a))(rows)
+        res_tok = jax.vmap(
+            lambda kk, rr: jax.random.categorical(kk, jnp.log(rr))
+        )(rkeys, res).astype(prompt.dtype)
+        drafts_pad = jnp.concatenate(
+            [drafts, jnp.zeros((b, 1), prompt.dtype)], axis=1
+        )
+        acc_at_a = jax.lax.dynamic_index_in_dim(acc_pad, a, axis=1, keepdims=False)
+        x_a = jax.lax.dynamic_index_in_dim(drafts_pad, a, axis=1, keepdims=False)
+        bonus = jnp.where(acc_at_a, x_a, res_tok)
+        return _emit_advance(out, n, drafts, bonus, a, t_cache, d_cache)
 
     def cond(state):
         return state[1] < max_new_tokens
 
-    out, n, _, _, _ = jax.lax.while_loop(cond, round_, (out, n0, cur, t_cache, d_cache))
+    body = round_sampled if temperature > 0 else round_
+    out, n, _, _, _ = jax.lax.while_loop(cond, body, (out, n0, cur, t_cache, d_cache))
     return out[:, :total]

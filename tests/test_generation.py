@@ -5,7 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from hops_tpu.models.generation import generate
+from hops_tpu.models.generation import generate, generate_speculative
 from hops_tpu.models.transformer import TransformerLM
 
 TINY = dict(
@@ -427,3 +427,83 @@ def test_windowed_moe_decode_matches_full_forward():
         want = model.apply({"params": params}, tokens)[:, -1]
         np.testing.assert_allclose(step_logits[:, 0], want, atol=2e-4, rtol=2e-4)
         tok = jnp.argmax(step_logits[:, -1:], axis=-1)
+
+
+def test_speculative_sampled_is_lossless():
+    """Rejection-sampling speculation must emit tokens distributed as
+    the TARGET's filtered distribution regardless of the draft: with a
+    deliberately different draft model, the empirical first-token
+    distribution over many independent rows matches the target's
+    filtered softmax (total-variation tolerance), and same-rng runs
+    reproduce exactly."""
+    kw = dict(vocab_size=16, d_model=32, num_heads=4, num_layers=2,
+              dtype=jnp.float32, attention_impl="reference",
+              max_decode_len=32)
+    target = TransformerLM(**kw)
+    draft = TransformerLM(**kw)
+    tp = target.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))["params"]
+    dp = draft.init(jax.random.PRNGKey(9), jnp.zeros((1, 4), jnp.int32))["params"]
+
+    b = 1024
+    prompt = jnp.tile(jnp.asarray([[3, 7, 1, 12]], jnp.int32), (b, 1))
+    temperature, top_k = 0.8, 8
+    out = generate_speculative(
+        target, tp, draft, dp, prompt, max_new_tokens=4, k=3,
+        temperature=temperature, top_k=top_k, rng=jax.random.PRNGKey(42),
+    )
+    assert out.shape == (b, 8)
+    first = np.asarray(out[:, 4])
+
+    # Target's filtered distribution at the first generated position.
+    from hops_tpu.models.generation import _filter_logits
+    logits = target.apply({"params": tp}, prompt[:1])[0, -1][None]
+    probs = np.asarray(
+        jax.nn.softmax(_filter_logits(logits, temperature, top_k, None))
+    )[0]
+    emp = np.bincount(first, minlength=16) / b
+    tv = 0.5 * np.abs(emp - probs).sum()
+    assert tv < 0.12, (tv, emp, probs)
+    # Filtered-out tokens (outside top-8) must never appear.
+    assert set(np.nonzero(emp)[0]) <= set(np.argsort(probs)[-8:]) | set(
+        np.nonzero(probs)[0]
+    )
+
+    again = generate_speculative(
+        target, tp, draft, dp, prompt, max_new_tokens=4, k=3,
+        temperature=temperature, top_k=top_k, rng=jax.random.PRNGKey(42),
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(again))
+    other = generate_speculative(
+        target, tp, draft, dp, prompt, max_new_tokens=4, k=3,
+        temperature=temperature, top_k=top_k, rng=jax.random.PRNGKey(43),
+    )
+    assert not np.array_equal(np.asarray(out), np.asarray(other))
+
+    with pytest.raises(ValueError, match="rng"):
+        generate_speculative(
+            target, tp, draft, dp, prompt[:2], max_new_tokens=2, k=2,
+            temperature=0.5,
+        )
+
+
+def test_speculative_sampled_perfect_draft_accepts_everything():
+    """draft == target: u < min(1, p/q) = 1 always accepts, so every
+    round advances k tokens — the while_loop runs ceil(new/k) rounds
+    and the output still reproduces by rng."""
+    kw = dict(vocab_size=32, d_model=32, num_heads=4, num_layers=2,
+              dtype=jnp.float32, attention_impl="reference",
+              max_decode_len=48)
+    lm = TransformerLM(**kw)
+    params = lm.init(jax.random.PRNGKey(1), jnp.zeros((1, 4), jnp.int32))["params"]
+    prompt = jnp.asarray(np.random.RandomState(5).randint(0, 32, (3, 5)), jnp.int32)
+    out = generate_speculative(
+        lm, params, lm, params, prompt, max_new_tokens=9, k=4,
+        temperature=1.0, rng=jax.random.PRNGKey(7),
+    )
+    assert out.shape == (3, 14)
+    assert (np.asarray(out[:, :5]) == np.asarray(prompt)).all()
+    again = generate_speculative(
+        lm, params, lm, params, prompt, max_new_tokens=9, k=4,
+        temperature=1.0, rng=jax.random.PRNGKey(7),
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(again))
