@@ -72,6 +72,17 @@ def _map_cache(cache: Any, fn_kv, fn_idx, *rest: Any) -> Any:
     return out
 
 
+def _get_idx(cache: Any) -> Any:
+    """The cache-index vector: every layer's idx leaf carries the same
+    value (transformer.py advances them in lockstep); return the
+    first."""
+    for path, leaf in jax.tree_util.tree_leaves_with_path(cache):
+        name = str(path[-1].key) if hasattr(path[-1], "key") else ""
+        if name == "idx":
+            return leaf
+    raise ValueError("cache has no 'idx' leaves")
+
+
 def _sample_rows(logits, temps, topks, topps, seeds, ns, use_top_p=False):
     """Per-row sampling over (rows, vocab) logits: ``temps[i] <= 0`` is
     greedy; ``topks[i] > 0`` keeps the top-k logits; ``0 < topps[i] <
@@ -168,6 +179,9 @@ class LMEngine:
         decode_horizon: int = 1,
         mesh: Any = None,
         tp_axis: str = "model",
+        draft_model: Any = None,
+        draft_params: Any = None,
+        spec_k: int = 4,
     ):
         if not getattr(model, "ragged_decode", False):
             raise ValueError(
@@ -181,6 +195,28 @@ class LMEngine:
         if decode_horizon < 1:
             raise ValueError(f"decode_horizon must be >= 1, got {decode_horizon}")
         self.decode_horizon = decode_horizon
+        # Speculative decoding (greedy): the draft proposes spec_k - 1
+        # tokens per dispatch and the target scores the chunk in one
+        # ragged warm append. Unlike generate_speculative's scalar-min
+        # acceptance, each SLOT accepts its own a_r tokens — the ragged
+        # (slots,) cache index is what makes per-row acceptance free.
+        self.draft_model = draft_model
+        self.draft_params = draft_params
+        self.spec_k = spec_k if draft_model is not None else 0
+        if draft_model is not None:
+            if spec_k < 2:
+                raise ValueError(f"spec_k must be >= 2, got {spec_k}")
+            if not getattr(draft_model, "ragged_decode", False):
+                raise ValueError("draft_model needs ragged_decode=True too")
+            if decode_horizon > 1:
+                raise ValueError(
+                    "speculation and decode_horizon both amortize "
+                    "dispatches — use one (spec_k xor decode_horizon)"
+                )
+            if mesh is not None:
+                raise NotImplementedError(
+                    "speculative decoding with mesh= is not implemented"
+                )
         # Tensor parallelism: every engine program runs inside a
         # shard_map over ``tp_axis`` — params and KV caches shard on
         # their head axes (parallel/tp_inference.py layout), scalars
@@ -226,6 +262,14 @@ class LMEngine:
         self._cache = _map_cache(
             variables["cache"], jnp.zeros_like, jnp.zeros_like
         )
+        self._draft_cache = None
+        if draft_model is not None:
+            _, dvariables = draft_model.apply(
+                {"params": draft_params}, dummy, decode=True, mutable=["cache"]
+            )
+            self._draft_cache = _map_cache(
+                dvariables["cache"], jnp.zeros_like, jnp.zeros_like
+            )
         if mesh is not None:
             # (slots, heads, ...) k/v/scale leaves shard on the head
             # dim; the (slots,) index replicates.
@@ -433,8 +477,100 @@ class LMEngine:
             return run(params, cache, tokens, live0, rems, eos_ids, temps,
                        topks, topps, seeds, ns)
 
+        def spec_prefill(params, dparams, padded_prompt, true_len):
+            # Greedy admission for a speculative engine: prefill BOTH
+            # caches on the prompt; the target's last true row gives
+            # the first token, both indices rewind to the true end.
+            logits, t_vars = model.apply(
+                {"params": params}, padded_prompt, decode=True,
+                mutable=["cache"],
+            )
+            _, d_vars = draft_model.apply(
+                {"params": dparams}, padded_prompt, decode=True,
+                mutable=["cache"],
+            )
+            zero = jnp.zeros((), jnp.float32)
+            first_tok, t_cache = _admit_tail(
+                logits, t_vars, true_len, true_len, zero,
+                jnp.int32(0), zero, jnp.int32(0),
+                sampled=False, nucleus=False,
+            )
+            d_cache = _map_cache(
+                d_vars["cache"], lambda leaf: leaf,
+                lambda idx: jnp.full_like(idx, true_len),
+            )
+            return first_tok, t_cache, d_cache
+
+        def spec_step(params, dparams, t_cache, d_cache, tokens, active):
+            # One speculative dispatch: the draft proposes spec_k - 1
+            # greedy tokens per slot, the target scores each slot's
+            # [token, proposals] chunk in ONE ragged warm append, and
+            # every row keeps its own longest matching prefix a_r plus
+            # the target prediction after it (bonus) — per-row
+            # acceptance, which generate_speculative's scalar cache
+            # index cannot do. Cache invariant: idx = written tokens
+            # (the newest emitted token is unwritten); the dispatch
+            # writes the current token plus the proposals, so both
+            # indices rewind to idx0 + 1 + a_r per row.
+            def clamp(c):
+                return _map_cache(
+                    c, lambda leaf: leaf,
+                    lambda idx: jnp.where(active, idx, 0),
+                )
+
+            t_cache, d_cache = clamp(t_cache), clamp(d_cache)
+            idx0 = _get_idx(t_cache)
+
+            def dstep(carry, _):
+                dc, tok = carry
+                logits, dv = draft_model.apply(
+                    {"params": dparams, "cache": dc}, tok[:, None],
+                    decode=True, mutable=["cache"],
+                )
+                nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                return (dv["cache"], nxt), nxt
+
+            # spec_k steps, spec_k - 1 proposals: the last step's
+            # proposal is discarded but its cache WRITE is load-bearing
+            # — on full acceptance the rewind keeps position
+            # idx0 + spec_k - 1, which only that step writes (same
+            # invariant as generate_speculative's draft scan).
+            (d_cache, _), drafts_t = jax.lax.scan(
+                dstep, (d_cache, tokens), None, length=spec_k
+            )
+            drafts = jnp.moveaxis(drafts_t, 0, 1)[:, : spec_k - 1]
+            chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            logits, t_vars = model.apply(
+                {"params": params, "cache": t_cache}, chunk, decode=True,
+                mutable=["cache"],
+            )
+            t_cache = t_vars["cache"]
+            preds = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            match = drafts == preds[:, : spec_k - 1]
+            a_rows = jnp.argmin(
+                jnp.concatenate([match, jnp.zeros((slots, 1), bool)], axis=1),
+                axis=1,
+            ).astype(jnp.int32)
+            bonus = jnp.take_along_axis(preds, a_rows[:, None], axis=1)[:, 0]
+            new_idx = jnp.where(active, idx0 + 1 + a_rows, 0)
+
+            def rewind(c):
+                return _map_cache(
+                    c, lambda leaf: leaf,
+                    lambda idx: new_idx.astype(idx.dtype),
+                )
+
+            return drafts, a_rows, bonus, rewind(t_cache), rewind(d_cache)
+
         self._prefill = prefill
         self._append = append
+        self._spec_prefill = (
+            jax.jit(spec_prefill) if draft_model is not None else None
+        )
+        self._spec_step = (
+            jax.jit(spec_step, donate_argnums=(2, 3))
+            if draft_model is not None else None
+        )
         self._insert = jax.jit(insert, donate_argnums=(0,))
         self._prefixes: dict[str, tuple[Any, int]] = {}
         self._step_greedy = jax.jit(step_greedy, donate_argnums=(1,))
@@ -451,6 +587,10 @@ class LMEngine:
         self.dispatches = 0
         self.tokens_emitted = 0
         self.prefix_hits = 0
+        # Speculation telemetry: accepted proposals / proposal slots
+        # offered is the acceptance rate (how good the draft is).
+        self.spec_accepted = 0
+        self.spec_offered = 0
 
     # --- public API -----------------------------------------------------
 
@@ -524,6 +664,27 @@ class LMEngine:
             raise ValueError("temperature must be >= 0")
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+        if self.spec_k:
+            if temperature > 0:
+                raise ValueError(
+                    "a speculative engine is greedy-only for now — "
+                    "submit with temperature=0 or build the engine "
+                    "without draft_model"
+                )
+            if prefix_id is not None:
+                raise NotImplementedError(
+                    "prefix caching on a speculative engine is not "
+                    "implemented (the draft would need its own prefix)"
+                )
+            cap2 = min(
+                self.model.max_decode_len, self.draft_model.max_decode_len
+            )
+            if total + self.spec_k > cap2:
+                raise ValueError(
+                    f"prompt {prompt.size} + {max_new_tokens} new tokens "
+                    f"(+{self.spec_k} speculation slack) exceeds "
+                    f"max_decode_len {cap2}"
+                )
         seed = int(seed) & 0x7FFFFFFF  # fold into int32 before it hits jit
         ticket = self._next_ticket
         self._next_ticket += 1
@@ -607,6 +768,34 @@ class LMEngine:
             self.tokens_emitted += 1
             if st.remaining == 0 or (st.eos_id is not None and tok == st.eos_id):
                 finished.append(self._finish(row))
+
+        if self.spec_k:
+            drafts, a_rows, bonus, self._cache, self._draft_cache = (
+                self._spec_step(
+                    self.params, self.draft_params, self._cache,
+                    self._draft_cache, tokens, active,
+                )
+            )
+            self.dispatches += 1
+            drafts = np.asarray(drafts)
+            a_rows, bonus = np.asarray(a_rows), np.asarray(bonus)
+            for row in range(self.slots):
+                if self._slot_state[row] is None:
+                    continue
+                self.spec_offered += self.spec_k - 1
+                self.spec_accepted += int(a_rows[row])
+                # Emit the accepted proposals then the bonus; account()
+                # may finish the slot mid-stream (budget or eos), after
+                # which the rest of this row's tokens are discarded —
+                # the over-advanced cache rows are garbage a future
+                # insert overwrites.
+                for tok in [int(t) for t in drafts[row, : a_rows[row]]] + [
+                    int(bonus[row])
+                ]:
+                    if self._slot_state[row] is None:
+                        break
+                    account(row, tok)
+            return finished
 
         if self.decode_horizon > 1:
             rems = jnp.asarray(
@@ -713,6 +902,23 @@ class LMEngine:
             )
             total_len = base_len + L
             self.prefix_hits += 1
+        elif self.spec_k:
+            # The padded prefill chunk must fit the SMALLER cache: the
+            # draft prefills the same bucket.
+            bucket = min(
+                self._bucket(L), self.model.max_decode_len,
+                self.draft_model.max_decode_len,
+            )
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :L] = req.prompt
+            first_tok, one_cache, one_draft = self._spec_prefill(
+                self.params, self.draft_params, jnp.asarray(padded),
+                jnp.int32(L),
+            )
+            self._draft_cache = self._insert(
+                self._draft_cache, one_draft, jnp.int32(row), jnp.int32(L)
+            )
+            total_len = L
         else:
             bucket = min(self._bucket(L), self.model.max_decode_len)
             padded = np.zeros((1, bucket), np.int32)

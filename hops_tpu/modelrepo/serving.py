@@ -162,6 +162,28 @@ class LMEnginePredictor:
         cfg = lm_config or {}
         bundle = pickle.loads((artifact_dir / "flax_model.pkl").read_bytes())
         module = bundle["module"].clone(ragged_decode=True)
+        draft_module = draft_params = None
+        if cfg.get("draft_model") and cfg.get("prefixes"):
+            # Reject at startup, not per request: register_prefix would
+            # succeed (target cache only) but every prefix_id request
+            # would then fail in submit().
+            raise NotImplementedError(
+                "prefixes are not supported with draft_model "
+                "(speculative serving is greedy, prefix-less for now)"
+            )
+        if cfg.get("draft_model"):
+            # Speculative serving: the draft is a second registry model
+            # ({"draft_model": name, "draft_version": int?, "spec_k": k}).
+            from hops_tpu.modelrepo import registry
+
+            meta = registry.get_model(
+                cfg["draft_model"], cfg.get("draft_version")
+            )
+            draft = pickle.loads(
+                (Path(meta["path"]) / "flax_model.pkl").read_bytes()
+            )
+            draft_module = draft["module"].clone(ragged_decode=True)
+            draft_params = draft["params"]
         self._engine = LMEngine(
             module,
             bundle["params"],
@@ -170,6 +192,9 @@ class LMEnginePredictor:
                 tuple(cfg["prefill_buckets"]) if "prefill_buckets" in cfg else None
             ),
             decode_horizon=int(cfg.get("decode_horizon", 1)),
+            draft_model=draft_module,
+            draft_params=draft_params,
+            spec_k=int(cfg.get("spec_k", 4)),
         )
         # Shared prompt prefixes (system prompts): prefilled once at
         # startup; instances opt in with {"prefix_id": name}.
@@ -479,10 +504,13 @@ def create_or_update(
     ``timeout_ms`` (default 5). ``model_server="LM"`` serves a saved
     TransformerLM with continuous batching (``lm_config`` knobs:
     ``slots``, ``prefill_buckets``, ``decode_horizon`` — device-side
-    steps per dispatch, amortizing host-dispatch latency — and
+    steps per dispatch, amortizing host-dispatch latency —
     ``prefixes``, a ``{name: token_ids}`` dict of shared prompt
-    prefixes prefilled once at startup); it does its own cross-request
-    scheduling, so it composes with ``batching_enabled=False`` only."""
+    prefixes prefilled once at startup, and
+    ``draft_model``/``draft_version``/``spec_k`` — a second registry
+    model proposing tokens for greedy speculative serving); it does
+    its own cross-request scheduling, so it composes with
+    ``batching_enabled=False`` only."""
     if model_server.upper() == LM and batching_enabled:
         raise ValueError(
             "model_server='LM' schedules requests itself (continuous "

@@ -615,3 +615,113 @@ def test_engine_tensor_parallel_matches_unsharded():
     assert workload(tp) == workload(dense)
     idx = np.asarray(tp._cache["block_0"]["attn"]["idx"])
     assert idx.shape == (2,)  # global view intact
+
+
+def test_engine_speculative_matches_generate():
+    """A speculative engine (draft model proposing per dispatch) must
+    emit exactly per-request greedy generate() — per-ROW acceptance:
+    slots advance by their own accepted counts, unlike
+    generate_speculative's batch-min."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    # A different draft (other init): plausible but imperfect proposals.
+    draft_params = _params(plain, seed=5)
+
+    rs = np.random.RandomState(31)
+    prompts = [rs.randint(1, 64, (n,)) for n in (3, 8, 5, 2, 6)]
+    budgets = [9, 4, 7, 1, 6]
+    engine = LMEngine(model, params, slots=2, prefill_buckets=(8, 16),
+                      draft_model=model, draft_params=draft_params,
+                      spec_k=3)
+    tickets = [
+        engine.submit(p, max_new_tokens=b) for p, b in zip(prompts, budgets)
+    ]
+    results = engine.run()
+    for p, b, t in zip(prompts, budgets, tickets):
+        ref = generate(
+            plain, params, jnp.asarray(p)[None], jax.random.PRNGKey(0),
+            max_new_tokens=b, temperature=0.0,
+        )
+        assert results[t] == list(np.asarray(ref[0, len(p):])), t
+    assert engine.spec_offered > 0
+
+
+def test_engine_speculative_perfect_draft_accepts_all_and_saves_dispatches():
+    """draft == target: every proposal accepted, so tokens/dispatch
+    approaches spec_k and the eos path still truncates exactly."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    rs = np.random.RandomState(32)
+    probe = rs.randint(1, 64, (5,))
+    roll = generate(plain, params, jnp.asarray(probe)[None],
+                    jax.random.PRNGKey(0), max_new_tokens=12, temperature=0.0)
+    gen = [int(x) for x in np.asarray(roll[0, 5:])]
+    eos = gen[4]
+    expect = gen[: gen.index(eos) + 1]
+
+    engine = LMEngine(model, params, slots=1, prefill_buckets=(8,),
+                      draft_model=model, draft_params=params, spec_k=4)
+    second = rs.randint(1, 64, (4,))
+    t0 = engine.submit(probe, max_new_tokens=12, eos_id=eos)
+    t1 = engine.submit(second, max_new_tokens=8)
+    results = engine.run()
+    assert results[t0] == expect
+    assert engine.spec_accepted == engine.spec_offered  # perfect draft
+    # 8 tokens for t1 in ceil(8/4)=2-3 dispatches, not 8.
+    assert engine.dispatches < 8
+    ref = generate(plain, params, jnp.asarray(second)[None],
+                   jax.random.PRNGKey(0), max_new_tokens=8, temperature=0.0)
+    assert results[t1] == list(np.asarray(ref[0, 4:]))
+
+
+def test_engine_speculative_validation():
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    with pytest.raises(ValueError, match="spec_k"):
+        LMEngine(model, params, draft_model=model, draft_params=params,
+                 spec_k=1)
+    with pytest.raises(ValueError, match="horizon"):
+        LMEngine(model, params, draft_model=model, draft_params=params,
+                 decode_horizon=4)
+    engine = LMEngine(model, params, slots=1, prefill_buckets=(8,),
+                      draft_model=model, draft_params=params, spec_k=4)
+    with pytest.raises(ValueError, match="greedy-only"):
+        engine.submit([1, 2], max_new_tokens=2, temperature=0.5)
+    with pytest.raises(NotImplementedError, match="prefix"):
+        engine.register_prefix("sys", [1, 2, 3])
+        engine.submit([4], max_new_tokens=2, prefix_id="sys")
+    with pytest.raises(ValueError, match="slack"):
+        engine.submit(list(range(1, 30)), max_new_tokens=32)
+
+
+@pytest.mark.slow
+def test_lm_server_speculative_over_http():
+    """lm_config draft_model/spec_k: speculative continuous batching
+    behind the REST contract, output exactly per-request generate."""
+    from hops_tpu.modelrepo import registry, serving
+
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    registry.save_flax(plain, params, "spec-lm", metrics={"loss": 1.0})
+    registry.save_flax(plain, _params(plain, seed=8), "spec-draft",
+                       metrics={"loss": 2.0})
+    serving.create_or_update(
+        "spec-lm", model_name="spec-lm", model_server="LM",
+        lm_config={"slots": 2, "prefill_buckets": [8],
+                   "draft_model": "spec-draft", "spec_k": 3},
+    )
+    serving.start("spec-lm")
+    try:
+        p = [5, 9, 2, 7]
+        resp = serving.make_inference_request(
+            "spec-lm", {"instances": [{"prompt": p, "max_new_tokens": 6}]}
+        )
+        ref = generate(plain, params, jnp.asarray(p)[None],
+                       jax.random.PRNGKey(0), max_new_tokens=6,
+                       temperature=0.0)
+        assert resp["predictions"][0] == list(np.asarray(ref[0, 4:]))
+    finally:
+        serving.stop("spec-lm")
