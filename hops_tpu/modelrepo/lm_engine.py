@@ -679,10 +679,13 @@ class LMEngine:
             cap2 = min(
                 self.model.max_decode_len, self.draft_model.max_decode_len
             )
-            if total + self.spec_k > cap2:
+            # Deepest write: the final dispatch enters with at most
+            # total - 2 written tokens (one emitted-but-unwritten, one
+            # of the budget still to come) and writes spec_k positions.
+            if total + self.spec_k - 2 > cap2:
                 raise ValueError(
                     f"prompt {prompt.size} + {max_new_tokens} new tokens "
-                    f"(+{self.spec_k} speculation slack) exceeds "
+                    f"(+{self.spec_k - 2} speculation slack) exceeds "
                     f"max_decode_len {cap2}"
                 )
         seed = int(seed) & 0x7FFFFFFF  # fold into int32 before it hits jit

@@ -176,11 +176,8 @@ class LMEnginePredictor:
             # ({"draft_model": name, "draft_version": int?, "spec_k": k}).
             from hops_tpu.modelrepo import registry
 
-            meta = registry.get_model(
+            draft = registry.load_flax(
                 cfg["draft_model"], cfg.get("draft_version")
-            )
-            draft = pickle.loads(
-                (Path(meta["path"]) / "flax_model.pkl").read_bytes()
             )
             draft_module = draft["module"].clone(ragged_decode=True)
             draft_params = draft["params"]

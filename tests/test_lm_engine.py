@@ -694,7 +694,25 @@ def test_engine_speculative_validation():
         engine.register_prefix("sys", [1, 2, 3])
         engine.submit([4], max_new_tokens=2, prefix_id="sys")
     with pytest.raises(ValueError, match="slack"):
-        engine.submit(list(range(1, 30)), max_new_tokens=32)
+        engine.submit(list(range(1, 30)), max_new_tokens=34)
+
+
+def test_engine_speculative_exact_capacity_boundary():
+    """The deepest speculative write is total + spec_k - 2: a request
+    at exactly that bound must be accepted AND decode correctly (the
+    write never leaves the cache)."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    engine = LMEngine(model, params, slots=1, prefill_buckets=(32,),
+                      draft_model=model, draft_params=_params(plain, seed=2),
+                      spec_k=4)
+    p = np.random.RandomState(41).randint(1, 64, (29,))
+    t = engine.submit(p, max_new_tokens=33)  # 29+33+4-2 == 64 exactly
+    results = engine.run()
+    ref = generate(plain, params, jnp.asarray(p)[None], jax.random.PRNGKey(0),
+                   max_new_tokens=33, temperature=0.0)
+    assert results[t] == list(np.asarray(ref[0, 29:]))
 
 
 @pytest.mark.slow

@@ -163,3 +163,46 @@ class TestResNetTPUForm:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
         for a, b in zip(jax.tree.leaves(s0), jax.tree.leaves(s1)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_train_state_composes_with_optax_recipes():
+    """The train-step factories accept any optax chain — clipping,
+    warmup-cosine, and MultiSteps gradient accumulation all compose
+    through create_train_state (k micro-steps == one applied update)."""
+    import optax
+
+    from hops_tpu.models import common
+    from hops_tpu.models.mnist import FFN
+
+    model = FFN(dtype=jnp.float32)
+    tx = optax.MultiSteps(
+        optax.chain(
+            optax.clip_by_global_norm(1.0),
+            optax.adamw(
+                optax.warmup_cosine_decay_schedule(
+                    5e-4, 1e-3, warmup_steps=2, decay_steps=10
+                )
+            ),
+        ),
+        every_k_schedule=2,
+    )
+    state = common.create_train_state(
+        model, jax.random.PRNGKey(0), (4, 28, 28, 1), optimizer=tx
+    )
+    step = jax.jit(common.make_train_step())
+    batch = {
+        "image": np.random.RandomState(0).randn(4, 28, 28, 1).astype(np.float32),
+        "label": np.random.RandomState(1).randint(0, 10, (4,)),
+    }
+    p0 = state.params["Dense_0"]["kernel"]
+    state, m1 = step(state, batch)
+    # First micro-step accumulates only: params unchanged.
+    np.testing.assert_array_equal(
+        np.asarray(state.params["Dense_0"]["kernel"]), np.asarray(p0)
+    )
+    state, m2 = step(state, batch)
+    # Second micro-step applies the accumulated update.
+    assert not np.array_equal(
+        np.asarray(state.params["Dense_0"]["kernel"]), np.asarray(p0)
+    )
+    assert np.isfinite(float(m2["loss"]))
