@@ -48,6 +48,11 @@ def main() -> None:
         "(amortizes host-dispatch latency; only used with --continuous)",
     )
     parser.add_argument(
+        "--spec-k", type=int, default=0,
+        help="speculative engine: a half-depth draft proposes spec_k-1 "
+        "tokens per dispatch (greedy; only with --continuous)",
+    )
+    parser.add_argument(
         "--valid-sweep", action="store_true",
         help="time raw decode_attention vs valid_len at fixed capacity: "
         "flat times mean capacity-proportional DMA, linear-in-valid times "
@@ -219,8 +224,23 @@ def _continuous_bench(args) -> None:
     # ONE engine across runs: its jitted programs are per-instance, so
     # a fresh engine would recompile and the timing would be compile,
     # not serving.
+    spec_kw = {}
+    if args.spec_k:
+        # Draft with half the layers: same vocab, plausible proposals,
+        # roughly half the per-step cost.
+        draft = TransformerLM(
+            **{**kw, "num_layers": max(1, args.layers // 2)},
+            ragged_decode=True,
+        )
+        spec_kw = dict(
+            draft_model=draft,
+            draft_params=draft.init(
+                jax.random.PRNGKey(1), jnp.zeros((1, 8), jnp.int32)
+            )["params"],
+            spec_k=args.spec_k,
+        )
     engine = LMEngine(model, params, slots=slots,
-                      decode_horizon=args.horizon)
+                      decode_horizon=args.horizon, **spec_kw)
 
     def run_engine():
         d0 = engine.dispatches
@@ -258,11 +278,15 @@ def _continuous_bench(args) -> None:
     run_static()
     t_stat = time.perf_counter() - t0
 
+    spec_note = (
+        f", acceptance {engine.spec_accepted / max(engine.spec_offered, 1):.2f}"
+        if args.spec_k else ""
+    )
     print(
         f"continuous batching ({len(requests)} ragged requests, "
         f"{slots} slots, {total_tokens} tokens):\n"
         f"  engine: {t_cont:.2f}s = {total_tokens / t_cont:7.0f} useful tokens/s "
-        f"({dispatches} decode dispatches)\n"
+        f"({dispatches} decode dispatches{spec_note})\n"
         f"  static: {t_stat:.2f}s = {total_tokens / t_stat:7.0f} useful tokens/s "
         f"({static_steps} padded steps, head-of-line + pad waste)\n"
         f"  speedup: {t_stat / t_cont:.2f}x"

@@ -83,6 +83,30 @@ def _get_idx(cache: Any) -> Any:
     raise ValueError("cache has no 'idx' leaves")
 
 
+def _filter_rows(logits, temps, topks, topps, use_top_p=False):
+    """The per-row sampling filter: temperature-scale, top-k-mask, and
+    (``use_top_p``, static) nucleus-mask (rows, vocab) logits.
+    ``temps[i] <= 0`` rows divide by 1e-6 — after softmax that is a
+    numerically exact one-hot at the argmax, which is what lets the
+    speculative rejection sampler treat greedy rows uniformly."""
+    v = logits.shape[-1]
+    logits = logits.astype(jnp.float32)
+    srt = jnp.sort(logits, axis=-1)  # ascending
+    k_eff = jnp.clip(jnp.where(topks > 0, topks, v), 1, v)
+    kth = jnp.take_along_axis(srt, (v - k_eff)[:, None], axis=-1)
+    masked = jnp.where(logits < kth, -jnp.inf, logits)
+    scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
+    if use_top_p:
+        # Reuse the ascending top-k sort: value-mask (ties kept, same
+        # multiset as `masked`) and temperature-scale it descending —
+        # top_p_mask then skips its own full-vocab sort.
+        srt_desc = srt[:, ::-1]
+        srt_desc = jnp.where(srt_desc >= kth, srt_desc, -jnp.inf)
+        srt_desc = srt_desc / jnp.maximum(temps, 1e-6)[:, None]
+        scaled = top_p_mask(scaled, topps, sorted_desc=srt_desc)
+    return scaled
+
+
 def _sample_rows(logits, temps, topks, topps, seeds, ns, use_top_p=False):
     """Per-row sampling over (rows, vocab) logits: ``temps[i] <= 0`` is
     greedy; ``topks[i] > 0`` keeps the top-k logits; ``0 < topps[i] <
@@ -97,22 +121,8 @@ def _sample_rows(logits, temps, topks, topps, seeds, ns, use_top_p=False):
     keys = jax.vmap(
         lambda sd, n: jax.random.fold_in(jax.random.PRNGKey(sd), n)
     )(seeds, ns)
-    v = logits.shape[-1]
-    logits = logits.astype(jnp.float32)
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    srt = jnp.sort(logits, axis=-1)  # ascending
-    k_eff = jnp.clip(jnp.where(topks > 0, topks, v), 1, v)
-    kth = jnp.take_along_axis(srt, (v - k_eff)[:, None], axis=-1)
-    masked = jnp.where(logits < kth, -jnp.inf, logits)
-    scaled = masked / jnp.maximum(temps, 1e-6)[:, None]
-    if use_top_p:
-        # Reuse the ascending top-k sort: value-mask (ties kept, same
-        # multiset as `masked`) and temperature-scale it descending —
-        # top_p_mask then skips its own full-vocab sort.
-        srt_desc = srt[:, ::-1]
-        srt_desc = jnp.where(srt_desc >= kth, srt_desc, -jnp.inf)
-        srt_desc = srt_desc / jnp.maximum(temps, 1e-6)[:, None]
-        scaled = top_p_mask(scaled, topps, sorted_desc=srt_desc)
+    greedy = jnp.argmax(logits.astype(jnp.float32), axis=-1).astype(jnp.int32)
+    scaled = _filter_rows(logits, temps, topks, topps, use_top_p)
     sampled = jax.vmap(jax.random.categorical)(keys, scaled).astype(jnp.int32)
     return jnp.where(temps <= 0.0, greedy, sampled)
 
@@ -477,10 +487,13 @@ class LMEngine:
             return run(params, cache, tokens, live0, rems, eos_ids, temps,
                        topks, topps, seeds, ns)
 
-        def spec_prefill(params, dparams, padded_prompt, true_len):
-            # Greedy admission for a speculative engine: prefill BOTH
-            # caches on the prompt; the target's last true row gives
-            # the first token, both indices rewind to the true end.
+        @functools.partial(jax.jit, static_argnames=("sampled", "nucleus"))
+        def spec_prefill(params, dparams, padded_prompt, true_len, temp,
+                         topk, topp, seed, sampled=False, nucleus=False):
+            # Admission for a speculative engine: prefill BOTH caches
+            # on the prompt; the target's last true row gives the
+            # first token (drawn per the request's sampling knobs),
+            # both indices rewind to the true end.
             logits, t_vars = model.apply(
                 {"params": params}, padded_prompt, decode=True,
                 mutable=["cache"],
@@ -489,11 +502,9 @@ class LMEngine:
                 {"params": dparams}, padded_prompt, decode=True,
                 mutable=["cache"],
             )
-            zero = jnp.zeros((), jnp.float32)
             first_tok, t_cache = _admit_tail(
-                logits, t_vars, true_len, true_len, zero,
-                jnp.int32(0), zero, jnp.int32(0),
-                sampled=False, nucleus=False,
+                logits, t_vars, true_len, true_len, temp, topk, topp, seed,
+                sampled=sampled, nucleus=nucleus,
             )
             d_cache = _map_cache(
                 d_vars["cache"], lambda leaf: leaf,
@@ -562,13 +573,132 @@ class LMEngine:
 
             return drafts, a_rows, bonus, rewind(t_cache), rewind(d_cache)
 
+        def spec_step_sampled(params, dparams, t_cache, d_cache, tokens,
+                              active, temps, topks, topps, seeds, ns,
+                              *, nucleus):
+            # Rejection-sampling speculation, PER ROW (the engine's
+            # advantage over generate_speculative's batch-min): draft
+            # samples proposals from its filtered q, target accepts
+            # with prob min(1, p/q) (division-free u*q < p), and each
+            # row's first rejected slot resamples from the residual
+            # norm(max(p - q, 0)) — q zero-padded at the all-accepted
+            # bonus slot, so that case reduces to sampling from p.
+            # Greedy rows flow through the SAME math: temp <= 0 rows'
+            # filtered distributions are exact one-hots, making
+            # acceptance "argmax match" and the residual "target
+            # argmax" — bit-identical to the greedy engine. Keys fold
+            # (purpose, request seed, generated-token index); indices
+            # of discarded proposals are reused next dispatch, which is
+            # sound because discarded draws never influenced output.
+            def clamp(c):
+                return _map_cache(
+                    c, lambda leaf: leaf,
+                    lambda idx: jnp.where(active, idx, 0),
+                )
+
+            t_cache, d_cache = clamp(t_cache), clamp(d_cache)
+            idx0 = _get_idx(t_cache)
+
+            def keys_for(purpose, n_idx):
+                return jax.vmap(
+                    lambda sd, n: jax.random.fold_in(
+                        jax.random.fold_in(jax.random.PRNGKey(sd), 7 + purpose),
+                        n,
+                    )
+                )(seeds, n_idx)
+
+            def dstep(carry, _):
+                dc, tok, n_idx = carry
+                logits, dv = draft_model.apply(
+                    {"params": dparams, "cache": dc}, tok[:, None],
+                    decode=True, mutable=["cache"],
+                )
+                scaled = _filter_rows(
+                    logits[:, -1], temps, topks, topps, nucleus
+                )
+                q = jax.nn.softmax(scaled, axis=-1)
+                nxt = jax.vmap(
+                    lambda kk, sc: jax.random.categorical(kk, sc)
+                )(keys_for(0, n_idx), scaled).astype(jnp.int32)
+                return (dv["cache"], nxt, n_idx + 1), (nxt, q)
+
+            # spec_k steps, spec_k - 1 proposals: the last step's cache
+            # write is load-bearing on full acceptance (see spec_step).
+            (d_cache, _, _), (drafts_t, q_t) = jax.lax.scan(
+                dstep, (d_cache, tokens, ns), None, length=spec_k
+            )
+            drafts = jnp.moveaxis(drafts_t, 0, 1)[:, : spec_k - 1]
+            q_probs = jnp.moveaxis(q_t, 0, 1)[:, : spec_k - 1]
+            chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
+            logits, t_vars = model.apply(
+                {"params": params, "cache": t_cache}, chunk, decode=True,
+                mutable=["cache"],
+            )
+            t_cache = t_vars["cache"]
+            v = logits.shape[-1]
+            rep = lambda x: jnp.repeat(x, spec_k)
+            p_probs = jax.nn.softmax(
+                _filter_rows(
+                    logits.reshape(slots * spec_k, v), rep(temps),
+                    rep(topks), rep(topps), nucleus,
+                ).reshape(slots, spec_k, v),
+                axis=-1,
+            )
+            tok_idx = drafts[..., None]
+            px = jnp.take_along_axis(p_probs[:, : spec_k - 1], tok_idx, -1)[..., 0]
+            qx = jnp.take_along_axis(q_probs, tok_idx, -1)[..., 0]
+            us = jnp.stack(
+                [
+                    jax.vmap(jax.random.uniform)(keys_for(1, ns + i))
+                    for i in range(spec_k - 1)
+                ],
+                axis=1,
+            )
+            accepts = us * qx < px
+            acc_pad = jnp.concatenate(
+                [accepts, jnp.zeros((slots, 1), bool)], axis=1
+            )
+            a_rows = jnp.argmin(acc_pad, axis=1).astype(jnp.int32)
+            # Per-row residual at each row's OWN first-rejected slot
+            # (acc_pad[r, a_r] is False by construction, so the bonus
+            # is always a residual/bonus-slot draw — never a re-emit).
+            gather = lambda x: jnp.take_along_axis(
+                x, a_rows[:, None, None], axis=1
+            )[:, 0]
+            p_a = gather(p_probs)
+            q_a = gather(
+                jnp.concatenate([q_probs, jnp.zeros((slots, 1, v))], axis=1)
+            )
+            res = jnp.maximum(p_a - q_a, 0.0)
+            ssum = jnp.sum(res, axis=-1, keepdims=True)
+            res = jnp.where(ssum > 0, res / jnp.where(ssum > 0, ssum, 1.0), p_a)
+            bonus = jax.vmap(
+                lambda kk, rr: jax.random.categorical(kk, jnp.log(rr))
+            )(keys_for(2, ns + a_rows), res).astype(jnp.int32)
+            new_idx = jnp.where(active, idx0 + 1 + a_rows, 0)
+
+            def rewind(c):
+                return _map_cache(
+                    c, lambda leaf: leaf,
+                    lambda idx: new_idx.astype(idx.dtype),
+                )
+
+            return drafts, a_rows, bonus, rewind(t_cache), rewind(d_cache)
+
         self._prefill = prefill
         self._append = append
         self._spec_prefill = (
-            jax.jit(spec_prefill) if draft_model is not None else None
+            spec_prefill if draft_model is not None else None
         )
         self._spec_step = (
             jax.jit(spec_step, donate_argnums=(2, 3))
+            if draft_model is not None else None
+        )
+        self._spec_step_sampled = (
+            jax.jit(
+                spec_step_sampled, donate_argnums=(2, 3),
+                static_argnames=("nucleus",),
+            )
             if draft_model is not None else None
         )
         self._insert = jax.jit(insert, donate_argnums=(0,))
@@ -665,12 +795,6 @@ class LMEngine:
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if self.spec_k:
-            if temperature > 0:
-                raise ValueError(
-                    "a speculative engine is greedy-only for now — "
-                    "submit with temperature=0 or build the engine "
-                    "without draft_model"
-                )
             if prefix_id is not None:
                 raise NotImplementedError(
                     "prefix caching on a speculative engine is not "
@@ -773,12 +897,21 @@ class LMEngine:
                 finished.append(self._finish(row))
 
         if self.spec_k:
-            drafts, a_rows, bonus, self._cache, self._draft_cache = (
-                self._spec_step(
-                    self.params, self.draft_params, self._cache,
-                    self._draft_cache, tokens, active,
+            if sampled:
+                drafts, a_rows, bonus, self._cache, self._draft_cache = (
+                    self._spec_step_sampled(
+                        self.params, self.draft_params, self._cache,
+                        self._draft_cache, tokens, active,
+                        *sampling_vectors(), nucleus=nucleus,
+                    )
                 )
-            )
+            else:
+                drafts, a_rows, bonus, self._cache, self._draft_cache = (
+                    self._spec_step(
+                        self.params, self.draft_params, self._cache,
+                        self._draft_cache, tokens, active,
+                    )
+                )
             self.dispatches += 1
             drafts = np.asarray(drafts)
             a_rows, bonus = np.asarray(a_rows), np.asarray(bonus)
@@ -917,6 +1050,10 @@ class LMEngine:
             first_tok, one_cache, one_draft = self._spec_prefill(
                 self.params, self.draft_params, jnp.asarray(padded),
                 jnp.int32(L),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.float32(req.top_p), jnp.int32(req.seed),
+                sampled=req.temperature > 0,
+                nucleus=req.temperature > 0 and 0.0 < req.top_p < 1.0,
             )
             self._draft_cache = self._insert(
                 self._draft_cache, one_draft, jnp.int32(row), jnp.int32(L)

@@ -1,7 +1,8 @@
 """Relay-recovery watcher: probe periodically, then run queued hardware
 measurements exactly once.
 
-The queue is the decode-horizon continuous-batching A/B (the rest of the
+The queue: the decode-horizon continuous-batching A/B, the speculative
+engine A/B, and the post-fix int8 decode re-run (the rest of the
 round-4 agenda was banked by ``hw_measure.py`` — `HW_MEASURE.jsonl`).
 Measurements run with NO timeout and are never killed: a SIGTERM'd
 client is what wedges the single-tenant relay in the first place
@@ -30,6 +31,11 @@ STEPS: list[tuple[str, list[str]]] = [
     ("decode_continuous_h8", [sys.executable, "examples/decode_bench.py",
                               "--continuous", "--batch", "4", "--tokens", "32",
                               "--layers", "4", "--horizon", "8"]),
+    ("decode_continuous_spec", [sys.executable, "examples/decode_bench.py",
+                                "--continuous", "--batch", "4", "--tokens", "32",
+                                "--layers", "4", "--spec-k", "4"]),
+    ("int8_rerun", [sys.executable, "examples/decode_bench.py",
+                    "--kv-dtype", "int8"]),
 ]
 
 

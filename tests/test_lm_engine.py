@@ -688,8 +688,6 @@ def test_engine_speculative_validation():
                  decode_horizon=4)
     engine = LMEngine(model, params, slots=1, prefill_buckets=(8,),
                       draft_model=model, draft_params=params, spec_k=4)
-    with pytest.raises(ValueError, match="greedy-only"):
-        engine.submit([1, 2], max_new_tokens=2, temperature=0.5)
     with pytest.raises(NotImplementedError, match="prefix"):
         engine.register_prefix("sys", [1, 2, 3])
         engine.submit([4], max_new_tokens=2, prefix_id="sys")
@@ -743,3 +741,68 @@ def test_lm_server_speculative_over_http():
         assert resp["predictions"][0] == list(np.asarray(ref[0, 4:]))
     finally:
         serving.stop("spec-lm")
+
+
+def test_engine_speculative_mixed_sampling_keeps_greedy_exact():
+    """A speculative engine serving greedy and sampled requests in the
+    SAME batch: greedy rows flow through the rejection math as exact
+    one-hots, so their output stays bit-identical to generate()."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    engine = LMEngine(model, params, slots=2, prefill_buckets=(8,),
+                      draft_model=model, draft_params=_params(plain, seed=3),
+                      spec_k=3)
+    rs = np.random.RandomState(51)
+    pg, ps = rs.randint(1, 64, (5,)), rs.randint(1, 64, (4,))
+    tg = engine.submit(pg, max_new_tokens=8)
+    t1 = engine.submit(ps, max_new_tokens=6, temperature=0.9, top_p=0.9,
+                       seed=11)
+    t2 = engine.submit(ps, max_new_tokens=6, temperature=0.9, top_p=0.9,
+                       seed=11)
+    r = engine.run()
+    ref = generate(plain, params, jnp.asarray(pg)[None], jax.random.PRNGKey(0),
+                   max_new_tokens=8, temperature=0.0)
+    assert r[tg] == list(np.asarray(ref[0, 5:]))
+    assert r[t1] == r[t2]  # same seed reproduces through speculation
+    assert all(0 <= t < 64 for t in r[t1])
+
+
+def test_engine_speculative_sampled_is_lossless():
+    """Rejection-sampling speculation in the engine: conditioned on the
+    first generated token, the second token's empirical law over many
+    independent requests matches the target's filtered softmax
+    (total-variation tolerance) despite a mismatched draft."""
+    kw = dict(vocab_size=16, d_model=32, num_heads=4, num_layers=2,
+              dtype=jnp.float32, attention_impl="reference",
+              max_decode_len=16)
+    model = TransformerLM(**kw, ragged_decode=True)
+    plain = TransformerLM(**kw)
+    params = _params(plain)
+    engine = LMEngine(model, params, slots=8, prefill_buckets=(8,),
+                      draft_model=model, draft_params=_params(plain, seed=9),
+                      spec_k=3)
+    prompt = [3, 7, 1, 12]
+    n = 384
+    tickets = [
+        engine.submit(prompt, max_new_tokens=2, temperature=0.8, top_k=8,
+                      seed=1000 + i)
+        for i in range(n)
+    ]
+    results = engine.run()
+    pairs = [tuple(results[t]) for t in tickets]
+    # Condition on the modal first token and test the second's law.
+    firsts = [a for a, _ in pairs]
+    modal = max(set(firsts), key=firsts.count)
+    seconds = np.asarray([b for a, b in pairs if a == modal])
+    assert seconds.size >= 60, seconds.size
+
+    from hops_tpu.models.generation import _filter_logits
+    ctx = jnp.asarray(prompt + [modal], jnp.int32)[None]
+    logits = plain.apply({"params": params}, ctx)[0, -1][None]
+    probs = np.asarray(
+        jax.nn.softmax(_filter_logits(logits, 0.8, 8, None))
+    )[0]
+    emp = np.bincount(seconds, minlength=16) / seconds.size
+    tv = 0.5 * np.abs(emp - probs).sum()
+    assert tv < 0.22, (tv, seconds.size)
