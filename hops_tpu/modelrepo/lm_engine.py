@@ -72,6 +72,23 @@ def _map_cache(cache: Any, fn_kv, fn_idx, *rest: Any) -> Any:
     return out
 
 
+def _clamp_idx(cache: Any, active: Any) -> Any:
+    """Clamp inactive rows' cache index to 0 (the free-slot
+    convention): a free row writes one position, attends one block,
+    and its output is discarded host-side."""
+    return _map_cache(
+        cache, lambda leaf: leaf, lambda idx: jnp.where(active, idx, 0)
+    )
+
+
+def _rewind_idx(cache: Any, new_idx: Any) -> Any:
+    """Set every layer's cache index to ``new_idx`` (per-row)."""
+    return _map_cache(
+        cache, lambda leaf: leaf,
+        lambda idx: jnp.asarray(new_idx, idx.dtype),
+    )
+
+
 def _get_idx(cache: Any) -> Any:
     """The cache-index vector: every layer's idx leaf carries the same
     value (transformer.py advances them in lockstep); return the
@@ -86,9 +103,10 @@ def _get_idx(cache: Any) -> Any:
 def _filter_rows(logits, temps, topks, topps, use_top_p=False):
     """The per-row sampling filter: temperature-scale, top-k-mask, and
     (``use_top_p``, static) nucleus-mask (rows, vocab) logits.
-    ``temps[i] <= 0`` rows divide by 1e-6 — after softmax that is a
-    numerically exact one-hot at the argmax, which is what lets the
-    speculative rejection sampler treat greedy rows uniformly."""
+    ``temps[i] <= 0`` rows divide by 1e-6 (a near-one-hot after
+    softmax); paths with an exactness contract for greedy rows — the
+    speculative rejection sampler, `_sample_rows`'s output — override
+    those rows with exact argmax/one-hots rather than rely on it."""
     v = logits.shape[-1]
     logits = logits.astype(jnp.float32)
     srt = jnp.sort(logits, axis=-1)  # ascending
@@ -403,9 +421,7 @@ class LMEngine:
             # whole stale cache each dispatch) and then grow without
             # bound. Clamped, a free row writes one position at offset
             # 0 and attends one block — actually "noise".
-            cache = _map_cache(
-                cache, lambda leaf: leaf, lambda idx: jnp.where(active, idx, 0)
-            )
+            cache = _clamp_idx(cache, active)
             logits, variables = local_model.apply(
                 {"params": params, "cache": cache},
                 tokens[:, None],
@@ -523,13 +539,7 @@ class LMEngine:
             # (the newest emitted token is unwritten); the dispatch
             # writes the current token plus the proposals, so both
             # indices rewind to idx0 + 1 + a_r per row.
-            def clamp(c):
-                return _map_cache(
-                    c, lambda leaf: leaf,
-                    lambda idx: jnp.where(active, idx, 0),
-                )
-
-            t_cache, d_cache = clamp(t_cache), clamp(d_cache)
+            t_cache, d_cache = _clamp_idx(t_cache, active), _clamp_idx(d_cache, active)
             idx0 = _get_idx(t_cache)
 
             def dstep(carry, _):
@@ -564,14 +574,8 @@ class LMEngine:
             ).astype(jnp.int32)
             bonus = jnp.take_along_axis(preds, a_rows[:, None], axis=1)[:, 0]
             new_idx = jnp.where(active, idx0 + 1 + a_rows, 0)
-
-            def rewind(c):
-                return _map_cache(
-                    c, lambda leaf: leaf,
-                    lambda idx: new_idx.astype(idx.dtype),
-                )
-
-            return drafts, a_rows, bonus, rewind(t_cache), rewind(d_cache)
+            return (drafts, a_rows, bonus,
+                    _rewind_idx(t_cache, new_idx), _rewind_idx(d_cache, new_idx))
 
         def spec_step_sampled(params, dparams, t_cache, d_cache, tokens,
                               active, temps, topks, topps, seeds, ns,
@@ -590,13 +594,7 @@ class LMEngine:
             # (purpose, request seed, generated-token index); indices
             # of discarded proposals are reused next dispatch, which is
             # sound because discarded draws never influenced output.
-            def clamp(c):
-                return _map_cache(
-                    c, lambda leaf: leaf,
-                    lambda idx: jnp.where(active, idx, 0),
-                )
-
-            t_cache, d_cache = clamp(t_cache), clamp(d_cache)
+            t_cache, d_cache = _clamp_idx(t_cache, active), _clamp_idx(d_cache, active)
             idx0 = _get_idx(t_cache)
 
             def keys_for(purpose, n_idx):
@@ -613,13 +611,28 @@ class LMEngine:
                     {"params": dparams, "cache": dc}, tok[:, None],
                     decode=True, mutable=["cache"],
                 )
-                scaled = _filter_rows(
-                    logits[:, -1], temps, topks, topps, nucleus
+                last = logits[:, -1].astype(jnp.float32)
+                scaled = _filter_rows(last, temps, topks, topps, nucleus)
+                # Greedy rows get EXACT one-hots, not softmax(x/1e-6):
+                # with near-tied logits the quasi-one-hot could accept
+                # a mismatched draft token (or split an exact tie),
+                # breaking the bit-identical-to-generate contract.
+                onehot = jax.nn.one_hot(
+                    jnp.argmax(last, axis=-1), last.shape[-1]
                 )
-                q = jax.nn.softmax(scaled, axis=-1)
-                nxt = jax.vmap(
+                q = jnp.where(
+                    (temps <= 0.0)[:, None],
+                    onehot,
+                    jax.nn.softmax(scaled, axis=-1),
+                )
+                drawn = jax.vmap(
                     lambda kk, sc: jax.random.categorical(kk, sc)
                 )(keys_for(0, n_idx), scaled).astype(jnp.int32)
+                nxt = jnp.where(
+                    temps <= 0.0,
+                    jnp.argmax(last, axis=-1).astype(jnp.int32),
+                    drawn,
+                )
                 return (dv["cache"], nxt, n_idx + 1), (nxt, q)
 
             # spec_k steps, spec_k - 1 proposals: the last step's cache
@@ -643,6 +656,16 @@ class LMEngine:
                     rep(topks), rep(topps), nucleus,
                 ).reshape(slots, spec_k, v),
                 axis=-1,
+            )
+            # Greedy rows: exact one-hot targets (see dstep comment) —
+            # acceptance degenerates to exact argmax match and the
+            # residual to the target argmax, bit-identical to the
+            # greedy program.
+            p_onehot = jax.nn.one_hot(
+                jnp.argmax(logits.astype(jnp.float32), axis=-1), v
+            )
+            p_probs = jnp.where(
+                (temps <= 0.0)[:, None, None], p_onehot, p_probs
             )
             tok_idx = drafts[..., None]
             px = jnp.take_along_axis(p_probs[:, : spec_k - 1], tok_idx, -1)[..., 0]
@@ -672,18 +695,19 @@ class LMEngine:
             res = jnp.maximum(p_a - q_a, 0.0)
             ssum = jnp.sum(res, axis=-1, keepdims=True)
             res = jnp.where(ssum > 0, res / jnp.where(ssum > 0, ssum, 1.0), p_a)
-            bonus = jax.vmap(
+            drawn_bonus = jax.vmap(
                 lambda kk, rr: jax.random.categorical(kk, jnp.log(rr))
             )(keys_for(2, ns + a_rows), res).astype(jnp.int32)
+            # Greedy rows' residual is an exact one-hot: take its
+            # argmax outright rather than a categorical over log(0)s.
+            bonus = jnp.where(
+                temps <= 0.0,
+                jnp.argmax(res, axis=-1).astype(jnp.int32),
+                drawn_bonus,
+            )
             new_idx = jnp.where(active, idx0 + 1 + a_rows, 0)
-
-            def rewind(c):
-                return _map_cache(
-                    c, lambda leaf: leaf,
-                    lambda idx: new_idx.astype(idx.dtype),
-                )
-
-            return drafts, a_rows, bonus, rewind(t_cache), rewind(d_cache)
+            return (drafts, a_rows, bonus,
+                    _rewind_idx(t_cache, new_idx), _rewind_idx(d_cache, new_idx))
 
         self._prefill = prefill
         self._append = append

@@ -136,6 +136,10 @@ def test_continuous_batching_example():
     result = continuous_batching.main()
     assert result["parity"] == result["requests"] == 6
     assert result["dispatches"] < result["naive_dispatches"]
+    # The speculative engine preserves greedy output exactly, whatever
+    # its (here: random-draft) acceptance rate.
+    assert result["spec_parity"] == 6
+    assert result["spec_dispatches"] <= result["dispatches"]
 
 
 def test_preemptible_training_example():
