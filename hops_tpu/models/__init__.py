@@ -7,4 +7,12 @@ SURVEY.md §6). Here they are proper flax modules with bfloat16 compute
 on the MXU and shared train-step factories.
 """
 
-from hops_tpu.models import common, mnist, moe, resnet, transformer, widedeep  # noqa: F401
+from hops_tpu.models import (  # noqa: F401
+    common,
+    generation,
+    mnist,
+    moe,
+    resnet,
+    transformer,
+    widedeep,
+)

@@ -237,13 +237,13 @@ class TestTPInference:
         model, params, prompt = self._setup()
         rng = jax.random.PRNGKey(5)
         ref = generate(model, params, prompt, rng, max_new_tokens=6,
-                       temperature=0.7, top_k=8)
+                       temperature=0.7, top_k=8, top_p=0.9)
         mesh = mesh_lib.make_mesh(
             {"data": 2, "model": 2}, devices=jax.devices()[:4]
         )
         out = tp_generate(model, params, prompt, rng, mesh,
                           batch_axis="data", max_new_tokens=6,
-                          temperature=0.7, top_k=8)
+                          temperature=0.7, top_k=8, top_p=0.9)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
 
     def test_tp_rejects_moe(self):
