@@ -231,6 +231,7 @@ def generate_speculative(
     top_k: int | None = None,
     top_p: float | None = None,
     rng: jax.Array | None = None,
+    row_offset: jax.Array | int = 0,
 ) -> jax.Array:
     """Lossless speculative decoding: ``draft_model`` proposes ``k - 1``
     tokens autoregressively, ``model`` scores the whole chunk in ONE
@@ -367,7 +368,7 @@ def generate_speculative(
     def round_sampled(state):
         out, n, cur, t_cache, d_cache = state
         pos = prompt_len + n
-        rows = jnp.arange(b)
+        rows = row_offset + jnp.arange(b)  # global ids: dp-shard safe
 
         def fold3(purpose, row, t):
             # Distinct streams for draft-draw / accept-u / residual-draw

@@ -10,6 +10,7 @@ and DCN across slices.
 from hops_tpu.parallel import mesh, multihost, strategy  # noqa: F401
 from hops_tpu.parallel.tp_inference import (  # noqa: F401
     tp_generate,
+    tp_generate_speculative,
     tp_param_specs,
 )
 from hops_tpu.parallel.strategy import (  # noqa: F401

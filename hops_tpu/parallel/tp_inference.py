@@ -95,35 +95,108 @@ def tp_generate(
     return fn(params, prompt, rng)
 
 
+def tp_generate_speculative(
+    model: Any,
+    params: Any,
+    draft_model: Any,
+    draft_params: Any,
+    prompt: jax.Array,
+    mesh: Mesh,
+    tp_axis: str = "model",
+    batch_axis: str | None = None,
+    **spec_kwargs: Any,
+) -> jax.Array:
+    """:func:`hops_tpu.models.generation.generate_speculative` over a
+    tensor-parallel mesh: BOTH checkpoints slice in place
+    (``tp_param_specs``) and both models' whole propose/score/accept
+    loop runs inside one shard_map. Greedy output matches the
+    single-device call (up to argmax flips at exact float ties —
+    tp psums sum in a different order). Sampled runs are deterministic
+    and keyed by global row ids, but acceptance compares ``u*q < p``
+    on those reduction-order-sensitive logits, so cross-layout
+    agreement is distributional (lossless wrt the tp-computed target),
+    not bitwise. The draft's ``num_heads`` (and ``num_kv_heads``) must
+    divide the tp degree too."""
+    if spec_kwargs.get("temperature", 0.0) > 0 and "rng" not in spec_kwargs:
+        # Mirror generate_speculative's validation here: inside the
+        # traced wrapper rng is never None, so its own guard can't fire
+        # — silently substituting a fixed key would make every
+        # "random" call identical.
+        raise ValueError("sampled speculative decoding requires rng")
+    # rng is an ARRAY: it rides as a traced argument, not a cache key.
+    rng = spec_kwargs.pop("rng", None)
+    fn = _compiled(
+        model, mesh, tp_axis, batch_axis,
+        tuple(sorted(spec_kwargs.items())), draft_model=draft_model,
+    )
+    return fn(
+        params, draft_params, prompt,
+        jax.random.PRNGKey(0) if rng is None else rng,
+    )
+
+
 @functools.lru_cache(maxsize=64)
-def _compiled(model, mesh, tp_axis, batch_axis, kw_items):
-    """The jitted shard_mapped generate loop, cached on everything
+def _compiled(model, mesh, tp_axis, batch_axis, kw_items, draft_model=None):
+    """The jitted shard_mapped decode loop (plain generate, or
+    speculative when ``draft_model`` is given), cached on everything
     static — a per-call ``jax.jit(closure)`` would be a fresh callable
     every time and re-trace/recompile the whole decode program on
     every request batch."""
-    from hops_tpu.models.generation import generate
+    from hops_tpu.models.generation import generate, generate_speculative
 
-    generate_kwargs = dict(kw_items)
-    local = model.clone(tp_axis=tp_axis, tp_shards=mesh.shape[tp_axis])
+    kwargs = dict(kw_items)
+    if "row_offset" in kwargs:
+        raise ValueError(
+            "the tp wrapper owns row_offset (it derives it from the "
+            "dp shard index) — shard the batch via batch_axis instead"
+        )
+    shards = mesh.shape[tp_axis]
+    local = model.clone(tp_axis=tp_axis, tp_shards=shards)
+    dlocal = (
+        draft_model.clone(tp_axis=tp_axis, tp_shards=shards)
+        if draft_model is not None else None
+    )
     data_spec = P(batch_axis) if batch_axis else P()
 
-    def run(p, prompt, rng):
-        # Global row id of this shard's row 0, so sampled rollouts are
-        # bit-identical to the unsharded call (generate folds global
-        # row ids into its per-row sampling keys).
-        row_offset = (
-            jax.lax.axis_index(batch_axis) * prompt.shape[0]
-            if batch_axis else 0
-        )
-        return generate(
-            local, p, prompt, rng, row_offset=row_offset, **generate_kwargs
-        )
+    def offset(prompt):
+        # Global row id of this shard's row 0, so sampled rollouts key
+        # their draws identically to the unsharded call.
+        if not batch_axis:
+            return 0
+        return jax.lax.axis_index(batch_axis) * prompt.shape[0]
 
-    def mapped(params, prompt, rng):
-        specs = tp_param_specs(params, tp_axis)
-        return shard_map(
-            run, mesh=mesh, in_specs=(specs, data_spec, P()),
-            out_specs=data_spec, check_rep=False,
-        )(params, prompt, rng)
+    if draft_model is None:
+
+        def run(p, prompt, rng):
+            return generate(
+                local, p, prompt, rng, row_offset=offset(prompt), **kwargs
+            )
+
+        def mapped(params, prompt, rng):
+            return shard_map(
+                run, mesh=mesh,
+                in_specs=(tp_param_specs(params, tp_axis), data_spec, P()),
+                out_specs=data_spec, check_rep=False,
+            )(params, prompt, rng)
+
+    else:
+
+        def run(p, dp, prompt, rng):
+            return generate_speculative(
+                local, p, dlocal, dp, prompt, rng=rng,
+                row_offset=offset(prompt), **kwargs,
+            )
+
+        def mapped(params, draft_params, prompt, rng):
+            return shard_map(
+                run, mesh=mesh,
+                in_specs=(
+                    tp_param_specs(params, tp_axis),
+                    tp_param_specs(draft_params, tp_axis),
+                    data_spec,
+                    P(),
+                ),
+                out_specs=data_spec, check_rep=False,
+            )(params, draft_params, prompt, rng)
 
     return jax.jit(mapped)
