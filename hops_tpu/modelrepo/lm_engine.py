@@ -1025,6 +1025,29 @@ class LMEngine:
                 return True
         return False
 
+    def stats(self) -> dict[str, Any]:
+        """Serving-telemetry snapshot: dispatch counts, occupancy,
+        prefix-cache hits, and speculation acceptance — surfaced over
+        HTTP by ``GET /v1/models/<name>`` (serving.py)."""
+        out = {
+            "dispatches": self.dispatches,
+            "tokens_emitted": self.tokens_emitted,
+            "tokens_per_dispatch": round(
+                self.tokens_emitted / max(self.dispatches, 1), 3
+            ),
+            "prefix_hits": self.prefix_hits,
+            "queued": len(self._queue),
+            "slots_busy": sum(st is not None for st in self._slot_state),
+            "slots": self.slots,
+            "decode_horizon": self.decode_horizon,
+        }
+        if self.spec_k:
+            out["spec_k"] = self.spec_k
+            out["spec_acceptance"] = round(
+                self.spec_accepted / max(self.spec_offered, 1), 3
+            )
+        return out
+
     @property
     def has_work(self) -> bool:
         """Anything queued or decoding? (The serving driver thread

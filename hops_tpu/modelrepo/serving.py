@@ -169,7 +169,7 @@ class LMEnginePredictor:
             # would then fail in submit().
             raise NotImplementedError(
                 "prefixes are not supported with draft_model "
-                "(speculative serving is greedy, prefix-less for now)"
+                "(speculative serving is prefix-less for now)"
             )
         if cfg.get("draft_model"):
             # Speculative serving: the draft is a second registry model
@@ -223,6 +223,12 @@ class LMEnginePredictor:
                 self._cv.notify_all()
             log.exception("LM engine driver thread died")
             raise
+
+    def stats(self) -> dict[str, Any]:
+        """Engine telemetry under the engine lock (the driver thread
+        steps under the same condition variable)."""
+        with self._cv:
+            return self._engine.stats()
 
     @staticmethod
     def _parse(instance: Any) -> dict[str, Any]:
@@ -427,11 +433,34 @@ class _RunningServing:
                 timeout_ms=float(bc.get("timeout_ms", 5.0)),
             )
         predictor = self.batcher or self.predictor
+        raw_predictor = self.predictor
         producer = self.producer
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args: Any) -> None:  # silence stderr spam
                 pass
+
+            def do_GET(self) -> None:
+                # TF-Serving's model-status contract
+                # (GET /v1/models/<name>), extended with live engine
+                # telemetry when the predictor exposes stats() — the
+                # LM engine's dispatches, occupancy, prefix hits, and
+                # speculation acceptance.
+                try:
+                    if not self.path.rstrip("/").endswith(f"/v1/models/{name}"):
+                        self._reply(404, {"error": f"unknown path {self.path}"})
+                        return
+                    body: dict[str, Any] = {
+                        "model_version_status": [{
+                            "version": str(cfg.get("model_version", 1)),
+                            "state": "AVAILABLE",
+                        }],
+                    }
+                    if hasattr(raw_predictor, "stats"):
+                        body["engine"] = raw_predictor.stats()
+                    self._reply(200, body)
+                except Exception as e:  # noqa: BLE001 — server must stay up
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
             def do_POST(self) -> None:
                 try:
@@ -858,16 +887,33 @@ def make_inference_request(
     """POST the TF-Serving payload to the endpoint (reference:
     ``serving.make_inference_request(name, {"signature_name",
     "instances": [...]})``)."""
+    req = urllib.request.Request(
+        f"{_endpoint(name)}/v1/models/{name}{verb}",
+        data=json.dumps(data).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def get_model_status(name: str) -> dict[str, Any]:
+    """``GET /v1/models/<name>`` — TF-Serving's model-status contract,
+    extended with live ``engine`` telemetry (dispatch counts, slot
+    occupancy, prefix hits, speculation acceptance) for
+    ``model_server="LM"`` endpoints."""
+    with urllib.request.urlopen(
+        f"{_endpoint(name)}/v1/models/{name}", timeout=30
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def _endpoint(name: str) -> str:
+    """Base URL of a RUNNING serving, or raise (the one definition of
+    the registry/port/status preamble)."""
     reg = _load_registry()
     if name not in reg:
         raise KeyError(f"serving {name!r} not found")
     port = reg[name].get("port")
     if port is None or get_status(name) != "Running":
         raise RuntimeError(f"serving {name!r} is not running")
-    req = urllib.request.Request(
-        f"http://127.0.0.1:{port}/v1/models/{name}{verb}",
-        data=json.dumps(data).encode(),
-        headers={"Content-Type": "application/json"},
-    )
-    with urllib.request.urlopen(req, timeout=30) as resp:
-        return json.loads(resp.read())
+    return f"http://127.0.0.1:{port}"

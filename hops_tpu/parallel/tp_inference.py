@@ -117,7 +117,7 @@ def tp_generate_speculative(
     agreement is distributional (lossless wrt the tp-computed target),
     not bitwise. The draft's ``num_heads`` (and ``num_kv_heads``) must
     divide the tp degree too."""
-    if spec_kwargs.get("temperature", 0.0) > 0 and "rng" not in spec_kwargs:
+    if spec_kwargs.get("temperature", 0.0) > 0 and spec_kwargs.get("rng") is None:
         # Mirror generate_speculative's validation here: inside the
         # traced wrapper rng is never None, so its own guard can't fire
         # — silently substituting a fixed key would make every

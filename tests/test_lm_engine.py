@@ -739,6 +739,12 @@ def test_lm_server_speculative_over_http():
                        jax.random.PRNGKey(0), max_new_tokens=6,
                        temperature=0.0)
         assert resp["predictions"][0] == list(np.asarray(ref[0, 4:]))
+        # GET /v1/models/<name>: TF-Serving status + engine telemetry.
+        status = serving.get_model_status("spec-lm")
+        assert status["model_version_status"][0]["state"] == "AVAILABLE"
+        eng = status["engine"]
+        assert eng["tokens_emitted"] >= 6 and eng["spec_k"] == 3
+        assert 0.0 <= eng["spec_acceptance"] <= 1.0
     finally:
         serving.stop("spec-lm")
 
