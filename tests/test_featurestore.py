@@ -967,3 +967,26 @@ class TestBias:
         df = pd.DataFrame({"count": ["A", "B"], "label": [0, 1], "pred": [0, 1]})
         with pytest.raises(ValueError, match="collide"):
             bias.slice_metrics(df, "label", "pred", "count")
+
+
+def test_pack_documents_lm_layout():
+    """Ragged docs -> (n, seq_len + 1) rows: eos separates documents,
+    the stream chunks without interior padding, and the remainder pads
+    or drops as asked."""
+    from hops_tpu.featurestore.feed import pack_documents
+
+    docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10]]
+    packed = pack_documents(docs, seq_len=4, eos_id=99, pad_id=0,
+                            drop_remainder=False)
+    # Stream: 1 2 3 99 4 5 99 6 7 8 9 10 99 -> 13 tokens, rows of 5.
+    assert packed.shape == (3, 5)
+    assert packed[0].tolist() == [1, 2, 3, 99, 4]
+    assert packed[1].tolist() == [5, 99, 6, 7, 8]
+    assert packed[2].tolist() == [9, 10, 99, 0, 0]  # padded remainder
+    dropped = pack_documents(docs, seq_len=4, eos_id=99)
+    assert dropped.shape == (2, 5)
+
+    import pytest
+
+    with pytest.raises(ValueError, match="too short"):
+        pack_documents([[1]], seq_len=8, eos_id=99)
