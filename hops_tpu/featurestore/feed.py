@@ -325,8 +325,14 @@ def pack_documents(
         pad[0, : tail.size] = tail
         packed = np.concatenate([packed, pad])
     if not packed.size:
+        if not stream.size:
+            raise ValueError("no input tokens: docs is empty")
+        if drop_remainder:
+            raise ValueError(
+                f"documents too short to fill one row of {row} tokens "
+                "(pass drop_remainder=False to keep a padded partial row)"
+            )
         raise ValueError(
-            f"documents too short to fill one row of {row} tokens "
-            "(pass drop_remainder=False to keep a padded partial row)"
+            f"documents too short to fill one row of {row} tokens"
         )
     return packed

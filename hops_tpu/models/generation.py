@@ -468,3 +468,141 @@ def generate_speculative(
     body = round_sampled if temperature > 0 else round_
     out, n, _, _, _ = jax.lax.while_loop(cond, body, (out, n0, cur, t_cache, d_cache))
     return out[:, :total]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "model", "max_new_tokens", "beam_size", "eos_id", "pad_id",
+        "length_penalty",
+    ),
+)
+def beam_search(
+    model: Any,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int = 32,
+    beam_size: int = 4,
+    eos_id: int | None = None,
+    pad_id: int = 0,
+    length_penalty: float = 0.0,
+) -> tuple[jax.Array, jax.Array]:
+    """Beam search over the KV-cached decode path: returns
+    ``(tokens (b, L + max_new_tokens), scores (b,))`` — the best beam
+    per row and its total log-probability (divided by
+    ``generated_length ** length_penalty`` when set; finished beams
+    freeze at their eos length).
+
+    TPU-static throughout: ``b * beam_size`` cache rows live for the
+    whole search, each step is one batched decode dispatch + a
+    ``(b, k*V)`` top-k + a gather that reorders cache rows and the
+    emitted buffer by back-pointer — no dynamic shapes, no host loop.
+    With ``eos_id``, a finished beam's only continuation is ``pad_id``
+    at zero score delta, so it competes unchanged while live beams
+    extend. The prompt prefills once per beam row (one pass, simple
+    and static; the cache tile trick saves prefill FLOPs only, not
+    decode cost, and prefill is a one-time cost).
+    """
+    b, prompt_len = prompt.shape
+    k = beam_size
+    if k < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if prompt_len + max_new_tokens > model.max_decode_len:
+        raise ValueError(
+            f"prompt {prompt_len} + {max_new_tokens} new tokens exceeds "
+            f"max_decode_len {model.max_decode_len}"
+        )
+
+    # Prefill all b*k beam rows (beam-major: row r = b_idx * k + beam).
+    tiled = jnp.repeat(prompt, k, axis=0)  # (b*k, L)
+    logits, variables = model.apply(
+        {"params": params}, tiled, decode=True, mutable=["cache"]
+    )
+    cache = variables["cache"]
+    logp0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32), axis=-1)
+    v = logp0.shape[-1]
+
+    # Initial scores: only beam 0 is live (all rows hold the same
+    # prefix, so step 1 must pick the top-k DISTINCT first tokens from
+    # one distribution, not k copies of the argmax).
+    neg = jnp.float32(-1e30)
+    scores = jnp.where(jnp.arange(k) == 0, 0.0, neg)  # (k,)
+    scores = jnp.tile(scores[None], (b, 1))  # (b, k)
+
+    def select(scores, logp, done, lengths):
+        # logp (b, k, V) additions; finished beams may only emit
+        # pad_id at zero delta.
+        pad_only = jnp.full((v,), neg).at[pad_id].set(0.0)
+        logp = jnp.where(done[:, :, None], pad_only[None, None], logp)
+        total = scores[:, :, None] + logp  # (b, k, V)
+        flat = total.reshape(b, k * v)
+        top_scores, top_idx = jax.lax.top_k(flat, k)  # (b, k)
+        parent = top_idx // v
+        token = (top_idx % v).astype(prompt.dtype)
+        new_done = jnp.take_along_axis(done, parent, axis=1)
+        new_len = jnp.take_along_axis(lengths, parent, axis=1)
+        if eos_id is not None:
+            hit = (token == eos_id) & ~new_done
+            new_len = jnp.where(new_done, new_len, new_len + 1)
+            new_done = new_done | hit
+        else:
+            new_len = new_len + 1
+        return top_scores, parent, token, new_done, new_len
+
+    first_scores, parent0, tok0, done0, len0 = select(
+        scores, logp0.reshape(b, k, v),
+        jnp.zeros((b, k), bool), jnp.zeros((b, k), jnp.int32),
+    )
+
+    def reorder(tree_or_buf, parent):
+        # Gather beam rows by back-pointer: global row = b_idx*k + beam.
+        # The scalar cache index (0-d) is row-shared — every beam row
+        # advances in lockstep — so it passes through untouched.
+        rows = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+
+        def gather(leaf):
+            return leaf if leaf.ndim == 0 else jnp.take(leaf, rows, axis=0)
+
+        return jax.tree.map(gather, tree_or_buf)
+
+    buf = jnp.full((b * k, max_new_tokens), pad_id, prompt.dtype)
+    cache = reorder(cache, parent0)
+    buf = buf.at[:, 0].set(tok0.reshape(-1))
+
+    def step(carry, t):
+        cache, buf, scores, tok, done, lengths = carry
+        logits, variables = model.apply(
+            {"params": params, "cache": cache},
+            tok.reshape(-1)[:, None],
+            decode=True,
+            mutable=["cache"],
+        )
+        cache = variables["cache"]
+        logp = jax.nn.log_softmax(
+            logits[:, -1].astype(jnp.float32), axis=-1
+        ).reshape(b, k, v)
+        scores, parent, tok2, done, lengths = select(scores, logp, done, lengths)
+        cache = reorder(cache, parent)
+        buf = reorder(buf, parent)
+        buf = jax.lax.dynamic_update_slice(
+            buf, tok2.reshape(-1, 1), (jnp.zeros((), jnp.int32), t)
+        )
+        return (cache, buf, scores, tok2, done, lengths), None
+
+    (cache, buf, scores, _, done, lengths), _ = jax.lax.scan(
+        step, (cache, buf, first_scores, tok0, done0, len0),
+        jnp.arange(1, max_new_tokens),
+    )
+
+    if length_penalty:
+        norm = jnp.maximum(lengths, 1).astype(jnp.float32) ** length_penalty
+        ranked = scores / norm
+    else:
+        ranked = scores
+    best = jnp.argmax(ranked, axis=1)  # (b,)
+    best_rows = jnp.arange(b) * k + best
+    best_tokens = jnp.take(buf.reshape(b * k, -1), best_rows, axis=0)
+    best_scores = jnp.take_along_axis(ranked, best[:, None], axis=1)[:, 0]
+    return jnp.concatenate([prompt, best_tokens], axis=1), best_scores

@@ -507,3 +507,72 @@ def test_speculative_sampled_perfect_draft_accepts_everything():
         temperature=1.0, rng=jax.random.PRNGKey(7),
     )
     np.testing.assert_array_equal(np.asarray(out), np.asarray(again))
+
+
+def test_beam_search_k1_is_greedy():
+    model, params = _model_and_params()
+    from hops_tpu.models.generation import beam_search
+
+    prompt = jnp.asarray(np.random.RandomState(13).randint(1, 64, (2, 6)))
+    greedy = generate(model, params, prompt, jax.random.PRNGKey(0),
+                      max_new_tokens=8, temperature=0.0)
+    beams, scores = beam_search(model, params, prompt, max_new_tokens=8,
+                                beam_size=1)
+    np.testing.assert_array_equal(np.asarray(beams), np.asarray(greedy))
+    assert scores.shape == (2,) and np.all(np.asarray(scores) <= 0)
+
+
+def test_beam_search_finds_optimal_sequence():
+    """With beam_size >= V^depth the search is exhaustive: its winner
+    must equal the brute-force most-likely continuation."""
+    from itertools import product
+
+    from hops_tpu.models.generation import beam_search
+    from hops_tpu.models.transformer import TransformerLM
+
+    kw = dict(vocab_size=4, d_model=32, num_heads=4, num_layers=2,
+              dtype=jnp.float32, attention_impl="reference",
+              max_decode_len=16)
+    model = TransformerLM(**kw)
+    params = model.init(jax.random.PRNGKey(2), jnp.zeros((1, 4), jnp.int32))[
+        "params"]
+    prompt = jnp.asarray([[1, 3, 2]], jnp.int32)
+
+    beams, score = beam_search(model, params, prompt, max_new_tokens=2,
+                               beam_size=16)
+
+    best, best_lp = None, -np.inf
+    for seq in product(range(4), repeat=2):
+        full = jnp.asarray([list(np.asarray(prompt[0])) + list(seq)])
+        logits = model.apply({"params": params}, full)
+        lp = 0.0
+        for i, tok in enumerate(seq):
+            logp = jax.nn.log_softmax(logits[0, 2 + i].astype(jnp.float32))
+            lp += float(logp[tok])
+        if lp > best_lp:
+            best, best_lp = seq, lp
+    assert tuple(np.asarray(beams[0, 3:])) == best
+    assert abs(float(score[0]) - best_lp) < 1e-4
+
+
+def test_beam_search_eos_freezes_beam():
+    """A beam that emits eos pads thereafter at frozen score. With
+    beam_size=1 the beam IS the greedy path, so setting eos to the
+    greedy first token guarantees the freeze path runs (no vacuous
+    conditional)."""
+    model, params = _model_and_params()
+    from hops_tpu.models.generation import beam_search
+
+    prompt = jnp.asarray(np.random.RandomState(14).randint(1, 64, (1, 5)))
+    greedy = generate(model, params, prompt, jax.random.PRNGKey(0),
+                      max_new_tokens=1, temperature=0.0)
+    eos = int(np.asarray(greedy[0, 5]))
+    beams, score = beam_search(model, params, prompt, max_new_tokens=6,
+                               beam_size=1, eos_id=eos, pad_id=0)
+    row = list(np.asarray(beams[0, 5:]))
+    assert row[0] == eos
+    assert all(t == 0 for t in row[1:]), row
+    # Frozen score: exactly the first token's log-prob, nothing after.
+    logits = model.apply({"params": params}, prompt)
+    lp = float(jax.nn.log_softmax(logits[0, -1].astype(jnp.float32))[eos])
+    assert abs(float(score[0]) - lp) < 1e-4
