@@ -509,10 +509,17 @@ def test_speculative_sampled_perfect_draft_accepts_everything():
     np.testing.assert_array_equal(np.asarray(out), np.asarray(again))
 
 
-def test_beam_search_k1_is_greedy():
-    model, params = _model_and_params()
+@pytest.mark.parametrize(
+    "knobs", [{}, {"num_kv_heads": 2, "kv_cache_dtype": "int8"}]
+)
+def test_beam_search_k1_is_greedy(knobs):
+    """beam_size=1 equals greedy generate — including through the GQA +
+    int8-cache decode path (beam search rides the same cache)."""
     from hops_tpu.models.generation import beam_search
 
+    model = TransformerLM(**TINY, **knobs)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))[
+        "params"]
     prompt = jnp.asarray(np.random.RandomState(13).randint(1, 64, (2, 6)))
     greedy = generate(model, params, prompt, jax.random.PRNGKey(0),
                       max_new_tokens=8, temperature=0.0)
