@@ -44,10 +44,22 @@ def main(seq_len: int = 512, steps: int = 5) -> dict:
     # buffer hurts — the token-chunked LM-head loss never builds it.
     step = jax.jit(make_lm_train_step(loss_chunk=128), donate_argnums=(0,))
 
+    # Real LM data prep: ragged "documents" greedy-pack into
+    # eos-separated (n, seq+1) rows — no interior padding
+    # (featurestore.feed.pack_documents, the standard pretraining
+    # layout), then rows shard over the data axis.
+    from hops_tpu.featurestore.feed import pack_documents
+
     rng = np.random.RandomState(0)
     batch_size = 2 * mesh.shape["data"]
+    docs = [
+        rng.randint(1, 256, (int(n),))
+        for n in rng.randint(seq_len // 3, seq_len, steps * batch_size * 3)
+    ]
+    packed = pack_documents(docs, seq_len=seq_len, eos_id=0)
+    assert len(packed) >= steps * batch_size, len(packed)
     for i in range(steps):
-        tokens = rng.randint(0, 256, (batch_size, seq_len + 1))
+        tokens = packed[i * batch_size:(i + 1) * batch_size]
         batch = {
             "tokens": jax.device_put(tokens, NamedSharding(mesh, P("data")))
         }

@@ -36,6 +36,8 @@ STEPS: list[tuple[str, list[str]]] = [
                                 "--layers", "4", "--spec-k", "4"]),
     ("int8_rerun", [sys.executable, "examples/decode_bench.py",
                     "--kv-dtype", "int8"]),
+    # Fresh driver-style headline artifact (compile cache warm: ~70 s).
+    ("resnet50_bench", [sys.executable, "bench.py", "--no-probe"]),
 ]
 
 

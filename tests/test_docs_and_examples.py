@@ -176,3 +176,15 @@ def test_torch_example_through_launch_and_de():
     assert result["launch"]["accuracy"] > 0.85  # real digits, real training
     assert result["de"]["best_metric"] > 0.85
     assert 1e-4 <= result["de"]["best_config"]["lr"] <= 1e-2
+
+
+def test_long_context_lm_example():
+    """Ring-attention training over a data x seq mesh, fed by
+    pack_documents rows."""
+    from examples import long_context_lm
+
+    import numpy as np
+
+    result = long_context_lm.main(seq_len=256, steps=2)
+    assert np.isfinite(result["loss"])
+    assert result["mesh"]["seq"] > 1
