@@ -1,0 +1,176 @@
+"""Single-tenant relay lock: serialize every client of the TPU relay.
+
+The axon relay serves ONE tenant; two clients racing it — or a client
+killed mid-compile — wedge it for hours (BENCHMARKS.md relay incident
+log: both round-4 wedges were self-inflicted collisions/kills). Round 4
+stated the discipline in prose; this module enforces it in code, per
+the round-4 review: one lock, acquired by everything that touches the
+relay (`bench.py`, `hw_measure.py`, `hw_watch.py`,
+`examples/decode_bench.py`), refusing to start while another holder is
+live, and never wrapped in `timeout`.
+
+Mechanics
+---------
+* The lock is a file (default `<repo>/.relay.lock`; override with
+  `$HOPS_TPU_RELAY_LOCK` for tests) created with `O_CREAT|O_EXCL` —
+  atomic on POSIX — holding `{pid, purpose, ts}` for diagnostics.
+* A second acquire by a different process raises `RelayBusy` naming
+  the live owner, *without* touching the relay.
+* Stale locks (owner pid no longer alive) are broken automatically:
+  a crash must not require manual cleanup.
+* Holders export `$HOPS_TPU_RELAY_TOKEN` so their *children* (e.g.
+  `hw_measure.py` running `bench.py --no-probe`) pass through instead
+  of deadlocking against their own parent's lock.
+
+Reference role: the reference serializes GPU benchmark runs by having
+exactly one Spark executor per GPU (`benchmark.ipynb` under
+MirroredStrategy); here the scarce resource is the relay itself, so the
+mutual exclusion lives client-side.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Iterator
+
+ENV_LOCK_PATH = "HOPS_TPU_RELAY_LOCK"
+ENV_TOKEN = "HOPS_TPU_RELAY_TOKEN"
+
+
+def lock_path() -> Path:
+    override = os.environ.get(ENV_LOCK_PATH)
+    if override:
+        return Path(override)
+    return Path(__file__).resolve().parents[2] / ".relay.lock"
+
+
+class RelayBusy(RuntimeError):
+    """Another live process holds the relay lock."""
+
+    def __init__(self, owner: dict):
+        self.owner = owner
+        super().__init__(
+            f"relay locked by pid {owner.get('pid')} "
+            f"({owner.get('purpose', '?')}) since {owner.get('ts', '?')} — "
+            "refusing to race the single-tenant relay; wait for the holder "
+            "to finish naturally (NEVER kill it: a killed client wedges "
+            "the relay)"
+        )
+
+
+def _read_owner(path: Path) -> dict | None:
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None  # vanished or mid-write; caller retries
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    return True
+
+
+def _break_stale(path: Path, stale_pid: int) -> None:
+    """Unlink the lock iff it still names `stale_pid` and that pid is dead.
+
+    Serialized under an flock'd guard file: two racers that both
+    observed the same stale lock must not double-break — the loser's
+    unlink would otherwise remove a NEW holder's freshly created lock,
+    putting two clients inside the critical section (the exact
+    collision this module exists to prevent). Under the guard, the
+    re-read makes the unlink conditional on the lock still being the
+    stale one.
+    """
+    import fcntl
+
+    guard = path.with_name(path.name + ".guard")
+    with open(guard, "w") as g:
+        fcntl.flock(g, fcntl.LOCK_EX)
+        owner = _read_owner(path)
+        if (
+            owner is not None
+            and owner.get("pid") == stale_pid
+            and not _pid_alive(stale_pid)
+        ):
+            try:
+                path.unlink()
+            except FileNotFoundError:
+                pass
+
+
+def current_owner() -> dict | None:
+    """The live holder's `{pid, purpose, ts}`, or None if the lock is free.
+
+    Side effect: breaks (removes) a stale lock whose owner pid is dead.
+    """
+    path = lock_path()
+    if not path.exists():
+        return None
+    owner = _read_owner(path)
+    if owner is None:
+        return None
+    pid = owner.get("pid")
+    if isinstance(pid, int) and not _pid_alive(pid):
+        # Crashed holder: break the lock so a crash never needs manual
+        # cleanup. Children of the dead holder may linger, but they
+        # inherited the token and will finish on their own — the next
+        # holder's pre-run probe detects an unhealthy relay anyway.
+        _break_stale(path, pid)
+        return None
+    return owner
+
+
+@contextmanager
+def relay_lock(purpose: str, wait_s: float = 0.0, poll_s: float = 5.0) -> Iterator[None]:
+    """Hold the relay for `purpose`; children inherit via $HOPS_TPU_RELAY_TOKEN.
+
+    `wait_s=0` refuses immediately when busy (the hw_* entry points);
+    `wait_s>0` polls until the holder exits (bench.py's driver run,
+    which would rather wait out a sweep than go red).
+
+    Raises `RelayBusy` if still held at the deadline.
+    """
+    path = lock_path()
+    if os.environ.get(ENV_TOKEN):
+        # We are a child of the holder (or a re-entrant caller): the
+        # parent serializes relay access for us.
+        yield
+        return
+    deadline = time.monotonic() + wait_s
+    while True:
+        owner = current_owner()  # also breaks stale locks
+        if owner is None:
+            try:
+                fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                continue  # raced another acquirer; re-check liveness
+            with os.fdopen(fd, "w") as f:
+                json.dump(
+                    {"pid": os.getpid(), "purpose": purpose,
+                     "ts": time.strftime("%Y-%m-%d %H:%M:%S")},
+                    f,
+                )
+            break
+        if time.monotonic() >= deadline:
+            raise RelayBusy(owner)
+        time.sleep(min(poll_s, max(0.1, deadline - time.monotonic())))
+    os.environ[ENV_TOKEN] = str(os.getpid())
+    try:
+        yield
+    finally:
+        os.environ.pop(ENV_TOKEN, None)
+        owner = _read_owner(path)
+        if owner and owner.get("pid") == os.getpid():
+            try:
+                path.unlink()
+            except FileNotFoundError:
+                pass

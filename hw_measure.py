@@ -60,14 +60,31 @@ def record(entry: dict) -> None:
 def main() -> int:
     import os
 
-    # Children run scripts from examples/ — python puts the SCRIPT's
-    # dir on sys.path, not the cwd, so the repo root must ride
-    # PYTHONPATH (appended: /root/.axon_site must stay first or the
-    # TPU plugin fails to register).
-    env = dict(os.environ)
-    env["PYTHONPATH"] = ":".join(
-        p for p in (env.get("PYTHONPATH"), str(ROOT)) if p
-    )
+    sys.path.insert(0, str(ROOT))
+    from hops_tpu.runtime.relaylock import RelayBusy, relay_lock
+
+    try:
+        with relay_lock("hw_measure.py sweep"):
+            # Snapshot the env AFTER acquiring: relay_lock exports the
+            # pass-through token into os.environ, and children spawned
+            # with a pre-acquisition copy would collide with our own
+            # lock (subprocess env= replaces, not augments).
+            env = dict(os.environ)
+            # Children run scripts from examples/ — python puts the
+            # SCRIPT's dir on sys.path, not the cwd, so the repo root
+            # must ride PYTHONPATH (appended: /root/.axon_site must
+            # stay first or the TPU plugin fails to register).
+            env["PYTHONPATH"] = ":".join(
+                p for p in (env.get("PYTHONPATH"), str(ROOT)) if p
+            )
+            return _run_steps(env)
+    except RelayBusy as e:
+        print(f"[hw_measure] {e}", flush=True)
+        record({"step": "abort", "reason": f"relay lock busy: {e.owner}"})
+        return 2
+
+
+def _run_steps(env: dict) -> int:
     for name, cmd in STEPS:
         t0 = time.time()
         print(f"[hw_measure] {name}: {' '.join(cmd[1:])}", flush=True)

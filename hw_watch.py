@@ -47,20 +47,45 @@ def record(entry: dict) -> None:
 
 
 def main() -> int:
-    env = dict(os.environ)
-    env["PYTHONPATH"] = ":".join(
-        p for p in (env.get("PYTHONPATH"), str(ROOT)) if p
-    )
+    sys.path.insert(0, str(ROOT))
+    from hops_tpu.runtime.relaylock import RelayBusy, relay_lock
+
+    def child_env() -> dict:
+        # Rebuilt per use: after relay_lock is acquired it must carry
+        # the pass-through token relay_lock exports into os.environ
+        # (a pre-acquisition snapshot would make children collide with
+        # our own lock). PYTHONPATH appended, never prepended:
+        # /root/.axon_site must stay first or the TPU plugin fails to
+        # register.
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ":".join(
+            p for p in (env.get("PYTHONPATH"), str(ROOT)) if p
+        )
+        return env
+
     while True:
         proc = subprocess.run(
             [sys.executable, "bench.py", "--probe"],
-            cwd=ROOT, env=env, capture_output=True, text=True,
+            cwd=ROOT, env=child_env(), capture_output=True, text=True,
         )
         if '"ok": true' in proc.stdout:
             print("[hw_watch] relay recovered — running queue", flush=True)
             break
-        print(f"[hw_watch] relay still wedged; sleeping {PROBE_EVERY_S}s", flush=True)
+        if '"busy": true' in proc.stdout:
+            print(f"[hw_watch] relay locked by another client; sleeping {PROBE_EVERY_S}s",
+                  flush=True)
+        else:
+            print(f"[hw_watch] relay still wedged; sleeping {PROBE_EVERY_S}s", flush=True)
         time.sleep(PROBE_EVERY_S)
+    try:
+        with relay_lock("hw_watch.py queue"):
+            return _run_queue(child_env())
+    except RelayBusy as e:
+        print(f"[hw_watch] {e}", flush=True)
+        return 2
+
+
+def _run_queue(env: dict) -> int:
     for name, cmd in STEPS:
         t0 = time.time()
         print(f"[hw_watch] {name}", flush=True)

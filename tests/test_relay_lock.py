@@ -1,0 +1,122 @@
+"""Relay-lock discipline, enforced in code (round-4 review item #2).
+
+The single-tenant relay wedges when two clients race it or one is
+killed mid-compile; these tests prove the mutual exclusion that every
+relay entry point (bench.py, hw_measure.py, hw_watch.py,
+examples/decode_bench.py) now acquires: a second client is REFUSED
+while the holder lives, stale locks break themselves, and the holder's
+children pass through instead of deadlocking.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from hops_tpu.runtime import relaylock
+from hops_tpu.runtime.relaylock import RelayBusy, current_owner, relay_lock
+
+ROOT = Path(relaylock.__file__).resolve().parents[2]
+
+
+@pytest.fixture(autouse=True)
+def isolated_lock(tmp_path, monkeypatch):
+    """Point the lock at a temp file; make this process a fresh client."""
+    path = tmp_path / "relay.lock"
+    monkeypatch.setenv(relaylock.ENV_LOCK_PATH, str(path))
+    monkeypatch.delenv(relaylock.ENV_TOKEN, raising=False)
+    yield path
+
+
+def test_acquire_writes_owner_and_releases(isolated_lock):
+    with relay_lock("unit test"):
+        owner = json.loads(isolated_lock.read_text())
+        assert owner["pid"] == os.getpid()
+        assert owner["purpose"] == "unit test"
+        assert os.environ[relaylock.ENV_TOKEN] == str(os.getpid())
+    assert not isolated_lock.exists()
+    assert relaylock.ENV_TOKEN not in os.environ
+
+
+def test_second_client_refused_while_holder_lives(isolated_lock, monkeypatch):
+    with relay_lock("holder"):
+        # A *different* process has no token; simulate one by dropping
+        # ours. The holder (this pid) is alive, so: refused.
+        monkeypatch.delenv(relaylock.ENV_TOKEN)
+        with pytest.raises(RelayBusy) as e:
+            with relay_lock("second client"):
+                pass
+        assert e.value.owner["purpose"] == "holder"
+        assert "never kill" in str(e.value).lower()
+
+
+def test_children_of_holder_pass_through(isolated_lock):
+    with relay_lock("holder"):
+        # Children inherit $HOPS_TPU_RELAY_TOKEN (hw_measure running
+        # bench.py --no-probe); re-entry must not deadlock or re-lock.
+        with relay_lock("child"):
+            owner = json.loads(isolated_lock.read_text())
+            assert owner["purpose"] == "holder"  # still the parent's lock
+
+
+def test_subprocess_child_with_post_acquisition_env_passes_through(isolated_lock):
+    """hw_measure/hw_watch spawn children with env=dict(os.environ): that
+    snapshot must be taken AFTER relay_lock exports the token, and a
+    child given it must enter without colliding with the parent's lock
+    (regression: a pre-acquisition snapshot deadlocked every sweep
+    against its own holder)."""
+    with relay_lock("holder"):
+        env = dict(os.environ)  # post-acquisition: carries the token
+        code = (
+            "from hops_tpu.runtime.relaylock import relay_lock\n"
+            "with relay_lock('child'):\n"
+            "    print('entered')\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env, capture_output=True, text=True, cwd=ROOT,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert "entered" in proc.stdout
+
+
+def test_stale_lock_broken_automatically(isolated_lock):
+    proc = subprocess.Popen(["true"])  # a pid that is certainly dead...
+    proc.wait()  # ...once reaped
+    isolated_lock.write_text(json.dumps(
+        {"pid": proc.pid, "purpose": "crashed sweep", "ts": "2026-01-01 00:00:00"}
+    ))
+    assert current_owner() is None  # stale: broken on inspection
+    with relay_lock("after crash"):
+        assert json.loads(isolated_lock.read_text())["pid"] == os.getpid()
+
+
+def test_wait_times_out_to_busy(isolated_lock, monkeypatch):
+    with relay_lock("holder"):
+        monkeypatch.delenv(relaylock.ENV_TOKEN)
+        with pytest.raises(RelayBusy):
+            with relay_lock("waiter", wait_s=0.2, poll_s=0.05):
+                pass
+
+
+def test_bench_probe_refuses_without_touching_relay(isolated_lock):
+    """The real entry point: `bench.py --probe` answers busy (and does
+    NOT run its backend probe) while another live client holds the lock."""
+    isolated_lock.write_text(json.dumps(
+        {"pid": os.getpid(), "purpose": "this test", "ts": "now"}
+    ))
+    env = dict(os.environ)
+    env[relaylock.ENV_LOCK_PATH] = str(isolated_lock)
+    env.pop(relaylock.ENV_TOKEN, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "bench.py"), "--probe"],
+        capture_output=True, text=True, env=env, cwd=ROOT,
+    )
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["busy"] is True
+    assert out["ok"] is False
+    assert out["owner"]["purpose"] == "this test"
