@@ -110,6 +110,39 @@ def _sync(tree) -> float:
     return float(jax.tree.leaves(tree)[0])
 
 
+def _timed_loop(step_fn, state, batch, *, steps, warmup, scan_chunk, remake_state):
+    """Shared timing harness for every bench: compile+warmup with the
+    one-shot transient-UNAVAILABLE retry, then whole-dispatch timing.
+
+    The retry exists because the relay intermittently answers a long
+    compile with a transient UNAVAILABLE (HW_MEASURE.jsonl 2026-07-31);
+    ``remake_state`` re-initializes because ``step_fn`` donates its
+    state. One harness, not a per-bench copy, so relay-resilience fixes
+    land everywhere at once. Returns ``(elapsed_s, total_steps)``.
+    """
+    _note(f"compiling + warmup ({max(1, warmup // scan_chunk)} dispatches of {scan_chunk} steps)")
+    try:
+        state, loss = step_fn(state, batch)
+    except jax.errors.JaxRuntimeError as e:
+        if "UNAVAILABLE" not in str(e):
+            raise
+        _note(f"transient UNAVAILABLE on first compile; retrying once: {str(e)[:200]}")
+        time.sleep(30)
+        state = remake_state()
+        state, loss = step_fn(state, batch)
+    for _ in range(max(1, warmup // scan_chunk) - 1):
+        state, loss = step_fn(state, batch)
+    _sync(loss)
+    _note("warmup done, timing")
+
+    n_dispatch = max(1, steps // scan_chunk)  # whole dispatches only, never overshoot
+    t0 = time.perf_counter()
+    for _ in range(n_dispatch):
+        state, loss = step_fn(state, batch)
+    _sync(loss)
+    return time.perf_counter() - t0, n_dispatch * scan_chunk
+
+
 def run_bench(
     per_chip_batch: int = 128,  # measured sweet spot on v5e (96/192/256 all slower, BENCHMARKS.md)
     image_size: int = 224,
@@ -162,7 +195,8 @@ def run_bench(
         model,
         input_shape=(8, image_size, image_size, 3),
     )
-    state = strategy.replicate(jax.jit(init_fn)(jax.random.PRNGKey(0)))
+    make_state = lambda: strategy.replicate(jax.jit(init_fn)(jax.random.PRNGKey(0)))  # noqa: E731
+    state = make_state()
     _note("params initialized")
     train_step = common.make_bn_train_step()
 
@@ -185,34 +219,10 @@ def run_bench(
         }
     )
 
-    _note(f"compiling + warmup ({max(1, warmup // scan_chunk)} dispatches of {scan_chunk} steps)")
-    # The first dispatch carries the big train-step compile. The relay
-    # intermittently answers a long compile with a transient
-    # UNAVAILABLE (HW_MEASURE.jsonl 2026-07-31); one retry — with the
-    # state re-initialized, since step_fn donates it — salvages the
-    # run instead of losing a 27-minute attempt.
-    try:
-        state, loss = step_fn(state, batch)
-    except jax.errors.JaxRuntimeError as e:
-        if "UNAVAILABLE" not in str(e):
-            raise
-        _note(f"transient UNAVAILABLE on first compile; retrying once: {str(e)[:200]}")
-        time.sleep(30)
-        state = strategy.replicate(jax.jit(init_fn)(jax.random.PRNGKey(0)))
-        state, loss = step_fn(state, batch)
-    for _ in range(max(1, warmup // scan_chunk) - 1):
-        state, loss = step_fn(state, batch)
-    _sync(loss)
-    _note("warmup done, timing")
-
-    n_dispatch = max(1, steps // scan_chunk)  # whole dispatches only, never overshoot
-    t0 = time.perf_counter()
-    for _ in range(n_dispatch):
-        state, loss = step_fn(state, batch)
-    _sync(loss)
-    elapsed = time.perf_counter() - t0
-
-    total_steps = n_dispatch * scan_chunk
+    elapsed, total_steps = _timed_loop(
+        step_fn, state, batch, steps=steps, warmup=warmup,
+        scan_chunk=scan_chunk, remake_state=make_state,
+    )
     samples_per_sec = global_batch * total_steps / elapsed
     return {
         "samples_per_sec": samples_per_sec,
@@ -221,6 +231,120 @@ def run_bench(
         "n_chips": n_chips,
         "global_batch": global_batch,
         "platform": jax.devices()[0].platform,
+    }
+
+
+def run_lm_bench(
+    per_chip_batch: int = 8,
+    seq_len: int = 1024,
+    steps: int = 16,
+    warmup: int = 8,
+    smoke: bool = False,
+    scan_chunk: int = 8,
+    remat: bool = False,
+    loss_chunk: int = 512,
+) -> dict:
+    """Driver-grade LM training headline: tokens/s/chip and MFU%.
+
+    The LM stack is half the framework (flash kernels, ring/Ulysses,
+    chunked xent, the serving engine) but through round 4 only ResNet
+    had a driver-style number (round-4 review item #4). This times the
+    full next-token training step — GPT-2-medium-class TransformerLM
+    (~180M params: d_model 1024, d_head 128 per the round-4 decode
+    finding, 12 layers), flash attention, token-chunked LM-head loss,
+    bf16 matmuls — with the same device-side `lax.scan` loop and sync
+    discipline as the ResNet bench.
+
+    MFU uses the standard model-FLOPs accounting: 6*N_matmul per token
+    for fwd+bwd over every matmul parameter (embedding lookups are
+    gathers, not matmuls) plus the causal-attention term
+    6 * d_model * seq * layers; remat recompute is deliberately NOT
+    credited, so --remat reports honest (lower) MFU.
+    """
+    import functools
+
+    from hops_tpu.models import common
+    from hops_tpu.models.transformer import TransformerLM, make_lm_train_step
+    from hops_tpu.parallel.strategy import Strategy
+
+    if smoke:
+        d_model, num_layers, vocab = 64, 2, 256
+        per_chip_batch, seq_len, steps, warmup, scan_chunk, loss_chunk = 2, 64, 4, 2, 2, 32
+    else:
+        d_model, num_layers, vocab = 1024, 12, 32000
+
+    model = TransformerLM(
+        vocab_size=vocab,
+        d_model=d_model,
+        num_heads=8,
+        num_layers=num_layers,
+        dtype=jnp.bfloat16,
+        attention_impl="flash",
+        remat=remat,
+    )
+    strategy = Strategy()
+    n_chips = strategy.num_replicas_in_sync
+    global_batch = per_chip_batch * n_chips
+    _note(f"backend up: {n_chips} chip(s), platform={jax.devices()[0].platform}")
+
+    init_fn = functools.partial(
+        common.create_train_state, model, input_shape=(1, 8), input_dtype=jnp.int32
+    )
+    make_state = lambda: strategy.replicate(jax.jit(init_fn)(jax.random.PRNGKey(0)))  # noqa: E731
+    state = make_state()
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    n_embed = state.params["embed"]["embedding"].size
+    _note(f"params initialized: {n_params / 1e6:.1f}M ({(n_params - n_embed) / 1e6:.1f}M matmul)")
+
+    train_step = make_lm_train_step(loss_chunk=loss_chunk)
+    scan_chunk = min(scan_chunk, steps)
+
+    def multi_step(state, batch):
+        def body(st, _):
+            st, metrics = train_step(st, batch)
+            return st, metrics["loss"]
+
+        state, losses = jax.lax.scan(body, state, None, length=scan_chunk)
+        return state, losses[-1]
+
+    step_fn = strategy.step(multi_step)
+    rs = np.random.RandomState(jax.process_index())
+    # seq_len + 1 ids per row: the step slices inputs[:-1] / targets[1:],
+    # so the model itself runs at exactly seq_len.
+    batch = strategy.distribute_batch(
+        {"tokens": rs.randint(0, vocab, (global_batch, seq_len + 1)).astype(np.int32)}
+    )
+
+    elapsed, total_steps = _timed_loop(
+        step_fn, state, batch, steps=steps, warmup=warmup,
+        scan_chunk=scan_chunk, remake_state=make_state,
+    )
+    tokens_per_sec = global_batch * seq_len * total_steps / elapsed
+    # Model FLOPs per trained token: 2 MACs/param fwd, 2x that bwd,
+    # plus causal attention (QK^T + AV, s/2 average span): fwd
+    # 2 * 2 * d * s/2 * 2 = 2*d*s per layer-token, x3 for training.
+    fwd_flops_per_token = 2 * (n_params - n_embed) + 2 * d_model * seq_len * num_layers
+    train_flops_per_token = 3 * fwd_flops_per_token
+    achieved = tokens_per_sec / n_chips * train_flops_per_token
+    platform = jax.devices()[0].platform
+    # Per-generation peak from the roofline's own table — MFU against
+    # the wrong generation's roof would overstate the headline. None
+    # (unknown chip / cpu) means no MFU claim at all.
+    from hops_tpu.runtime.diagnostics import device_peaks
+
+    peaks = device_peaks() if platform == "tpu" else None
+    peak = peaks[0] if peaks else None
+    return {
+        "tokens_per_sec": tokens_per_sec,
+        "tokens_per_sec_per_chip": tokens_per_sec / n_chips,
+        "step_time_ms": elapsed / total_steps * 1e3,
+        "mfu_pct": round(100 * achieved / peak, 2) if peak else None,
+        "model_tflops_per_sec_per_chip": round(achieved / 1e12, 2),
+        "n_params_m": round(n_params / 1e6, 1),
+        "n_chips": n_chips,
+        "global_batch": global_batch,
+        "seq_len": seq_len,
+        "platform": platform,
     }
 
 
@@ -279,10 +403,16 @@ def main() -> None:
         "--probe", action="store_true",
         help="subprocess TPU health check (never wedges); prints one JSON line",
     )
-    parser.add_argument("--batch", type=int, default=128, help="per-chip batch size")
-    parser.add_argument("--steps", type=int, default=32)
     parser.add_argument(
-        "--scan-chunk", type=int, default=16, help="train steps per dispatch (1 = python loop)"
+        "--batch", type=int, default=None,
+        help="per-chip batch size (default: 128 ResNet, 8 LM)",
+    )
+    parser.add_argument("--steps", type=int, default=None,
+                        help="timed steps (default: 32 ResNet, 16 LM)")
+    parser.add_argument(
+        "--scan-chunk", type=int, default=None,
+        help="train steps per dispatch, 1 = python loop "
+        "(default: 16 ResNet, 8 LM)",
     )
     parser.add_argument(
         "--multihost", action="store_true",
@@ -297,6 +427,15 @@ def main() -> None:
         "--remat", action="store_true",
         help="per-block rematerialization: trade recompute FLOPs for "
         "activation HBM bytes (A/B lever on the bandwidth-bound step)",
+    )
+    parser.add_argument(
+        "--lm", action="store_true",
+        help="LM training headline instead of ResNet-50: ~180M-param "
+        "TransformerLM (d_head 128, flash attention, chunked LM-head "
+        "loss, bf16), reporting tokens/s/chip and MFU%%",
+    )
+    parser.add_argument(
+        "--seq-len", type=int, default=1024, help="--lm sequence length"
     )
     parser.add_argument(
         "--lock-wait", type=float, default=900.0,
@@ -322,7 +461,37 @@ def main() -> None:
         print(json.dumps({"metric": "tpu_probe", **probe_tpu()}))
         return
 
-    metric = "resnet50_samples_per_sec_per_chip"
+    if args.lm:
+        if args.multihost:
+            parser.error(
+                "--lm --multihost is not supported yet: the multihost LM "
+                "path is exercised by dryrun_multichip and the multihost "
+                "integration tests; the LM headline is single-chip"
+            )
+        metric, unit, value_key = "lm_tokens_per_sec_per_chip", "tokens/s/chip", "tokens_per_sec_per_chip"
+        batch = args.batch if args.batch is not None else 8
+        steps = args.steps if args.steps is not None else 16
+        scan_chunk = args.scan_chunk if args.scan_chunk is not None else 8
+
+        def do_run(**overrides):
+            return run_lm_bench(
+                per_chip_batch=batch, seq_len=args.seq_len, steps=steps,
+                scan_chunk=scan_chunk, remat=args.remat, **overrides,
+            )
+    else:
+        metric, unit, value_key = (
+            "resnet50_samples_per_sec_per_chip", "samples/s/chip", "samples_per_sec_per_chip"
+        )
+        batch = args.batch if args.batch is not None else 128
+        steps = args.steps if args.steps is not None else 32
+        scan_chunk = args.scan_chunk if args.scan_chunk is not None else 16
+
+        def do_run(**overrides):
+            return run_bench(
+                per_chip_batch=batch, steps=steps,
+                scan_chunk=scan_chunk, remat=args.remat, **overrides,
+            )
+
     if args.smoke:
         # The smoke run is documented CPU-safe; pin it there so it
         # never touches (or waits on) the single-tenant TPU relay —
@@ -330,19 +499,13 @@ def main() -> None:
         # not enough when a sitecustomize pre-imported jax — same
         # trick as tests/conftest.py.
         jax.config.update("jax_platforms", "cpu")
-        result = run_bench(
-            per_chip_batch=args.batch, steps=args.steps, smoke=True,
-            scan_chunk=args.scan_chunk, remat=args.remat,
-        )
+        result = do_run(smoke=True)
     elif args.multihost:
         # Multihost runs are launched one-process-per-host by
         # hops_tpu.launch against a real slice (no shared relay);
         # serialization is the launcher's job, not this lock's.
         _enable_compile_cache()
-        result = run_bench(
-            per_chip_batch=args.batch, steps=args.steps,
-            scan_chunk=args.scan_chunk, multihost=True, remat=args.remat,
-        )
+        result = do_run(multihost=True)
     else:
         try:
             # The driver's round-end run would rather wait out a
@@ -362,45 +525,49 @@ def main() -> None:
                         emit_stale_or_fail(metric, f"relay unreachable: {health.get('error')}")
                     _note(f"relay healthy ({health.get('platform')}, {health.get('elapsed_s')}s)")
                 _enable_compile_cache()
-                result = run_bench(
-                    per_chip_batch=args.batch, steps=args.steps,
-                    scan_chunk=args.scan_chunk, remat=args.remat,
-                )
+                result = do_run()
         except RelayBusy as e:
             _note(str(e))
             emit_stale_or_fail(metric, f"relay lock busy: {e.owner}")
-    value = result["samples_per_sec_per_chip"]
+    value = result[value_key]
     if args.multihost and jax.process_index() != 0:
         return  # one JSON line total: the chief's
 
-    # Baselines are recorded per platform: the first real run on a
-    # platform becomes that platform's baseline; later runs report
-    # against it.
+    # Baselines are recorded per platform (and per benchmark: the LM
+    # headline keys "<platform>_lm"): the first real run on a platform
+    # becomes that platform's baseline; later runs report against it.
     baseline = None
     if not args.smoke:
+        baseline_key = result["platform"] + ("_lm" if args.lm else "")
         recorded = json.loads(BASELINE_FILE.read_text()) if BASELINE_FILE.exists() else {}
-        entry = recorded.get(result["platform"])
+        entry = recorded.get(baseline_key)
         if entry is not None:
-            baseline = entry.get("samples_per_sec_per_chip")
+            baseline = entry.get(value_key)
         else:
-            recorded[result["platform"]] = {
-                "samples_per_sec_per_chip": value,
+            recorded[baseline_key] = {
+                value_key: value,
                 "platform": result["platform"],
                 "recorded": time.strftime("%Y-%m-%d"),
             }
             BASELINE_FILE.write_text(json.dumps(recorded, indent=2))
             baseline = value
 
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_samples_per_sec_per_chip",
-                "value": round(value, 2),
-                "unit": "samples/s/chip",
-                "vs_baseline": round(value / baseline, 4) if baseline else 1.0,
-            }
+    line = {
+        "metric": metric,
+        "value": round(value, 2),
+        "unit": unit,
+        "vs_baseline": round(value / baseline, 4) if baseline else 1.0,
+    }
+    if args.lm:
+        # The roofline context travels with the number (review item #4:
+        # "tokens/s/chip AND MFU% with the same roofline treatment").
+        line.update(
+            mfu_pct=result["mfu_pct"],
+            model_tflops_per_sec_per_chip=result["model_tflops_per_sec_per_chip"],
+            n_params_m=result["n_params_m"],
+            seq_len=result["seq_len"],
         )
-    )
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -142,6 +142,20 @@ _PEAKS = {
 }
 
 
+def device_peaks(kind: str | None = None) -> tuple[float, float] | None:
+    """(bf16 matmul FLOP/s, HBM bytes/s) peaks for a device kind.
+
+    ``kind`` defaults to the local backend's ``device_kind``; returns
+    None when the generation isn't tabulated — callers must not guess
+    a roof (an MFU% against the wrong generation's peak overstates the
+    headline). Single source for every peak lookup (roofline_report,
+    bench.py --lm).
+    """
+    if kind is None:
+        kind = jax.devices()[0].device_kind
+    return next((v for k, v in _PEAKS.items() if k in kind.lower()), None)
+
+
 def _find_trace_file(trace_dir: str) -> str:
     import glob
 
@@ -223,13 +237,12 @@ def roofline_report(
         # "/device:TPU:0" — so peaks come from the local backend. When
         # analyzing a trace on a different machine (or an unknown chip),
         # pass peak_flops/peak_bw explicitly.
-        kind = jax.devices()[0].device_kind.lower()
-        match = next((v for k, v in _PEAKS.items() if k in kind), None)
+        match = device_peaks()
         if match is None:
             log.warning(
                 "roofline_report: unknown device kind %r — using conservative "
                 "cpu peaks; pass peak_flops/peak_bw for a meaningful roofline",
-                kind,
+                jax.devices()[0].device_kind,
             )
             match = _PEAKS["cpu"]
         peak_flops, peak_bw = peak_flops or match[0], peak_bw or match[1]

@@ -24,7 +24,26 @@ ROOT = Path(__file__).parent
 OUT = ROOT / "HW_MEASURE.jsonl"
 PROBE_EVERY_S = 900
 
+# Round-5 queue (round-4 review item #1a): every currently-unlogged
+# claim gains an HW_MEASURE.jsonl line. Small compiles first — the
+# relay has wedged itself on big compiles, so the decode evidence must
+# be banked before the LM/ResNet compiles get a chance to take it down.
 STEPS: list[tuple[str, list[str]]] = [
+    # int8 decode kernel: both round-4 logged attempts failed Mosaic
+    # lowering; the fix (4155d33) has no logged artifact.
+    ("decode_int8", [sys.executable, "examples/decode_bench.py",
+                     "--kv-dtype", "int8"]),
+    # The composite the cache-bytes story is sold on — never logged green.
+    ("decode_all_knobs", [sys.executable, "examples/decode_bench.py",
+                          "--kv-dtype", "int8", "--kv-heads", "2",
+                          "--window", "256"]),
+    # O(valid) DMA-clamp evidence at shapes where the effect clears the
+    # ~1 ms dispatch floor (new defaults: d_head 128, cap 16k, fixed-
+    # valid capacity control row).
+    ("valid_sweep", [sys.executable, "examples/decode_bench.py",
+                     "--valid-sweep"]),
+    # Continuous-batching A/Bs: engine vs static, then the dispatch-
+    # floor levers (decode horizon, speculative decoding).
     ("decode_continuous_h1", [sys.executable, "examples/decode_bench.py",
                               "--continuous", "--batch", "4", "--tokens", "32",
                               "--layers", "4"]),
@@ -34,8 +53,8 @@ STEPS: list[tuple[str, list[str]]] = [
     ("decode_continuous_spec", [sys.executable, "examples/decode_bench.py",
                                 "--continuous", "--batch", "4", "--tokens", "32",
                                 "--layers", "4", "--spec-k", "4"]),
-    ("int8_rerun", [sys.executable, "examples/decode_bench.py",
-                    "--kv-dtype", "int8"]),
+    # LM training headline (round-4 review item #4): tokens/s/chip + MFU.
+    ("lm_bench", [sys.executable, "bench.py", "--lm", "--no-probe"]),
     # Fresh driver-style headline artifact (compile cache warm: ~70 s).
     ("resnet50_bench", [sys.executable, "bench.py", "--no-probe"]),
 ]
