@@ -196,6 +196,13 @@ class LMEngine:
     ``parallel/tp_inference.py`` — the dense checkpoint is sliced in
     place, the KV caches live head-sharded, and output is identical to
     the unsharded engine for the full knob surface).
+
+    The three levers COMPOSE: ``draft_model`` + ``decode_horizon`` runs
+    the whole draft/score/accept loop ``horizon`` times per dispatch
+    (up to ``horizon * spec_k`` tokens per host round-trip — the
+    configuration that matters when per-dispatch latency, not chip
+    time, bounds serving throughput), and either or both run
+    tensor-parallel under ``mesh``.
     """
 
     def __init__(
@@ -236,15 +243,12 @@ class LMEngine:
                 raise ValueError(f"spec_k must be >= 2, got {spec_k}")
             if not getattr(draft_model, "ragged_decode", False):
                 raise ValueError("draft_model needs ragged_decode=True too")
-            if decode_horizon > 1:
-                raise ValueError(
-                    "speculation and decode_horizon both amortize "
-                    "dispatches — use one (spec_k xor decode_horizon)"
-                )
-            if mesh is not None:
-                raise NotImplementedError(
-                    "speculative decoding with mesh= is not implemented"
-                )
+            # Speculation composes with BOTH other levers (round-4
+            # review item #3): decode_horizon runs the whole
+            # draft/score/accept loop ``horizon`` times inside one
+            # dispatch (the high-RTT configuration the dispatch-floor
+            # analysis asks for), and mesh= runs every spec program
+            # tensor-parallel like the non-spec engine.
         # Tensor parallelism: every engine program runs inside a
         # shard_map over ``tp_axis`` — params and KV caches shard on
         # their head axes (parallel/tp_inference.py layout), scalars
@@ -253,7 +257,9 @@ class LMEngine:
         # unsharded engine.
         self.mesh = mesh
         local_model = model
+        local_draft = draft_model
         param_specs = cache_specs = None
+        draft_param_specs = draft_cache_specs = None
         if mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -274,6 +280,25 @@ class LMEngine:
                 params, param_specs,
             )
             self.params = params
+            if draft_model is not None:
+                # The draft shards the same Megatron way: its heads must
+                # divide the tp degree just like the target's.
+                shards = mesh.shape[tp_axis]
+                dh = getattr(draft_model, "num_kv_heads", None) or draft_model.num_heads
+                if draft_model.num_heads % shards or dh % shards:
+                    raise ValueError(
+                        f"draft heads {draft_model.num_heads}/{dh} not "
+                        f"divisible by tp degree {shards}"
+                    )
+                local_draft = draft_model.clone(tp_axis=tp_axis, tp_shards=shards)
+                draft_param_specs = tp_param_specs(draft_params, tp_axis)
+                draft_params = jax.tree.map(
+                    lambda leaf, spec: jax.device_put(
+                        leaf, NamedSharding(mesh, spec)
+                    ),
+                    draft_params, draft_param_specs,
+                )
+                self.draft_params = draft_params
         cap = model.max_decode_len
         if prefill_buckets is None:
             prefill_buckets = tuple(
@@ -310,6 +335,16 @@ class LMEngine:
                 ),
                 self._cache, cache_specs,
             )
+            if self._draft_cache is not None:
+                draft_cache_specs = _map_cache(
+                    self._draft_cache, lambda leaf: P(None, tp_axis), lambda idx: P()
+                )
+                self._draft_cache = jax.tree.map(
+                    lambda leaf, spec: jax.device_put(
+                        leaf, NamedSharding(mesh, spec)
+                    ),
+                    self._draft_cache, draft_cache_specs,
+                )
 
         def sharded(body, in_specs, out_specs):
             if mesh is None:
@@ -510,25 +545,34 @@ class LMEngine:
             # on the prompt; the target's last true row gives the
             # first token (drawn per the request's sampling knobs),
             # both indices rewind to the true end.
-            logits, t_vars = model.apply(
-                {"params": params}, padded_prompt, decode=True,
-                mutable=["cache"],
-            )
-            _, d_vars = draft_model.apply(
-                {"params": dparams}, padded_prompt, decode=True,
-                mutable=["cache"],
-            )
-            first_tok, t_cache = _admit_tail(
-                logits, t_vars, true_len, true_len, temp, topk, topp, seed,
-                sampled=sampled, nucleus=nucleus,
-            )
-            d_cache = _map_cache(
-                d_vars["cache"], lambda leaf: leaf,
-                lambda idx: jnp.full_like(idx, true_len),
-            )
-            return first_tok, t_cache, d_cache
+            def body(params, dparams, padded_prompt, true_len, temp, topk,
+                     topp, seed):
+                logits, t_vars = local_model.apply(
+                    {"params": params}, padded_prompt, decode=True,
+                    mutable=["cache"],
+                )
+                _, d_vars = local_draft.apply(
+                    {"params": dparams}, padded_prompt, decode=True,
+                    mutable=["cache"],
+                )
+                first_tok, t_cache = _admit_tail(
+                    logits, t_vars, true_len, true_len, temp, topk, topp,
+                    seed, sampled, nucleus,
+                )
+                d_cache = _map_cache(
+                    d_vars["cache"], lambda leaf: leaf,
+                    lambda idx: jnp.full_like(idx, true_len),
+                )
+                return first_tok, t_cache, d_cache
 
-        def spec_step(params, dparams, t_cache, d_cache, tokens, active):
+            body = sharded(
+                body, (param_specs, draft_param_specs) + (P(),) * 6,
+                (P(), cache_specs, draft_cache_specs),
+            )
+            return body(params, dparams, padded_prompt, true_len, temp,
+                        topk, topp, seed)
+
+        def _spec_core(params, dparams, t_cache, d_cache, tokens, active):
             # One speculative dispatch: the draft proposes spec_k - 1
             # greedy tokens per slot, the target scores each slot's
             # [token, proposals] chunk in ONE ragged warm append, and
@@ -538,13 +582,15 @@ class LMEngine:
             # index cannot do. Cache invariant: idx = written tokens
             # (the newest emitted token is unwritten); the dispatch
             # writes the current token plus the proposals, so both
-            # indices rewind to idx0 + 1 + a_r per row.
+            # indices rewind to idx0 + 1 + a_r per row. A shard-mappable
+            # CORE: the single-dispatch jit, the tp wrapper, and the
+            # horizon scan all call this same body.
             t_cache, d_cache = _clamp_idx(t_cache, active), _clamp_idx(d_cache, active)
             idx0 = _get_idx(t_cache)
 
             def dstep(carry, _):
                 dc, tok = carry
-                logits, dv = draft_model.apply(
+                logits, dv = local_draft.apply(
                     {"params": dparams, "cache": dc}, tok[:, None],
                     decode=True, mutable=["cache"],
                 )
@@ -561,7 +607,7 @@ class LMEngine:
             )
             drafts = jnp.moveaxis(drafts_t, 0, 1)[:, : spec_k - 1]
             chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
-            logits, t_vars = model.apply(
+            logits, t_vars = local_model.apply(
                 {"params": params, "cache": t_cache}, chunk, decode=True,
                 mutable=["cache"],
             )
@@ -577,9 +623,9 @@ class LMEngine:
             return (drafts, a_rows, bonus,
                     _rewind_idx(t_cache, new_idx), _rewind_idx(d_cache, new_idx))
 
-        def spec_step_sampled(params, dparams, t_cache, d_cache, tokens,
-                              active, temps, topks, topps, seeds, ns,
-                              *, nucleus):
+        def _spec_core_sampled(params, dparams, t_cache, d_cache, tokens,
+                               active, temps, topks, topps, seeds, ns,
+                               *, nucleus):
             # Rejection-sampling speculation, PER ROW (the engine's
             # advantage over generate_speculative's batch-min): draft
             # samples proposals from its filtered q, target accepts
@@ -607,7 +653,7 @@ class LMEngine:
 
             def dstep(carry, _):
                 dc, tok, n_idx = carry
-                logits, dv = draft_model.apply(
+                logits, dv = local_draft.apply(
                     {"params": dparams, "cache": dc}, tok[:, None],
                     decode=True, mutable=["cache"],
                 )
@@ -643,7 +689,7 @@ class LMEngine:
             drafts = jnp.moveaxis(drafts_t, 0, 1)[:, : spec_k - 1]
             q_probs = jnp.moveaxis(q_t, 0, 1)[:, : spec_k - 1]
             chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
-            logits, t_vars = model.apply(
+            logits, t_vars = local_model.apply(
                 {"params": params, "cache": t_cache}, chunk, decode=True,
                 mutable=["cache"],
             )
@@ -709,6 +755,99 @@ class LMEngine:
             return (drafts, a_rows, bonus,
                     _rewind_idx(t_cache, new_idx), _rewind_idx(d_cache, new_idx))
 
+        def spec_step(params, dparams, t_cache, d_cache, tokens, active):
+            body = sharded(
+                _spec_core,
+                (param_specs, draft_param_specs, cache_specs,
+                 draft_cache_specs, P(), P()),
+                (P(), P(), P(), cache_specs, draft_cache_specs),
+            )
+            return body(params, dparams, t_cache, d_cache, tokens, active)
+
+        def spec_step_sampled(params, dparams, t_cache, d_cache, tokens,
+                              active, temps, topks, topps, seeds, ns,
+                              *, nucleus):
+            body = sharded(
+                functools.partial(_spec_core_sampled, nucleus=nucleus),
+                (param_specs, draft_param_specs, cache_specs,
+                 draft_cache_specs) + (P(),) * 7,
+                (P(), P(), P(), cache_specs, draft_cache_specs),
+            )
+            return body(params, dparams, t_cache, d_cache, tokens, active,
+                        temps, topks, topps, seeds, ns)
+
+        # Speculation x horizon: the whole draft/score/accept loop runs
+        # ``horizon`` times inside ONE dispatch — the configuration the
+        # dispatch-floor analysis asks for on high-RTT hosts (round-4
+        # review item #3: one ~84 ms dispatch then buys up to
+        # horizon * spec_k tokens). In-graph retirement mirrors
+        # account() exactly: a row emits its accepted prefix plus the
+        # bonus, truncated by its budget and its first eos, then goes
+        # dead (cache index clamps to 0 — the free-slot convention).
+        # Returns per-iteration (emitted-token matrix, emit mask,
+        # accepted counts, live-going-in) so the host replays the same
+        # bookkeeping the single-dispatch path does token by token.
+        def spec_horizon(params, dparams, t_cache, d_cache, tokens, live0,
+                         rems, eos_ids, temps, topks, topps, seeds, ns,
+                         *, horizon, sampled, nucleus=False):
+            def run(params, dparams, t_cache, d_cache, tokens, live0, rems,
+                    eos_ids, temps, topks, topps, seeds, ns):
+                cols = jnp.arange(spec_k)[None, :]
+
+                def body(carry, _):
+                    t_c, d_c, tok, live, n, rem = carry
+                    if sampled:
+                        drafts, a_rows, bonus, t_c, d_c = _spec_core_sampled(
+                            params, dparams, t_c, d_c, tok, live,
+                            temps, topks, topps, seeds, n, nucleus=nucleus,
+                        )
+                    else:
+                        drafts, a_rows, bonus, t_c, d_c = _spec_core(
+                            params, dparams, t_c, d_c, tok, live
+                        )
+                    # Emitted-token matrix: accepted drafts in columns
+                    # 0..a_r-1, the bonus at column a_r.
+                    toks_e = jnp.concatenate(
+                        [drafts, jnp.zeros((slots, 1), jnp.int32)], axis=1
+                    )
+                    toks_e = jnp.where(
+                        cols == a_rows[:, None], bonus[:, None], toks_e
+                    )
+                    emit = (
+                        (cols <= a_rows[:, None])
+                        & (cols < rem[:, None])
+                        & live[:, None]
+                    )
+                    is_eos = (toks_e == eos_ids[:, None]) & emit
+                    # The first eos is emitted (account() emits then
+                    # finishes); everything after it is not.
+                    after = (jnp.cumsum(is_eos, axis=1) - is_eos) > 0
+                    emit &= ~after
+                    cnt = emit.sum(axis=1).astype(jnp.int32)
+                    rem2 = rem - cnt
+                    live2 = live & (rem2 > 0) & ~(is_eos & emit).any(axis=1)
+                    # Live rows always emit their full chunk, so the
+                    # last emitted token — next dispatch's input — is
+                    # the bonus; dead rows' carry token is a don't-care.
+                    return (t_c, d_c, bonus, live2, n + cnt, rem2), (
+                        toks_e, emit, a_rows, live,
+                    )
+
+                (t_c, d_c, _, _, _, _), (toks, emits, accs, lives) = jax.lax.scan(
+                    body, (t_cache, d_cache, tokens, live0, ns, rems), None,
+                    length=horizon,
+                )
+                return toks, emits, accs, lives, t_c, d_c
+
+            run = sharded(
+                run,
+                (param_specs, draft_param_specs, cache_specs,
+                 draft_cache_specs) + (P(),) * 9,
+                (P(), P(), P(), P(), cache_specs, draft_cache_specs),
+            )
+            return run(params, dparams, t_cache, d_cache, tokens, live0,
+                       rems, eos_ids, temps, topks, topps, seeds, ns)
+
         self._prefill = prefill
         self._append = append
         self._spec_prefill = (
@@ -722,6 +861,13 @@ class LMEngine:
             jax.jit(
                 spec_step_sampled, donate_argnums=(2, 3),
                 static_argnames=("nucleus",),
+            )
+            if draft_model is not None else None
+        )
+        self._spec_horizon = (
+            jax.jit(
+                spec_horizon, donate_argnums=(2, 3),
+                static_argnames=("horizon", "sampled", "nucleus"),
             )
             if draft_model is not None else None
         )
@@ -919,6 +1065,39 @@ class LMEngine:
             self.tokens_emitted += 1
             if st.remaining == 0 or (st.eos_id is not None and tok == st.eos_id):
                 finished.append(self._finish(row))
+
+        if self.spec_k and self.decode_horizon > 1:
+            rems = jnp.asarray(
+                [st.remaining if st else 0 for st in self._slot_state],
+                jnp.int32,
+            )
+            eos_ids = jnp.asarray(
+                [st.eos_id if st and st.eos_id is not None else -1
+                 for st in self._slot_state],
+                jnp.int32,
+            )
+            toks, emits, accs, lives, self._cache, self._draft_cache = (
+                self._spec_horizon(
+                    self.params, self.draft_params, self._cache,
+                    self._draft_cache, tokens, active, rems, eos_ids,
+                    *sampling_vectors(),
+                    horizon=self.decode_horizon, sampled=sampled,
+                    nucleus=nucleus,
+                )
+            )
+            self.dispatches += 1
+            toks, emits = np.asarray(toks), np.asarray(emits)
+            accs, lives = np.asarray(accs), np.asarray(lives)
+            for i in range(self.decode_horizon):
+                for row in range(self.slots):
+                    if self._slot_state[row] is None or not lives[i, row]:
+                        continue
+                    self.spec_offered += self.spec_k - 1
+                    self.spec_accepted += int(accs[i, row])
+                    for j in range(self.spec_k):
+                        if emits[i, row, j] and self._slot_state[row] is not None:
+                            account(row, int(toks[i, row, j]))
+            return finished
 
         if self.spec_k:
             if sampled:

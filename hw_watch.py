@@ -53,6 +53,12 @@ STEPS: list[tuple[str, list[str]]] = [
     ("decode_continuous_spec", [sys.executable, "examples/decode_bench.py",
                                 "--continuous", "--batch", "4", "--tokens", "32",
                                 "--layers", "4", "--spec-k", "4"]),
+    # The composed corner the dispatch-floor analysis asks for: one
+    # dispatch buys up to horizon * spec_k tokens.
+    ("decode_continuous_spec_h4", [sys.executable, "examples/decode_bench.py",
+                                   "--continuous", "--batch", "4", "--tokens",
+                                   "32", "--layers", "4", "--spec-k", "4",
+                                   "--horizon", "4"]),
     # LM training headline (round-4 review item #4): tokens/s/chip + MFU.
     ("lm_bench", [sys.executable, "bench.py", "--lm", "--no-probe"]),
     # Fresh driver-style headline artifact (compile cache warm: ~70 s).

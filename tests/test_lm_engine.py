@@ -683,9 +683,6 @@ def test_engine_speculative_validation():
     with pytest.raises(ValueError, match="spec_k"):
         LMEngine(model, params, draft_model=model, draft_params=params,
                  spec_k=1)
-    with pytest.raises(ValueError, match="horizon"):
-        LMEngine(model, params, draft_model=model, draft_params=params,
-                 decode_horizon=4)
     engine = LMEngine(model, params, slots=1, prefill_buckets=(8,),
                       draft_model=model, draft_params=params, spec_k=4)
     with pytest.raises(NotImplementedError, match="prefix"):
@@ -772,6 +769,126 @@ def test_engine_speculative_mixed_sampling_keeps_greedy_exact():
     assert r[tg] == list(np.asarray(ref[0, 5:]))
     assert r[t1] == r[t2]  # same seed reproduces through speculation
     assert all(0 <= t < 64 for t in r[t1])
+
+
+def test_engine_speculative_horizon_matches_generate():
+    """Speculation x decode_horizon (the high-RTT configuration: one
+    dispatch buys up to horizon * spec_k tokens): greedy output must
+    still be EXACTLY per-request generate(), through mixed budgets,
+    queueing, and an eos retirement mid-horizon."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    rs = np.random.RandomState(61)
+    prompts = [rs.randint(1, 64, (n,)) for n in (3, 8, 5, 2, 6)]
+    budgets = [9, 4, 7, 1, 6]
+    engine = LMEngine(model, params, slots=2, prefill_buckets=(8, 16),
+                      draft_model=model, draft_params=_params(plain, seed=5),
+                      spec_k=3, decode_horizon=3)
+    tickets = [
+        engine.submit(p, max_new_tokens=b) for p, b in zip(prompts, budgets)
+    ]
+    results = engine.run()
+    for p, b, t in zip(prompts, budgets, tickets):
+        ref = generate(
+            plain, params, jnp.asarray(p)[None], jax.random.PRNGKey(0),
+            max_new_tokens=b, temperature=0.0,
+        )
+        assert results[t] == list(np.asarray(ref[0, len(p):])), t
+    assert engine.spec_offered > 0
+
+    # eos mid-horizon: the in-graph retirement must truncate exactly
+    # where account() would.
+    probe = rs.randint(1, 64, (5,))
+    roll = generate(plain, params, jnp.asarray(probe)[None],
+                    jax.random.PRNGKey(0), max_new_tokens=12, temperature=0.0)
+    gen = [int(x) for x in np.asarray(roll[0, 5:])]
+    eos = gen[3]
+    expect = gen[: gen.index(eos) + 1]
+    eng2 = LMEngine(model, params, slots=1, prefill_buckets=(8,),
+                    draft_model=model, draft_params=params, spec_k=4,
+                    decode_horizon=4)
+    t0 = eng2.submit(probe, max_new_tokens=12, eos_id=eos)
+    assert eng2.run()[t0] == expect
+    # Perfect draft + horizon 4: 12-token budget in ~1 dispatch, not 12.
+    assert eng2.dispatches <= 2
+
+
+def test_engine_speculative_horizon_sampled_identical_to_single_step():
+    """Output is contractually identical for ANY decode_horizon; with a
+    draft that extends to the sampled path: same seeds, same tokens,
+    fewer dispatches."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    draft_params = _params(plain, seed=7)
+    rs = np.random.RandomState(62)
+    prompts = [rs.randint(1, 64, (n,)) for n in (4, 6, 3)]
+
+    def workload(horizon):
+        engine = LMEngine(model, params, slots=2, prefill_buckets=(8,),
+                          draft_model=model, draft_params=draft_params,
+                          spec_k=3, decode_horizon=horizon)
+        ts = [
+            engine.submit(prompts[0], max_new_tokens=7),
+            engine.submit(prompts[1], max_new_tokens=6, temperature=0.9,
+                          top_p=0.9, seed=13),
+            engine.submit(prompts[2], max_new_tokens=5, temperature=0.7,
+                          top_k=12, seed=14),
+        ]
+        r = engine.run()
+        return [r[t] for t in ts], engine.dispatches
+
+    single, d1 = workload(1)
+    horizon, dh = workload(4)
+    assert horizon == single
+    assert dh < d1
+
+
+def test_engine_speculative_tensor_parallel_matches_unsharded():
+    """Speculation x mesh: the whole draft/score/accept loop runs
+    tensor-parallel (Megatron-sharded target AND draft, head-sharded
+    caches). Greedy output matches the unsharded speculative engine;
+    sampled requests reproduce by seed. Composes with decode_horizon
+    (all three levers at once)."""
+    from hops_tpu.parallel import mesh as mesh_lib
+
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    draft_params = _params(plain, seed=5)
+    rs = np.random.RandomState(63)
+    prompts = [rs.randint(1, 64, (n,)) for n in (3, 7, 5)]
+
+    def workload(mesh, horizon):
+        engine = LMEngine(model, params, slots=2, prefill_buckets=(8,),
+                          draft_model=model, draft_params=draft_params,
+                          spec_k=3, decode_horizon=horizon, mesh=mesh)
+        ts = [
+            engine.submit(prompts[0], max_new_tokens=8),
+            engine.submit(prompts[1], max_new_tokens=5, eos_id=1),
+            engine.submit(prompts[2], max_new_tokens=6),
+        ]
+        r = engine.run()
+        return [r[t] for t in ts]
+
+    mesh = mesh_lib.make_mesh({"model": 2}, devices=jax.devices()[:2])
+    assert workload(mesh, 1) == workload(None, 1)
+    assert workload(mesh, 3) == workload(None, 3)
+
+    # Sampled rows under tp: acceptance compares reduction-order-
+    # sensitive floats (tp_inference docstring), so the contract is
+    # seed-reproducibility on the SAME layout, not cross-layout
+    # bitwise equality.
+    engine = LMEngine(model, params, slots=2, prefill_buckets=(8,),
+                      draft_model=model, draft_params=draft_params,
+                      spec_k=3, mesh=mesh)
+    t1 = engine.submit(prompts[0], max_new_tokens=6, temperature=0.9,
+                       top_p=0.9, seed=11)
+    t2 = engine.submit(prompts[0], max_new_tokens=6, temperature=0.9,
+                       top_p=0.9, seed=11)
+    r = engine.run()
+    assert r[t1] == r[t2]
 
 
 def test_engine_speculative_sampled_is_lossless():
