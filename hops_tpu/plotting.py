@@ -1,0 +1,214 @@
+"""Driver-side plotting: pull distributed results local, render figures.
+
+The reference's Plotting suite (notebooks/ml/Plotting/
+matplotlib_sparkmagic.ipynb:61,87,95) ships a cluster DataFrame to the
+driver with ``%%spark -o df`` and plots it in ``%%local`` cells with
+matplotlib. The TPU twin has no Livy hop to make: distributed results
+already land driver-side as files — run metric streams
+(``metrics.jsonl``, experiment/tensorboard.py), hyperparameter-search
+summaries (``search/drivers.py``), and feature-group statistics
+(``featurestore/statistics.py``). :func:`collect` is the ``-o df``
+verb (everything becomes a pandas DataFrame on the driver); the
+``plot_*`` helpers render the standard figures into the run dir
+through matplotlib's Agg backend, so they work headless on a TPU host
+exactly like the reference's ``%%local`` cells work on the Jupyter
+driver.
+
+No seaborn dependency: the environment pins to matplotlib, and every
+figure here is a line/bar/histogram matplotlib draws directly.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+import pandas as pd
+
+import matplotlib
+
+matplotlib.use("Agg", force=False)  # headless driver, like %%local on Jupyter
+import matplotlib.pyplot as plt  # noqa: E402
+
+
+def collect(source: Any) -> pd.DataFrame:
+    """The ``%%spark -o df`` verb: pull a result set driver-local as a
+    DataFrame.
+
+    Accepts:
+      * an experiment/run dir (or a ``metrics.jsonl`` path) — rows
+        ``(step, tag, value, time)``;
+      * a lagom result dict (``{"trials": {...}}`` from
+        ``search.drivers.lagom``) — one row per trial with its params
+        flattened as columns;
+      * a ``FeatureGroup`` (anything with ``.read()``) — the group's
+        rows, via its own offline read path;
+      * a DataFrame (returned as-is) or anything ``pd.DataFrame``
+        accepts (list of dicts, dict of columns).
+    """
+    if isinstance(source, pd.DataFrame):
+        return source
+    if hasattr(source, "read") and callable(source.read):
+        return source.read()
+    if isinstance(source, dict) and "trials" in source:
+        rows = []
+        for tid, t in source["trials"].items():
+            row = {"trial": tid, "metric": t.get("metric")}
+            row.update(t.get("params", {}))
+            rows.append(row)
+        return pd.DataFrame(rows)
+    if isinstance(source, (str, Path)):
+        from hops_tpu.runtime.logging import read_metrics
+
+        path = Path(source)
+        if path.is_dir():
+            path = path / "metrics.jsonl"
+        # read_metrics is the one reader for this stream (it tolerates
+        # the torn tail line of a live run).
+        return pd.DataFrame(read_metrics(path))
+    return pd.DataFrame(source)
+
+
+def _resolve_out(out: str | Path | None, default_name: str) -> Path:
+    """Default figure destination: ``<active run dir>/plots/<name>``,
+    the same place checkpoints and metric streams live — so a run's
+    figures travel with the run, like the reference's HDFS
+    ``Experiments`` dir artifacts."""
+    if out is None:
+        from hops_tpu.runtime import rundir
+
+        out = Path(rundir.logdir()) / "plots" / default_name
+    out = Path(out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    return out
+
+
+def plot_metrics(
+    run_dir: Any,
+    tags: list[str] | None = None,
+    out: str | Path | None = None,
+    logy: bool = False,
+) -> Path:
+    """Line plots of a run's scalar stream, one panel per tag
+    (loss curves, accuracy, throughput — whatever ``tensorboard.scalar``
+    logged). Returns the PNG path."""
+    df = collect(run_dir)
+    if df.empty:
+        raise ValueError(f"no metric events found in {run_dir!r}")
+    tags = tags or sorted(df["tag"].unique())
+    fig, axes = plt.subplots(
+        len(tags), 1, figsize=(8, 2.6 * len(tags)), sharex=True, squeeze=False
+    )
+    for ax, tag in zip(axes[:, 0], tags):
+        series = df[df["tag"] == tag].sort_values("step")
+        ax.plot(series["step"], series["value"], lw=1.2)
+        ax.set_ylabel(tag)
+        if logy:
+            ax.set_yscale("log")
+        ax.grid(True, alpha=0.3)
+    axes[-1, 0].set_xlabel("step")
+    fig.suptitle(f"run metrics — {Path(str(run_dir)).name}")
+    fig.tight_layout()
+    out = _resolve_out(out, "metrics.png")
+    fig.savefig(out, dpi=110)
+    plt.close(fig)
+    return out
+
+
+def plot_statistics(
+    stats_or_fg: Any,
+    out: str | Path | None = None,
+    max_features: int = 12,
+) -> Path:
+    """Feature-group statistics figure: per-feature mean ± stddev with
+    min/max whiskers, plus histogram panels for features whose
+    statistics config captured them. Accepts a statistics dict
+    (``fg.get_statistics()`` / ``compute_statistics``) or a
+    FeatureGroup (whose latest statistics are loaded). Returns the PNG
+    path."""
+    stats = stats_or_fg
+    if hasattr(stats_or_fg, "get_statistics"):
+        stats = stats_or_fg.get_statistics()
+    feats = {
+        name: e for name, e in (stats or {}).get("features", {}).items()
+        if "mean" in e
+    }
+    if not feats:
+        raise ValueError("no numeric feature statistics to plot "
+                         "(is the group's statistics_config enabled?)")
+    feats = dict(list(feats.items())[:max_features])
+    hists = {n: e["histogram"] for n, e in feats.items() if "histogram" in e}
+
+    n_hist_rows = -(-len(hists) // 3) if hists else 0
+    fig = plt.figure(figsize=(9, 3.2 + 2.2 * n_hist_rows))
+    gs = fig.add_gridspec(1 + n_hist_rows, 3)
+
+    ax = fig.add_subplot(gs[0, :])
+    names = list(feats)
+    means = np.array([feats[n]["mean"] for n in names])
+    stds = np.array([feats[n]["stddev"] for n in names])
+    lows = np.array([feats[n]["min"] for n in names])
+    highs = np.array([feats[n]["max"] for n in names])
+    x = np.arange(len(names))
+    ax.bar(x, means, yerr=stds, capsize=3, alpha=0.8)
+    ax.vlines(x, lows, highs, color="gray", lw=1, alpha=0.6)
+    ax.set_xticks(x)
+    ax.set_xticklabels(names, rotation=30, ha="right", fontsize=8)
+    ax.set_title(
+        f"feature statistics — {stats.get('row_count', '?')} rows "
+        "(bar: mean ± std, whisker: min–max)"
+    )
+    ax.grid(True, axis="y", alpha=0.3)
+
+    for i, (name, h) in enumerate(hists.items()):
+        hax = fig.add_subplot(gs[1 + i // 3, i % 3])
+        edges = np.asarray(h["edges"])
+        hax.bar(
+            edges[:-1], h["counts"], width=np.diff(edges),
+            align="edge", alpha=0.8,
+        )
+        hax.set_title(name, fontsize=9)
+        hax.tick_params(labelsize=7)
+
+    fig.tight_layout()
+    out = _resolve_out(out, "statistics.png")
+    fig.savefig(out, dpi=110)
+    plt.close(fig)
+    return out
+
+
+def plot_trials(
+    lagom_result: dict,
+    out: str | Path | None = None,
+) -> Path:
+    """Hyperparameter-search convergence: per-trial metric in completion
+    order with the best-so-far envelope — the figure the reference's
+    maggy printed as a table (SURVEY.md §2.4). Returns the PNG path."""
+    df = collect(lagom_result)
+    if "metric" in df:
+        df = df.dropna(subset=["metric"])  # failed trials have no score
+    if df.empty or "metric" not in df:
+        raise ValueError("no scored trials in lagom result")
+    direction = str(lagom_result.get("direction", "max")).lower()
+    vals = df["metric"].to_numpy(dtype=float)
+    best = (
+        np.maximum.accumulate(vals) if direction == "max"
+        else np.minimum.accumulate(vals)
+    )
+    fig, ax = plt.subplots(figsize=(8, 4))
+    ax.plot(np.arange(len(vals)), vals, "o", ms=4, alpha=0.7, label="trial")
+    ax.plot(np.arange(len(vals)), best, lw=1.5, label=f"best so far ({direction})")
+    ax.set_xlabel("trial (completion order)")
+    ax.set_ylabel(lagom_result.get("metric_name", "metric"))
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    ax.set_title(
+        f"search — {lagom_result.get('num_trials', len(vals))} trials, "
+        f"best {lagom_result.get('best_metric', best[-1]):.4g}"
+    )
+    fig.tight_layout()
+    out = _resolve_out(out, "trials.png")
+    fig.savefig(out, dpi=110)
+    plt.close(fig)
+    return out

@@ -121,7 +121,18 @@ _jsonable = scalarize
 
 
 def read_metrics(path: str | Path) -> list[dict[str, Any]]:
+    """Events from a run's ``metrics.jsonl``. Tolerates a torn tail
+    line: the stream is append-only and may be read while the run is
+    still writing (live dashboards, hops_tpu.plotting.collect)."""
     p = Path(path)
     if not p.exists():
         return []
-    return [json.loads(line) for line in p.read_text().splitlines() if line.strip()]
+    out = []
+    for line in p.read_text().splitlines():
+        if not line.strip():
+            continue
+        try:
+            out.append(json.loads(line))
+        except ValueError:
+            continue
+    return out

@@ -258,8 +258,20 @@ class TrialDriver:
             "best_metric": best.metric if best else None,
             "num_trials": len(results),
             "early_stopped": sum(r.stopped_early for r in results),
+            # direction + per-trial params travel with the summary so
+            # downstream tooling (hops_tpu.plotting.plot_trials /
+            # collect) can orient the best-so-far envelope and plot
+            # metric-vs-param without re-reading trial dirs.
+            "direction": self.direction,
             "trials": {
-                r.trial_id: {"metric": r.metric, "stopped_early": r.stopped_early}
+                r.trial_id: {
+                    "metric": r.metric,
+                    "stopped_early": r.stopped_early,
+                    "params": {
+                        k: v for k, v in r.params.items()
+                        if not k.startswith("_")
+                    },
+                }
                 for r in results
             },
         }

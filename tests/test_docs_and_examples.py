@@ -61,6 +61,15 @@ def test_lagom_search_inprocess():
     assert result["best_config"].keys() == {"kernel", "pool", "dropout"}
 
 
+def test_plotting_tour_inprocess():
+    from examples import plotting_tour
+
+    result = plotting_tour.main()
+    assert len(result["figures"]) == 3
+    for f in result["figures"]:
+        assert Path(f).read_bytes()[:4] == b"\x89PNG", f
+
+
 def test_iris_sklearn_python_predictor():
     from examples import iris_sklearn
 

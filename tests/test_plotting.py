@@ -1,0 +1,96 @@
+"""Driver-side plotting twin (matplotlib_sparkmagic.ipynb:61,87,95):
+collect() pulls each distributed result kind into a DataFrame; the
+plot_* helpers render real PNGs into the run dir."""
+
+import json
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from hops_tpu import plotting
+
+PNG_MAGIC = b"\x89PNG"
+
+
+def _metrics_dir(tmp_path, tags=("loss", "acc"), steps=20):
+    d = tmp_path / "run"
+    d.mkdir()
+    with (d / "metrics.jsonl").open("w") as f:
+        for step in range(steps):
+            for tag in tags:
+                f.write(json.dumps(
+                    {"step": step, "tag": tag, "value": 1.0 / (step + 1),
+                     "time": 0.0}
+                ) + "\n")
+        f.write("{torn")  # live-stream tail must be tolerated
+    return d
+
+
+def test_collect_metrics_dir_and_torn_line(tmp_path):
+    df = plotting.collect(_metrics_dir(tmp_path))
+    assert set(df["tag"]) == {"loss", "acc"}
+    assert len(df) == 40  # torn line dropped
+
+
+def test_collect_lagom_and_dataframe_passthrough():
+    res = {"trials": {"t0": {"metric": 0.5}, "t1": {"metric": None}}}
+    df = plotting.collect(res)
+    assert list(df["trial"]) == ["t0", "t1"]
+    same = pd.DataFrame({"a": [1]})
+    assert plotting.collect(same) is same
+
+
+def test_plot_metrics_renders_png(tmp_path):
+    out = plotting.plot_metrics(
+        _metrics_dir(tmp_path), out=tmp_path / "m.png"
+    )
+    assert out.read_bytes()[:4] == PNG_MAGIC
+
+
+def test_plot_statistics_from_feature_group(tmp_path):
+    import hops_tpu.featurestore as hsfs
+
+    fs = hsfs.connection().get_feature_store()
+    rs = np.random.RandomState(0)
+    fg = fs.create_feature_group(
+        "plot_stats_fg", version=1, primary_key=["pk"],
+        statistics_config={"enabled": True, "histograms": True},
+    )
+    fg.save(pd.DataFrame({"pk": np.arange(50), "x": rs.randn(50),
+                          "y": rs.gamma(2.0, 3.0, 50)}))
+    out = plotting.plot_statistics(fg, out=tmp_path / "s.png")
+    assert out.read_bytes()[:4] == PNG_MAGIC
+
+
+def test_plot_statistics_requires_numeric_stats(tmp_path):
+    with pytest.raises(ValueError, match="statistics"):
+        plotting.plot_statistics({"features": {}}, out=tmp_path / "x.png")
+
+
+def test_plot_trials_skips_failed_and_renders(tmp_path):
+    res = {
+        "best_metric": 0.9, "num_trials": 4, "direction": "max",
+        "trials": {
+            "t0": {"metric": 0.2}, "t1": {"metric": None},
+            "t2": {"metric": 0.9}, "t3": {"metric": 0.5},
+        },
+    }
+    out = plotting.plot_trials(res, out=tmp_path / "t.png")
+    assert out.read_bytes()[:4] == PNG_MAGIC
+
+
+def test_plot_defaults_into_run_dir(workspace):
+    """With out=None figures land in <active run dir>/plots — the
+    artifacts travel with the run like the reference's Experiments
+    dir."""
+    from hops_tpu.experiment import tensorboard
+    from hops_tpu.runtime import rundir
+
+    with rundir.activate(rundir.new_run("plotdemo")):
+        for step in range(5):
+            tensorboard.scalar(step, "loss", 1.0 / (step + 1))
+        tensorboard.flush()
+        out = plotting.plot_metrics(tensorboard.logdir())
+        assert out.read_bytes()[:4] == PNG_MAGIC
+        assert out.parent.name == "plots"
