@@ -499,7 +499,9 @@ def main() -> None:
         # not enough when a sitecustomize pre-imported jax — same
         # trick as tests/conftest.py.
         jax.config.update("jax_platforms", "cpu")
-        result = do_run(smoke=True)
+        # --smoke --multihost is the two-OS-process integration test's
+        # harness (launched via hops_tpu.launch on the fake mesh).
+        result = do_run(smoke=True, **({"multihost": True} if args.multihost else {}))
     elif args.multihost:
         # Multihost runs are launched one-process-per-host by
         # hops_tpu.launch against a real slice (no shared relay);

@@ -447,7 +447,19 @@ class _RunningServing:
                 # LM engine's dispatches, occupancy, prefix hits, and
                 # speculation acceptance.
                 try:
-                    if not self.path.rstrip("/").endswith(f"/v1/models/{name}"):
+                    # Exact TF-Serving routes only: /v1/models/<name>
+                    # and the versioned /v1/models/<name>/versions/<N>
+                    # form (a suffix match would accept arbitrary
+                    # prefixes like /junk/v1/models/<name>).
+                    path = self.path.rstrip("/")
+                    base = f"/v1/models/{name}"
+                    versioned = path.startswith(base + "/versions/")
+                    if versioned:
+                        ver = path[len(base) + len("/versions/"):]
+                        if ver != str(cfg.get("model_version", 1)):
+                            self._reply(404, {"error": f"unknown version {ver}"})
+                            return
+                    elif path != base:
                         self._reply(404, {"error": f"unknown path {self.path}"})
                         return
                     body: dict[str, Any] = {
@@ -466,7 +478,9 @@ class _RunningServing:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
-                    if not self.path.endswith(f"/v1/models/{name}:predict"):
+                    # Exact route, like do_GET: a suffix match would
+                    # accept /junk/v1/models/<name>:predict.
+                    if self.path.rstrip("/") != f"/v1/models/{name}:predict":
                         self._reply(404, {"error": f"unknown path {self.path}"})
                         return
                     instances = payload.get("instances")
@@ -551,7 +565,13 @@ def create_or_update(
         def int_list(x: Any, what: str) -> list[int]:
             out = []
             for t in np.asarray(x).reshape(-1):
-                i = int(t)
+                # Loud rejection with the field's name for BOTH failure
+                # shapes: non-integral numerics (int() succeeds but
+                # changes the value) and non-numerics (int() raises).
+                try:
+                    i = int(t)
+                except (TypeError, ValueError):
+                    raise ValueError(f"{what} must be integers, got {t!r}") from None
                 if i != t:
                     raise ValueError(f"{what} must be integers, got {t!r}")
                 out.append(i)

@@ -35,12 +35,15 @@ def test_dryrun_cannot_touch_a_poisoned_backend():
         cwd=REPO_ROOT,
         capture_output=True,
         text=True,
-        timeout=graft._DRYRUN_TIMEOUT_S + 60,
+        # dryrun_multichip(8) runs TWO sequential subprocesses (the
+        # 8-device matrix, then the 16-device v5e64 layout), each with
+        # its own _DRYRUN_TIMEOUT_S budget.
+        timeout=2 * graft._DRYRUN_TIMEOUT_S + 60,
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = proc.stdout
     for leg in ("dryrun_multichip ok", "pp ok", "pp+moe ok", "pp+sp ok",
-                "pp+ep ok", "dp+pp+tp ok"):
+                "pp+ep ok", "dp+pp+tp ok", "v5e64-layout ok"):
         assert leg in out, f"missing leg {leg!r} in:\n{out}"
 
 
@@ -56,21 +59,37 @@ def test_dryrun_always_self_provisions(monkeypatch):
     monkeypatch.delenv("HOPS_TPU_DRYRUN_NATIVE", raising=False)
     monkeypatch.setattr(graft.subprocess, "run", fake_run)
     graft.dryrun_multichip(16)
-    assert len(calls) == 1
+    assert len(calls) == 1  # 16 devices already cover the v5e64 leg
     cmd, kw = calls[0]
     assert "--xla_force_host_platform_device_count=16" in kw["env"]["XLA_FLAGS"]
     assert "jax_platforms', 'cpu'" in cmd[-1]
     assert kw["timeout"] == graft._DRYRUN_TIMEOUT_S
 
+    # Below 16 devices the v5e64 layout gets its own 16-device fake
+    # mesh: a second subprocess.
+    calls.clear()
+    graft.dryrun_multichip(8)
+    assert len(calls) == 2
+    cmd16, kw16 = calls[1]
+    assert "_leg_v5e64" in cmd16[-1]
+    assert "--xla_force_host_platform_device_count=16" in kw16["env"]["XLA_FLAGS"]
+
 
 def test_dryrun_native_escape_hatch(monkeypatch):
     """HOPS_TPU_DRYRUN_NATIVE=1 runs the body in-process (real
-    multi-device hosts opt in; tests already sit on the 8-dev mesh)."""
+    multi-device hosts opt in; tests already sit on the 8-dev mesh) —
+    but the 16-device v5e64 leg still validates via its backend-safe
+    fake-mesh subprocess."""
     monkeypatch.setenv("HOPS_TPU_DRYRUN_NATIVE", "1")
-    called = []
+    called, spawned = [], []
     monkeypatch.setattr(graft, "_dryrun_impl", lambda n: called.append(n))
+    monkeypatch.setattr(
+        graft.subprocess, "run",
+        lambda cmd, **kw: spawned.append(cmd) or subprocess.CompletedProcess(cmd, 0),
+    )
     graft.dryrun_multichip(8)
     assert called == [8]
+    assert len(spawned) == 1 and "_leg_v5e64" in spawned[0][-1]
 
 
 @pytest.mark.slow

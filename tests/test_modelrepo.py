@@ -82,6 +82,38 @@ class TestServing:
         with pytest.raises(RuntimeError):
             serving.make_inference_request("mnist-ffn", {"instances": []})
 
+    def test_status_routes_exact_and_versioned(self, tmp_path):
+        """TF-Serving status contract: the exact /v1/models/<name> path
+        and the versioned /versions/<N> form answer 200; prefix-padded
+        paths and wrong versions are 404 (a suffix match used to accept
+        /junk/v1/models/<name>)."""
+        import urllib.error
+        import urllib.request
+
+        script = tmp_path / "p.py"
+        script.write_text(
+            "class Predict:\n    def predict(self, instances):\n        return instances\n"
+        )
+        serving.create_or_update("routes", model_path=str(tmp_path), model_server="PYTHON")
+        serving.start("routes")
+        try:
+            base = serving._endpoint("routes")
+
+            def get(path):
+                with urllib.request.urlopen(base + path, timeout=30) as r:
+                    return json.loads(r.read())
+
+            ok = get("/v1/models/routes")
+            assert ok["model_version_status"][0]["state"] == "AVAILABLE"
+            ver = ok["model_version_status"][0]["version"]
+            assert get(f"/v1/models/routes/versions/{ver}") == ok
+            for bad in ("/junk/v1/models/routes", "/v1/models/routes/versions/999"):
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    get(bad)
+                assert e.value.code == 404
+        finally:
+            serving.stop("routes")
+
     def test_python_predictor(self, tmp_path):
         script = tmp_path / "predictor.py"
         script.write_text(

@@ -70,7 +70,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_collective_all_reduce(tmp_path):
+@pytest.mark.parametrize("n_proc", [2, 4])
+def test_multi_process_collective_all_reduce(tmp_path, n_proc):
+    """2- and 4-OS-process collective training (the 4-process case is
+    the smallest shape that exercises >2-host coordination — ring
+    topologies and barrier paths that a pair cannot, per the round-4
+    review's RUNBOOK-coverage gap)."""
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER)
     port = _free_port()
@@ -89,7 +94,7 @@ def test_two_process_collective_all_reduce(tmp_path):
                 sys.executable, "-m", "hops_tpu.launch",
                 "--platform", "cpu",
                 "--coordinator", f"127.0.0.1:{port}",
-                "--num-processes", "2",
+                "--num-processes", str(n_proc),
                 "--process-id", str(i),
                 str(worker),
             ],
@@ -99,15 +104,15 @@ def test_two_process_collective_all_reduce(tmp_path):
             text=True,
             cwd=str(Path(__file__).parent.parent),
         )
-        for i in range(2)
+        for i in range(n_proc)
     ]
     outs = [p.communicate(timeout=300)[0] for p in procs]
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
         assert "WORKER_OK" in out, out
-        assert "procs=2" in out and "replicas=4" in out, out
+        assert f"procs={n_proc}" in out and f"replicas={2 * n_proc}" in out, out
 
-    # Both hosts agreed on one session id → artifacts in ONE run dir.
+    # All hosts agreed on one session id → artifacts in ONE run dir.
     sessions = {line.split("session=")[1].split()[0]
                 for out in outs for line in out.splitlines() if "WORKER_OK" in line}
     assert len(sessions) == 1

@@ -160,15 +160,20 @@ def _cache_inputs(batch=2, heads=4, cap=512, d=64, dtype=jnp.float32):
     return k, v
 
 
+@pytest.mark.parametrize("block_bh", [1, 2])
 @pytest.mark.parametrize(
     "s,valid", [(1, 1), (1, 7), (1, 128), (1, 300), (4, 132), (16, 512), (5, 5)]
 )
-def test_decode_attention_matches_reference(s, valid):
+def test_decode_attention_matches_reference(s, valid, block_bh):
+    """block_bh > 1 groups (batch, kv-head) rows per grid step — the
+    per-group scratch views and union DMA clamp are separate indexing
+    from the default, so the knob gets its own parity coverage
+    (interpret mode exercises exactly that logic)."""
     from hops_tpu.ops.attention import decode_attention, decode_attention_reference
 
     k, v = _cache_inputs()
     q, _, _ = _inputs(batch=2, heads=4, seq=s, d=64, seed=2)
-    out = decode_attention(q, k, v, jnp.int32(valid), block_k=128)
+    out = decode_attention(q, k, v, jnp.int32(valid), block_k=128, block_bh=block_bh)
     ref = decode_attention_reference(q, k, v, jnp.int32(valid))
     np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
 
@@ -260,8 +265,9 @@ def test_quantize_kv_roundtrip_error_bound():
     assert bool(jnp.all(jnp.abs(back - x) <= bound))
 
 
+@pytest.mark.parametrize("block_bh", [1, 2])
 @pytest.mark.parametrize("s,valid", [(1, 1), (1, 129), (4, 260), (1, 512)])
-def test_decode_attention_q8_close_to_fp(s, valid):
+def test_decode_attention_q8_close_to_fp(s, valid, block_bh):
     from hops_tpu.ops.attention import (
         decode_attention_q8,
         decode_attention_reference,
@@ -272,7 +278,8 @@ def test_decode_attention_q8_close_to_fp(s, valid):
     q, _, _ = _inputs(batch=2, heads=4, seq=s, d=64, seed=3)
     kq, ks = quantize_kv(k)
     vq, vs = quantize_kv(v)
-    out = decode_attention_q8(q, kq, vq, ks, vs, jnp.int32(valid), block_k=128)
+    out = decode_attention_q8(q, kq, vq, ks, vs, jnp.int32(valid),
+                              block_k=128, block_bh=block_bh)
     ref = decode_attention_reference(q, k, v, jnp.int32(valid))
     np.testing.assert_allclose(out, ref, atol=0.05, rtol=0.05)
 
@@ -447,17 +454,20 @@ def test_decode_block_range_clamps_dma_to_valid_prefix():
 # -- ragged decode: per-row valid_len (continuous batching) ------------------
 
 
-def test_decode_attention_ragged_matches_per_row():
+@pytest.mark.parametrize("block_bh", [1, 2])
+def test_decode_attention_ragged_matches_per_row(block_bh):
     """A (b,) valid_len equals running each row alone with its scalar
     length — the continuous-batching contract, on both the kernel and
-    the XLA reference path."""
+    the XLA reference path. With block_bh > 1 the grouped DMA range is
+    the UNION of the rows' clamps (the ragged worst case for the
+    grouping), so the knob is covered where it matters most."""
     from hops_tpu.ops.attention import decode_attention, decode_attention_reference
 
     b = 4
     k, v = _cache_inputs(batch=b, heads=4, cap=512)
     q, _, _ = _inputs(batch=b, heads=4, seq=1, d=64, seed=2)
     vls = jnp.array([1, 77, 300, 512], jnp.int32)
-    out = decode_attention(q, k, v, vls, block_k=128)
+    out = decode_attention(q, k, v, vls, block_k=128, block_bh=block_bh)
     ref = decode_attention_reference(q, k, v, vls)
     for i in range(b):
         row = decode_attention(
