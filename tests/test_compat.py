@@ -5,6 +5,7 @@ citations inline) with only the import line changed.
 """
 
 import numpy as np
+import pytest
 
 from hops_tpu.compat import (
     dataset,
@@ -113,6 +114,7 @@ def test_maggy_lagom_cell():
     assert result["best_metric"] > 0
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_jobs_and_dataset_cells(tmp_path):
     """jobs_spark_client.py:44-54 flow via shims."""
     src = tmp_path / "ws"

@@ -43,6 +43,7 @@ def test_decode_logits_match_full_forward():
         np.testing.assert_allclose(logits[:, 0], full[:, t], atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_greedy_generation_is_deterministic_and_in_range():
     model, params = _model_and_params()
     prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 6), 0, 64)
@@ -54,6 +55,7 @@ def test_greedy_generation_is_deterministic_and_in_range():
     assert int(out1.max()) < 64 and int(out1.min()) >= 0
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_sampled_generation_respects_top_k():
     model, params = _model_and_params()
     prompt = jnp.zeros((1, 4), jnp.int32)
@@ -64,6 +66,7 @@ def test_sampled_generation_respects_top_k():
     assert out.shape == (1, 12)
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_generate_rejects_overflow():
     model, params = _model_and_params()
     prompt = jnp.zeros((1, 60), jnp.int32)
@@ -82,6 +85,7 @@ def test_generate_rejects_zero_new_tokens():
         generate(model, params, prompt, jax.random.PRNGKey(0), max_new_tokens=0)
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_moe_blocks_inherit_max_decode_len():
     """MoE layers' KV caches must size to the model's max_decode_len, not
     the MoEBlock default — otherwise decode past 2048 silently clamps."""
@@ -120,6 +124,7 @@ def test_long_prefill_kernel_path_matches_full_forward():
     assert bool(jnp.all(jnp.isfinite(step_logits)))
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_eos_masks_following_tokens_to_pad():
     """Once a row emits eos_id, every later position is pad_id; rows
     that never emit it are untouched (static shapes throughout)."""
@@ -152,6 +157,7 @@ def test_eos_masks_following_tokens_to_pad():
     np.testing.assert_array_equal(out[0, :4 + first_hit + 1], base[0, :4 + first_hit + 1])
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_eos_none_keeps_previous_behavior():
     model, params = _model_and_params()
     prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
@@ -429,6 +435,7 @@ def test_windowed_moe_decode_matches_full_forward():
         tok = jnp.argmax(step_logits[:, -1:], axis=-1)
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_speculative_sampled_is_lossless():
     """Rejection-sampling speculation must emit tokens distributed as
     the TARGET's filtered distribution regardless of the draft: with a
@@ -486,6 +493,7 @@ def test_speculative_sampled_is_lossless():
         )
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_speculative_sampled_perfect_draft_accepts_everything():
     """draft == target: u < min(1, p/q) = 1 always accepts, so every
     round advances k tokens — the while_loop runs ceil(new/k) rounds
@@ -512,6 +520,7 @@ def test_speculative_sampled_perfect_draft_accepts_everything():
 @pytest.mark.parametrize(
     "knobs", [{}, {"num_kv_heads": 2, "kv_cache_dtype": "int8"}]
 )
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_beam_search_k1_is_greedy(knobs):
     """beam_size=1 equals greedy generate — including through the GQA +
     int8-cache decode path (beam search rides the same cache)."""
@@ -529,6 +538,7 @@ def test_beam_search_k1_is_greedy(knobs):
     assert scores.shape == (2,) and np.all(np.asarray(scores) <= 0)
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_beam_search_finds_optimal_sequence():
     """With beam_size >= V^depth the search is exhaustive: its winner
     must equal the brute-force most-likely continuation."""
@@ -562,6 +572,7 @@ def test_beam_search_finds_optimal_sequence():
     assert abs(float(score[0]) - best_lp) < 1e-4
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_beam_search_eos_freezes_beam():
     """A beam that emits eos pads thereafter at frozen score. With
     beam_size=1 the beam IS the greedy path, so setting eos to the

@@ -14,6 +14,12 @@ from hops_tpu.models.generation import generate
 from hops_tpu.models.transformer import TransformerLM
 from hops_tpu.modelrepo.lm_engine import LMEngine
 
+# Every engine test compiles multiple per-instance programs (prefill
+# buckets + step variants) on 1-core CPU — the whole module is slow-tier
+# (round-5 re-tiering: the fast tier's budget is <3 min on 1 core;
+# coverage is unchanged across the two tiers combined).
+pytestmark = pytest.mark.slow
+
 TINY = dict(
     vocab_size=64, d_model=32, num_heads=4, num_layers=2,
     dtype=jnp.float32, attention_impl="reference", max_decode_len=64,

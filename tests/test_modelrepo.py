@@ -343,6 +343,7 @@ class TestStandaloneServing:
         assert serving.get_status("detached") == "Stopped"
         assert not serving._pid_alive(pid)  # host terminated by stop()
 
+    @pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
     def test_supervisor_restores_and_serves(self, tmp_path, workspace):
         import os
         import signal as sig

@@ -164,6 +164,7 @@ def _cache_inputs(batch=2, heads=4, cap=512, d=64, dtype=jnp.float32):
 @pytest.mark.parametrize(
     "s,valid", [(1, 1), (1, 7), (1, 128), (1, 300), (4, 132), (16, 512), (5, 5)]
 )
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_decode_attention_matches_reference(s, valid, block_bh):
     """block_bh > 1 groups (batch, kv-head) rows per grid step — the
     per-group scratch views and union DMA clamp are separate indexing
@@ -178,6 +179,7 @@ def test_decode_attention_matches_reference(s, valid, block_bh):
     np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_decode_attention_traced_valid_len_under_scan():
     """One compiled program serves every step: valid_len is a traced
     scalar riding the scan carry, the shapes never change."""
@@ -267,6 +269,7 @@ def test_quantize_kv_roundtrip_error_bound():
 
 @pytest.mark.parametrize("block_bh", [1, 2])
 @pytest.mark.parametrize("s,valid", [(1, 1), (1, 129), (4, 260), (1, 512)])
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_decode_attention_q8_close_to_fp(s, valid, block_bh):
     from hops_tpu.ops.attention import (
         decode_attention_q8,
@@ -455,6 +458,7 @@ def test_decode_block_range_clamps_dma_to_valid_prefix():
 
 
 @pytest.mark.parametrize("block_bh", [1, 2])
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_decode_attention_ragged_matches_per_row(block_bh):
     """A (b,) valid_len equals running each row alone with its scalar
     length — the continuous-batching contract, on both the kernel and
@@ -477,6 +481,7 @@ def test_decode_attention_ragged_matches_per_row(block_bh):
         np.testing.assert_allclose(ref[i : i + 1], row, atol=2e-6, rtol=2e-6)
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_decode_attention_ragged_zero_rows_output_zero():
     """vl == 0 marks a free slot: it attends nothing and outputs exact
     zeros (no NaN from the empty softmax), while live rows are
@@ -493,6 +498,7 @@ def test_decode_attention_ragged_zero_rows_output_zero():
     np.testing.assert_allclose(out[:1], alone, atol=2e-6, rtol=2e-6)
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_decode_attention_ragged_gqa_q8_window():
     """The ragged vector composes with every decode knob: GQA row
     folding, int8 cache, sliding window — against the per-row scalar
@@ -568,6 +574,7 @@ def test_decode_attention_ragged_traced_under_scan():
 # -- chunked-vocab cross-entropy (ops/xent.py) -------------------------------
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_chunked_xent_matches_optax_value_and_grad():
     import optax
 
@@ -613,6 +620,7 @@ def test_chunked_xent_never_materializes_full_logits():
     assert full not in text      # full logits never do
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_lm_train_step_loss_chunk_matches_dense_path():
     from hops_tpu.models import common
     from hops_tpu.models.transformer import TransformerLM, make_lm_train_step

@@ -185,6 +185,7 @@ class TestHybridMesh:
         assert np.isfinite(float(metrics["loss"]))
 
 
+@pytest.mark.slow  # whole-generate-loop shard_map compiles (round-5 re-tiering)
 class TestTPInference:
     """Tensor-parallel decoding: tp_generate == single-device generate,
     token for token, on a dense checkpoint sliced in place."""
@@ -255,6 +256,7 @@ class TestTPInference:
             lm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
 
 
+@pytest.mark.slow  # whole-generate-loop shard_map compiles (round-5 re-tiering)
 class TestTPSpeculative:
     """Tensor-parallel speculative decoding: tp_generate_speculative
     matches single-device generate_speculative token for token."""

@@ -108,6 +108,7 @@ def test_ring_attention_gqa_matches_repeated(seq_mesh, causal):
     np.testing.assert_allclose(out, ref, atol=3e-5, rtol=3e-5)
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_ring_attention_gqa_windowed(seq_mesh):
     from hops_tpu.ops.attention import repeat_kv
 

@@ -16,6 +16,7 @@ def _tokens(batch=2, seq=64, seed=0):
     return jax.random.randint(jax.random.PRNGKey(seed), (batch, seq), 0, TINY["vocab_size"])
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_forward_shape_and_dtype():
     model = TransformerLM(**TINY, attention_impl="reference")
     tokens = _tokens()
@@ -25,6 +26,7 @@ def test_forward_shape_and_dtype():
     assert logits.dtype == jnp.float32
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_train_step_reduces_loss():
     model = TransformerLM(**TINY, attention_impl="reference")
     state = common.create_train_state(
@@ -38,6 +40,7 @@ def test_train_step_reduces_loss():
     assert float(metrics["loss"]) < float(first["loss"])
 
 
+@pytest.mark.slow  # heavy jit compile (fast-tier budget: round-5 re-tiering)
 def test_flash_and_reference_impls_agree():
     tokens = _tokens(seq=128)
     ref = TransformerLM(**TINY, attention_impl="reference")
