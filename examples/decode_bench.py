@@ -331,7 +331,8 @@ def _continuous_bench(args) -> None:
         f"continuous batching ({len(requests)} ragged requests, "
         f"{slots} slots, {total_tokens} tokens):\n"
         f"  engine: {t_cont:.2f}s = {total_tokens / t_cont:7.0f} useful tokens/s "
-        f"({dispatches} decode dispatches{spec_note})\n"
+        f"({dispatches} decode dispatches, {engine.admission_waves} admission "
+        f"waves{spec_note})\n"
         f"  static: {t_stat:.2f}s = {total_tokens / t_stat:7.0f} useful tokens/s "
         f"({static_steps} padded steps, head-of-line + pad waste)\n"
         f"  speedup: {t_stat / t_cont:.2f}x"

@@ -15,11 +15,14 @@ TPU-shaped throughout:
   ``(slots,)`` vector (``TransformerLM(ragged_decode=True)``), so every
   slot advances independently and ``decode_attention`` masks/clamps
   each row's DMA by its own length (``ops/attention.py`` ragged path).
-- Exactly three compiled programs, all static-shape: *prefill* (one per
-  prompt-length bucket), *insert* (splice a prefilled b=1 cache into a
-  slot row), and *step* (one token for all slots). Admission and
-  completion are host-side bookkeeping — no recompiles at any request
-  mix.
+- A handful of compiled programs, all static-shape: *batched prefill*
+  (one per prompt-length bucket, full-slot batch with per-row ragged
+  true lengths — every request entering a free slot in the same
+  iteration shares ONE dispatch), *insert-batch* (one vectorized
+  masked merge into the persistent cache), the per-request *append*
+  (prefix-cache admissions), and *step* (one token for all slots).
+  Admission and completion are host-side bookkeeping — no recompiles
+  at any request mix.
 - Free slots stay in the batch: the step program clamps their cache
   index to 0 (an ``active`` mask), so a free row writes one position,
   attends one block, and its token is discarded host-side — noise,
@@ -361,7 +364,7 @@ class LMEngine:
         self._results: dict[int, list[int]] = {}
         self._next_ticket = 0
 
-        # --- the three compiled programs -------------------------------
+        # --- the compiled programs (see module docstring) ---------------
         def _admit_tail(logits, variables, true_len, end_len, temp, topk,
                         topp, seed, sampled, nucleus):
             """Shared tail of both admission programs: pick the last
@@ -448,6 +451,98 @@ class LMEngine:
                 ),
                 one,
             )
+
+        # -- batched admission --------------------------------------------
+        # Admission used to cost TWO dispatches PER REQUEST (b=1 prefill
+        # + row insert). On a dispatch-latency-bound link that tax
+        # dominates ragged workloads (measured: 84 ms/dispatch on the
+        # relay, HW step=decode_continuous — 24 of the 68+ dispatches
+        # were admissions). Now every request entering a free slot in
+        # the same engine iteration shares ONE full-slot-batch prefill
+        # (per-row ragged true lengths; un-admitted rows are zero
+        # prompts whose cache index rewinds to 0 = the free-slot
+        # convention) and ONE vectorized merge into the big cache.
+        # Compiles are keyed by (bucket, sampled, nucleus) only — batch
+        # is always `slots` — so the program count matches the old
+        # per-request path's.
+        @functools.partial(jax.jit, static_argnames=("sampled", "nucleus"))
+        def prefill_batch(params, padded, true_lens, temps, topks, topps,
+                          seeds, sampled=False, nucleus=False):
+            def body(params, padded, true_lens, temps, topks, topps, seeds):
+                logits, variables = local_model.apply(
+                    {"params": params}, padded, decode=True, mutable=["cache"]
+                )
+                last = jnp.take_along_axis(
+                    logits, jnp.maximum(true_lens - 1, 0)[:, None, None], axis=1
+                )[:, 0]
+                if sampled:
+                    toks = _sample_rows(
+                        last, temps, topks, topps, seeds,
+                        jnp.zeros((slots,), jnp.int32), use_top_p=nucleus,
+                    )
+                else:
+                    toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                # Pad garbage past each row's true length stays masked
+                # forever once idx rewinds (kernel invariant) — same as
+                # the per-request path.
+                cache = _map_cache(
+                    variables["cache"], lambda leaf: leaf,
+                    lambda idx: jnp.asarray(true_lens, idx.dtype),
+                )
+                return toks, cache
+
+            body = sharded(
+                body, (param_specs,) + (P(),) * 6, (P(), cache_specs)
+            )
+            return body(params, padded, true_lens, temps, topks, topps, seeds)
+
+        @functools.partial(jax.jit, static_argnames=("sampled", "nucleus"))
+        def spec_prefill_batch(params, dparams, padded, true_lens, temps,
+                               topks, topps, seeds, sampled=False,
+                               nucleus=False):
+            def body(params, dparams, padded, true_lens, temps, topks,
+                     topps, seeds):
+                logits, t_vars = local_model.apply(
+                    {"params": params}, padded, decode=True, mutable=["cache"]
+                )
+                _, d_vars = local_draft.apply(
+                    {"params": dparams}, padded, decode=True, mutable=["cache"]
+                )
+                last = jnp.take_along_axis(
+                    logits, jnp.maximum(true_lens - 1, 0)[:, None, None], axis=1
+                )[:, 0]
+                if sampled:
+                    toks = _sample_rows(
+                        last, temps, topks, topps, seeds,
+                        jnp.zeros((slots,), jnp.int32), use_top_p=nucleus,
+                    )
+                else:
+                    toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                rewind = lambda variables: _map_cache(  # noqa: E731
+                    variables["cache"], lambda leaf: leaf,
+                    lambda idx: jnp.asarray(true_lens, idx.dtype),
+                )
+                return toks, rewind(t_vars), rewind(d_vars)
+
+            body = sharded(
+                body, (param_specs, draft_param_specs) + (P(),) * 6,
+                (P(), cache_specs, draft_cache_specs),
+            )
+            return body(params, dparams, padded, true_lens, temps, topks,
+                        topps, seeds)
+
+        def insert_batch(big, rows_cache, admit, true_lens):
+            # One vectorized merge: the batched prefill's cache shares
+            # the big cache's full (slots, ...) shape, so admission is
+            # a masked where per leaf — no per-row dispatches.
+            def merge_kv(b, r):
+                m = admit.reshape((slots,) + (1,) * (b.ndim - 1))
+                return jnp.where(m, r, b)
+
+            def merge_idx(b_idx, r_idx):
+                return jnp.where(admit, jnp.asarray(true_lens, b_idx.dtype), b_idx)
+
+            return _map_cache(big, merge_kv, merge_idx, rows_cache)
 
         def _step_logits(params, cache, tokens, active):
             # Clamp free rows' cache index to 0 BEFORE the apply: the
@@ -850,6 +945,11 @@ class LMEngine:
 
         self._prefill = prefill
         self._append = append
+        self._prefill_batch = prefill_batch
+        self._spec_prefill_batch = (
+            spec_prefill_batch if draft_model is not None else None
+        )
+        self._insert_batch = jax.jit(insert_batch, donate_argnums=(0,))
         self._spec_prefill = (
             spec_prefill if draft_model is not None else None
         )
@@ -887,6 +987,9 @@ class LMEngine:
         self.dispatches = 0
         self.tokens_emitted = 0
         self.prefix_hits = 0
+        # Batched-admission telemetry: requests admitted / waves is the
+        # dispatch amortization factor (1.0 = no batching benefit).
+        self.admission_waves = 0
         # Speculation telemetry: accepted proposals / proposal slots
         # offered is the acceptance rate (how good the draft is).
         self.spec_accepted = 0
@@ -1001,12 +1104,20 @@ class LMEngine:
         boundaries, the standard latency/throughput trade). Returns
         tickets that finished this iteration."""
         finished = []
+        wave: list[tuple[int, _Request]] = []
         for row in range(self.slots):
             if self._slot_state[row] is None and self._queue:
                 req = self._queue.popleft()
-                done = self._admit(req, row)
-                if done is not None:
-                    finished.append(done)
+                if req.prefix is not None:
+                    # Prefix-append admissions keep the per-request
+                    # path: each starts from a different stored cache.
+                    done = self._admit(req, row)
+                    if done is not None:
+                        finished.append(done)
+                else:
+                    wave.append((row, req))
+        if wave:
+            finished.extend(self._admit_wave(wave))
         if not any(st is not None for st in self._slot_state):
             return finished
 
@@ -1215,6 +1326,7 @@ class LMEngine:
                 self.tokens_emitted / max(self.dispatches, 1), 3
             ),
             "prefix_hits": self.prefix_hits,
+            "admission_waves": self.admission_waves,
             "queued": len(self._queue),
             "slots_busy": sum(st is not None for st in self._slot_state),
             "slots": self.slots,
@@ -1246,27 +1358,115 @@ class LMEngine:
         return self.model.max_decode_len
 
     def _admit(self, req: _Request, row: int) -> int | None:
-        """Prefill ``req`` and splice it into slot ``row``. Returns the
-        ticket if the request finished at admission (budget of 1)."""
+        """Prefix-append admission: prefill ``req``'s suffix onto its
+        stored prefix cache and splice it into slot ``row``. Returns
+        the ticket if the request finished at admission (budget of 1).
+        Non-prefix requests go through :meth:`_admit_wave` (batched)."""
         L = req.prompt.size
-        if req.prefix is not None:
-            base_cache, base_len = req.prefix
-            bucket = min(self._bucket(L), self.model.max_decode_len - base_len)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :L] = req.prompt
-            first_tok, one_cache = self._append(
-                self.params, base_cache, jnp.asarray(padded),
-                jnp.int32(base_len), jnp.int32(L),
-                jnp.float32(req.temperature), jnp.int32(req.top_k),
-                jnp.float32(req.top_p), jnp.int32(req.seed),
-                sampled=req.temperature > 0,
-                nucleus=req.temperature > 0 and 0.0 < req.top_p < 1.0,
+        base_cache, base_len = req.prefix
+        bucket = min(self._bucket(L), self.model.max_decode_len - base_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :L] = req.prompt
+        first_tok, one_cache = self._append(
+            self.params, base_cache, jnp.asarray(padded),
+            jnp.int32(base_len), jnp.int32(L),
+            jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.float32(req.top_p), jnp.int32(req.seed),
+            sampled=req.temperature > 0,
+            nucleus=req.temperature > 0 and 0.0 < req.top_p < 1.0,
+        )
+        self.prefix_hits += 1
+        self._cache = self._insert(
+            self._cache, one_cache, jnp.int32(row), jnp.int32(base_len + L)
+        )
+        return self._register(row, req, int(first_tok))
+
+    def _admit_wave(self, wave: list[tuple[int, "_Request"]]) -> list[int]:
+        """Batched admission: ONE prefill dispatch + ONE cache merge for
+        every request entering a free slot this iteration (two more for
+        the draft on a speculative engine) — instead of two dispatches
+        per request. Output is identical to per-request admission: rows
+        are independent under causal attention, first tokens draw from
+        the same per-row (seed, n=0) keys, and un-admitted rows rewind
+        to index 0 (the free-slot convention).
+
+        The trade: the batched program materializes a transient
+        full-slot fresh cache, so peak HBM during a multi-request wave
+        is ~2× the persistent cache (target and, on speculative
+        engines, draft). Single-request waves — the trickle workload,
+        where batching buys nothing — take the b=1 per-request path
+        instead, which also keeps its memory profile."""
+        if len(wave) == 1:
+            row, req = wave[0]
+            done = self._admit_single(row, req)
+            return [done] if done is not None else []
+        caps = [self.model.max_decode_len]
+        if self.spec_k:
+            # The padded chunk must fit the SMALLER cache: the draft
+            # prefills the same bucket.
+            caps.append(self.draft_model.max_decode_len)
+        bucket = max(
+            min(self._bucket(req.prompt.size), *caps) for _, req in wave
+        )
+        padded = np.zeros((self.slots, bucket), np.int32)
+        true_lens = np.zeros((self.slots,), np.int32)
+        admit = np.zeros((self.slots,), bool)
+        temps = np.zeros((self.slots,), np.float32)
+        topks = np.zeros((self.slots,), np.int32)
+        topps = np.zeros((self.slots,), np.float32)
+        seeds = np.zeros((self.slots,), np.int32)
+        for row, req in wave:
+            L = req.prompt.size
+            padded[row, :L] = req.prompt
+            true_lens[row] = L
+            admit[row] = True
+            temps[row] = req.temperature
+            topks[row] = req.top_k
+            topps[row] = req.top_p
+            seeds[row] = req.seed
+        sampled = any(req.temperature > 0 for _, req in wave)
+        nucleus = any(
+            req.temperature > 0 and 0.0 < req.top_p < 1.0 for _, req in wave
+        )
+        args = (jnp.asarray(padded), jnp.asarray(true_lens),
+                jnp.asarray(temps), jnp.asarray(topks), jnp.asarray(topps),
+                jnp.asarray(seeds))
+        admit_v, lens_v = jnp.asarray(admit), jnp.asarray(true_lens)
+        if self.spec_k:
+            toks, t_rows, d_rows = self._spec_prefill_batch(
+                self.params, self.draft_params, *args,
+                sampled=sampled, nucleus=nucleus,
             )
-            total_len = base_len + L
-            self.prefix_hits += 1
-        elif self.spec_k:
-            # The padded prefill chunk must fit the SMALLER cache: the
-            # draft prefills the same bucket.
+            self._draft_cache = self._insert_batch(
+                self._draft_cache, d_rows, admit_v, lens_v
+            )
+        else:
+            toks, t_rows = self._prefill_batch(
+                self.params, *args, sampled=sampled, nucleus=nucleus,
+            )
+        self._cache = self._insert_batch(self._cache, t_rows, admit_v, lens_v)
+        self.admission_waves += 1
+        toks = np.asarray(toks)
+        finished = []
+        for row, req in wave:
+            done = self._register(row, req, int(toks[row]))
+            if done is not None:
+                finished.append(done)
+        return finished
+
+    def _admit_single(self, row: int, req: "_Request") -> int | None:
+        """b=1 admission for a one-request wave: two small dispatches,
+        no transient full-slot cache (see :meth:`_admit_wave`)."""
+        L = req.prompt.size
+        kwargs = dict(
+            sampled=req.temperature > 0,
+            nucleus=req.temperature > 0 and 0.0 < req.top_p < 1.0,
+        )
+        knobs = (jnp.float32(req.temperature), jnp.int32(req.top_k),
+                 jnp.float32(req.top_p), jnp.int32(req.seed))
+        if self.spec_k:
+            # The padded chunk must fit the SMALLER cache: the draft
+            # prefills the same bucket.
             bucket = min(
                 self._bucket(L), self.model.max_decode_len,
                 self.draft_model.max_decode_len,
@@ -1275,32 +1475,27 @@ class LMEngine:
             padded[0, :L] = req.prompt
             first_tok, one_cache, one_draft = self._spec_prefill(
                 self.params, self.draft_params, jnp.asarray(padded),
-                jnp.int32(L),
-                jnp.float32(req.temperature), jnp.int32(req.top_k),
-                jnp.float32(req.top_p), jnp.int32(req.seed),
-                sampled=req.temperature > 0,
-                nucleus=req.temperature > 0 and 0.0 < req.top_p < 1.0,
+                jnp.int32(L), *knobs, **kwargs,
             )
             self._draft_cache = self._insert(
                 self._draft_cache, one_draft, jnp.int32(row), jnp.int32(L)
             )
-            total_len = L
         else:
             bucket = min(self._bucket(L), self.model.max_decode_len)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :L] = req.prompt
             first_tok, one_cache = self._prefill(
-                self.params, jnp.asarray(padded), jnp.int32(L),
-                jnp.float32(req.temperature), jnp.int32(req.top_k),
-                jnp.float32(req.top_p), jnp.int32(req.seed),
-                sampled=req.temperature > 0,
-                nucleus=req.temperature > 0 and 0.0 < req.top_p < 1.0,
+                self.params, jnp.asarray(padded), jnp.int32(L), *knobs,
+                **kwargs,
             )
-            total_len = L
         self._cache = self._insert(
-            self._cache, one_cache, jnp.int32(row), jnp.int32(total_len)
+            self._cache, one_cache, jnp.int32(row), jnp.int32(L)
         )
-        tok = int(first_tok)
+        return self._register(row, req, int(first_tok))
+
+    def _register(self, row: int, req: "_Request", tok: int) -> int | None:
+        """Shared admission bookkeeping: record the first emitted token
+        and occupy (or immediately finish) the slot."""
         self.tokens_emitted += 1
         st = _SlotState(
             ticket=req.ticket,

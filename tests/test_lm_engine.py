@@ -777,6 +777,64 @@ def test_engine_speculative_mixed_sampling_keeps_greedy_exact():
     assert all(0 <= t < 64 for t in r[t1])
 
 
+def test_admission_wave_batches_prefills():
+    """All requests entering free slots in one iteration share ONE
+    prefill dispatch (admission_waves telemetry), and the batched path
+    emits exactly what per-request generate() would — including mixed
+    greedy/sampled waves and queueing into later waves."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    rs = np.random.RandomState(71)
+    prompts = [rs.randint(1, 64, (n,)) for n in (3, 9, 5, 2, 6, 4)]
+
+    engine = LMEngine(model, params, slots=4, prefill_buckets=(8, 16))
+    tickets = [engine.submit(p, max_new_tokens=5) for p in prompts[:4]]
+    engine.step()
+    assert engine.admission_waves == 1  # 4 admissions, ONE prefill dispatch
+    assert all(st is not None for st in engine._slot_state)
+
+    tickets += [engine.submit(p, max_new_tokens=5) for p in prompts[4:]]
+    results = engine.run()
+    assert engine.admission_waves >= 2  # later arrivals formed new waves
+    for p, t in zip(prompts, tickets):
+        ref = generate(
+            plain, params, jnp.asarray(p)[None], jax.random.PRNGKey(0),
+            max_new_tokens=5, temperature=0.0,
+        )
+        assert results[t] == list(np.asarray(ref[0, len(p):])), t
+    assert engine.stats()["admission_waves"] == engine.admission_waves
+
+
+def test_admission_wave_mixed_sampling():
+    """A MIXED greedy/sampled wave rides the sampled batched-prefill
+    program: greedy rows stay bit-identical to generate() (exact argmax
+    inside _sample_rows) and sampled rows reproduce by seed — two
+    identical sampled submissions in the same wave emit identically."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    rs = np.random.RandomState(72)
+    pg, ps = rs.randint(1, 64, (5,)), rs.randint(1, 64, (4,))
+
+    engine = LMEngine(model, params, slots=4, prefill_buckets=(8,))
+    tg = engine.submit(pg, max_new_tokens=6)
+    t1 = engine.submit(ps, max_new_tokens=6, temperature=0.9, top_p=0.9,
+                       seed=23)
+    t2 = engine.submit(ps, max_new_tokens=6, temperature=0.9, top_p=0.9,
+                       seed=23)
+    t3 = engine.submit(ps, max_new_tokens=6, temperature=0.7, top_k=12,
+                       seed=24)
+    engine.step()
+    assert engine.admission_waves == 1  # all four in one sampled wave
+    r = engine.run()
+    ref = generate(plain, params, jnp.asarray(pg)[None], jax.random.PRNGKey(0),
+                   max_new_tokens=6, temperature=0.0)
+    assert r[tg] == list(np.asarray(ref[0, 5:]))
+    assert r[t1] == r[t2]  # same seed, same wave -> identical
+    assert all(0 <= t < 64 for row in (r[t1], r[t3]) for t in row)
+
+
 def test_engine_speculative_horizon_matches_generate():
     """Speculation x decode_horizon (the high-RTT configuration: one
     dispatch buys up to horizon * spec_k tokens): greedy output must
