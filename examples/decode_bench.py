@@ -53,6 +53,11 @@ def main() -> None:
         "tokens per dispatch (greedy; only with --continuous)",
     )
     parser.add_argument(
+        "--offline", action="store_true",
+        help="drain via LMEngine.run_offline: one fused prefill+decode "
+        "dispatch per budget-sorted wave (only with --continuous)",
+    )
+    parser.add_argument(
         "--valid-sweep", action="store_true",
         help="time raw decode_attention vs valid_len at fixed capacity: "
         "flat times mean capacity-proportional DMA, linear-in-valid times "
@@ -82,6 +87,13 @@ def _dispatch(args, parser) -> None:
     from hops_tpu.models.transformer import TransformerLM
     from hops_tpu.runtime import diagnostics
 
+    if args.offline and (args.spec_k or args.horizon > 1):
+        # run_offline falls back to the ONLINE scheduler for
+        # speculative engines (and fuses by wave, ignoring horizon) —
+        # silently measuring that would mislabel the numbers.
+        parser.error("--offline measures the fused offline drain; it does "
+                     "not combine with --spec-k/--horizon (those are "
+                     "online-scheduler levers)")
     if args.valid_sweep:
         # Sweep-specific defaults (overridable): the round-4 sweep ran
         # at d_head 64 / cap 2048 — a 16 MB cache whose whole stream
@@ -291,7 +303,7 @@ def _continuous_bench(args) -> None:
         d0 = engine.dispatches
         for p, b in requests:
             engine.submit(p, max_new_tokens=b)
-        engine.run()
+        engine.run_offline() if args.offline else engine.run()
         return engine.dispatches - d0
 
     run_engine()  # compile (prefill buckets + step programs)

@@ -472,24 +472,13 @@ class LMEngine:
                 logits, variables = local_model.apply(
                     {"params": params}, padded, decode=True, mutable=["cache"]
                 )
-                last = jnp.take_along_axis(
-                    logits, jnp.maximum(true_lens - 1, 0)[:, None, None], axis=1
-                )[:, 0]
-                if sampled:
-                    toks = _sample_rows(
-                        last, temps, topks, topps, seeds,
-                        jnp.zeros((slots,), jnp.int32), use_top_p=nucleus,
-                    )
-                else:
-                    toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
                 # Pad garbage past each row's true length stays masked
                 # forever once idx rewinds (kernel invariant) — same as
                 # the per-request path.
-                cache = _map_cache(
-                    variables["cache"], lambda leaf: leaf,
-                    lambda idx: jnp.asarray(true_lens, idx.dtype),
+                return _batched_admit_tail(
+                    logits, variables, true_lens, temps, topks, topps,
+                    seeds, sampled, nucleus,
                 )
-                return toks, cache
 
             body = sharded(
                 body, (param_specs,) + (P(),) * 6, (P(), cache_specs)
@@ -508,21 +497,15 @@ class LMEngine:
                 _, d_vars = local_draft.apply(
                     {"params": dparams}, padded, decode=True, mutable=["cache"]
                 )
-                last = jnp.take_along_axis(
-                    logits, jnp.maximum(true_lens - 1, 0)[:, None, None], axis=1
-                )[:, 0]
-                if sampled:
-                    toks = _sample_rows(
-                        last, temps, topks, topps, seeds,
-                        jnp.zeros((slots,), jnp.int32), use_top_p=nucleus,
-                    )
-                else:
-                    toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
-                rewind = lambda variables: _map_cache(  # noqa: E731
-                    variables["cache"], lambda leaf: leaf,
+                toks, t_cache = _batched_admit_tail(
+                    logits, t_vars, true_lens, temps, topks, topps, seeds,
+                    sampled, nucleus,
+                )
+                d_cache = _map_cache(
+                    d_vars["cache"], lambda leaf: leaf,
                     lambda idx: jnp.asarray(true_lens, idx.dtype),
                 )
-                return toks, rewind(t_vars), rewind(d_vars)
+                return toks, t_cache, d_cache
 
             body = sharded(
                 body, (param_specs, draft_param_specs) + (P(),) * 6,
@@ -591,40 +574,74 @@ class LMEngine:
             return body(params, cache, tokens, active, temps, topks, topps,
                         seeds, ns)
 
+        def _decode_scan(params, cache, tok0, live0, n0, rem0, eos_ids,
+                         temps, topks, topps, seeds, *, horizon, sampled,
+                         nucleus):
+            """``horizon`` decode steps under one lax.scan with in-graph
+            retirement — THE single definition of the live-mask
+            semantics (budget decrement, emit-then-finish eos,
+            live-going-in output convention) that step_horizon,
+            offline_wave, and the host-side account() all rely on
+            staying bit-identical. Returns ((horizon, slots) tokens,
+            live-going-in mask, final cache)."""
+
+            def body(carry, _):
+                cache, tok, live, n, rem = carry
+                last, cache = _step_logits(params, cache, tok, live)
+                if sampled:
+                    nxt = _sample_rows(
+                        last, temps, topks, topps, seeds, n, use_top_p=nucleus
+                    )
+                else:
+                    nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                n2 = n + live.astype(jnp.int32)
+                rem2 = rem - live.astype(jnp.int32)
+                live2 = live & (rem2 > 0) & (nxt != eos_ids)
+                return (cache, nxt, live2, n2, rem2), (nxt, live)
+
+            (cache2, _, _, _, _), (toks, lives) = jax.lax.scan(
+                body, (cache, tok0, live0, n0, rem0), None, length=horizon
+            )
+            return toks, lives, cache2
+
+        def _batched_admit_tail(logits, variables, true_lens, temps, topks,
+                                topps, seeds, sampled, nucleus):
+            """Shared tail of every batched admission program: per-row
+            last-true-logit select, first-token draw (n=0 keys), and
+            cache-index rewind to each row's true length."""
+            last = jnp.take_along_axis(
+                logits, jnp.maximum(true_lens - 1, 0)[:, None, None], axis=1
+            )[:, 0]
+            if sampled:
+                tok0 = _sample_rows(
+                    last, temps, topks, topps, seeds,
+                    jnp.zeros((slots,), jnp.int32), use_top_p=nucleus,
+                )
+            else:
+                tok0 = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            cache = _map_cache(
+                variables["cache"], lambda leaf: leaf,
+                lambda idx: jnp.asarray(true_lens, idx.dtype),
+            )
+            return tok0, cache
+
         # Horizon program: ``horizon`` decode steps in ONE dispatch via
-        # lax.scan — the host-dispatch-latency amortization (measured
-        # on the relay: per-token dispatch cost ~84 ms RTT dominated
-        # engine throughput, BENCHMARKS.md "decode knobs, hardware").
-        # An in-graph ``live`` mask retires rows at their budget or
-        # eos: a dead row's cache index clamps to 0 (the free-slot
-        # convention), so caches can never overrun max_decode_len
-        # mid-horizon. Returns (horizon, slots) tokens plus the
-        # live-going-in mask saying which of them are real.
+        # the shared _decode_scan — the host-dispatch-latency
+        # amortization (measured on the relay: per-token dispatch cost
+        # ~84 ms RTT dominated engine throughput, BENCHMARKS.md "decode
+        # knobs, hardware"). A dead row's cache index clamps to 0 (the
+        # free-slot convention), so caches can never overrun
+        # max_decode_len mid-horizon.
         def step_horizon(params, cache, tokens, live0, rems, eos_ids,
                          temps, topks, topps, seeds, ns, *, horizon, sampled,
                          nucleus=False):
             def run(params, cache, tokens, live0, rems, eos_ids, temps,
                     topks, topps, seeds, ns):
-                def body(carry, _):
-                    cache, tok, live, n, rem = carry
-                    last, cache = _step_logits(params, cache, tok, live)
-                    if sampled:
-                        nxt = _sample_rows(
-                            last, temps, topks, topps, seeds, n,
-                            use_top_p=nucleus,
-                        )
-                    else:
-                        nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
-                    n2 = n + live.astype(jnp.int32)
-                    rem2 = rem - live.astype(jnp.int32)
-                    live2 = live & (rem2 > 0) & (nxt != eos_ids)
-                    return (cache, nxt, live2, n2, rem2), (nxt, live)
-
-                (cache2, _, _, _, _), (toks, lives) = jax.lax.scan(
-                    body, (cache, tokens, live0, ns, rems), None,
-                    length=horizon,
+                return _decode_scan(
+                    params, cache, tokens, live0, ns, rems, eos_ids,
+                    temps, topks, topps, seeds,
+                    horizon=horizon, sampled=sampled, nucleus=nucleus,
                 )
-                return toks, lives, cache2
 
             run = sharded(
                 run, (param_specs, cache_specs) + (P(),) * 9,
@@ -632,6 +649,45 @@ class LMEngine:
             )
             return run(params, cache, tokens, live0, rems, eos_ids, temps,
                        topks, topps, seeds, ns)
+
+        # Offline wave: the whole lifetime of `slots` requests — ragged
+        # prefill, first token, and the full decode scan with in-graph
+        # retirement — FUSED into one compiled program, one dispatch.
+        # This is the TPU-shaped answer to dispatch-latency-bound batch
+        # inference: the host contributes nothing between a wave's
+        # admission and its last token, so a W-wave workload costs W
+        # dispatches total (vs 2 admissions + ceil(budget/horizon)
+        # dispatches per wave online). Compiles key on
+        # (bucket, horizon, sampled, nucleus); run_offline buckets the
+        # horizon to powers of two so sorted workloads reuse programs.
+        def offline_wave(params, padded, true_lens, rems, eos_ids, temps,
+                         topks, topps, seeds, *, horizon, sampled,
+                         nucleus=False):
+            def run(params, padded, true_lens, rems, eos_ids, temps, topks,
+                    topps, seeds):
+                logits, variables = local_model.apply(
+                    {"params": params}, padded, decode=True, mutable=["cache"]
+                )
+                tok0, cache = _batched_admit_tail(
+                    logits, variables, true_lens, temps, topks, topps,
+                    seeds, sampled, nucleus,
+                )
+                admit = true_lens > 0  # zero-length rows pad the wave
+                rem0 = rems - admit.astype(jnp.int32)
+                live0 = admit & (rem0 > 0) & (tok0 != eos_ids)
+                toks, lives, _ = _decode_scan(
+                    params, cache, tok0, live0,
+                    jnp.ones((slots,), jnp.int32), rem0, eos_ids,
+                    temps, topks, topps, seeds,
+                    horizon=horizon, sampled=sampled, nucleus=nucleus,
+                )
+                return tok0, toks, lives
+
+            run = sharded(
+                run, (param_specs,) + (P(),) * 8, (P(), P(), P())
+            )
+            return run(params, padded, true_lens, rems, eos_ids, temps,
+                       topks, topps, seeds)
 
         @functools.partial(jax.jit, static_argnames=("sampled", "nucleus"))
         def spec_prefill(params, dparams, padded_prompt, true_len, temp,
@@ -950,6 +1006,9 @@ class LMEngine:
             spec_prefill_batch if draft_model is not None else None
         )
         self._insert_batch = jax.jit(insert_batch, donate_argnums=(0,))
+        self._offline_wave = jax.jit(
+            offline_wave, static_argnames=("horizon", "sampled", "nucleus")
+        )
         self._spec_prefill = (
             spec_prefill if draft_model is not None else None
         )
@@ -1293,6 +1352,109 @@ class LMEngine:
         while self._queue or any(st is not None for st in self._slot_state):
             self.step()
         return dict(self._results)
+
+    def run_offline(self) -> dict[int, list[int]]:
+        """Drain every queued request in budget-sorted slot-waves, ONE
+        fused prefill+decode dispatch per wave.
+
+        The batch-inference shape (all requests known upfront — the
+        reference's batch-inference role, SURVEY §2.5) doesn't need the
+        online scheduler's admit/decode cadence: each wave's whole
+        lifetime runs device-side, so a W-wave workload costs W
+        dispatches total — on a dispatch-latency-bound link this is the
+        difference between losing and winning against monolithic static
+        batching, while still doing strictly less padded compute
+        (budget-sorted waves pad to the WAVE's max budget, not the
+        global max; finished rows idle only to their wave's end).
+        Output is identical to :meth:`run` / per-request ``generate``
+        (sampled rows are placement-independent, so re-grouping by
+        budget changes nothing). Transient memory: one fresh full-slot
+        cache per wave (the persistent cache is untouched), same ~2×
+        peak as a multi-request admission wave.
+
+        Speculative engines, queued prefix requests, and drains started
+        mid-decode fall back to :meth:`run` (the online scheduler).
+        """
+        if (
+            self.spec_k
+            or any(r.prefix is not None for r in self._queue)
+            or any(st is not None for st in self._slot_state)
+        ):
+            return self.run()
+        # Budget-major sort: uniform budgets per wave minimize the scan
+        # steps finished rows idle through; bucket-minor keeps prompt
+        # padding tight. The sorted requests go BACK into the queue and
+        # pop per wave, so an exception mid-drain (OOM on a new shape,
+        # interrupt on a slow link) leaves every unprocessed request
+        # queued and retryable — same contract as run().
+        self._queue = collections.deque(sorted(
+            self._queue,
+            key=lambda r: (r.max_new_tokens, self._bucket(r.prompt.size)),
+            reverse=True,
+        ))
+        while self._queue:
+            wave = [
+                self._queue.popleft()
+                for _ in range(min(self.slots, len(self._queue)))
+            ]
+            try:
+                self._run_offline_wave(wave)
+            except BaseException:
+                self._queue.extendleft(reversed(wave))
+                raise
+        return dict(self._results)
+
+    def _run_offline_wave(self, wave: list["_Request"]) -> None:
+        """One fused offline dispatch for ``wave`` + host bookkeeping."""
+        bucket = max(
+            min(self._bucket(r.prompt.size), self.model.max_decode_len)
+            for r in wave
+        )
+        padded = np.zeros((self.slots, bucket), np.int32)
+        true_lens = np.zeros((self.slots,), np.int32)
+        rems = np.zeros((self.slots,), np.int32)
+        eos_ids = np.full((self.slots,), -1, np.int32)
+        temps = np.zeros((self.slots,), np.float32)
+        topks = np.zeros((self.slots,), np.int32)
+        topps = np.zeros((self.slots,), np.float32)
+        seeds = np.zeros((self.slots,), np.int32)
+        for row, r in enumerate(wave):
+            L = r.prompt.size
+            padded[row, :L] = r.prompt
+            true_lens[row] = L
+            rems[row] = r.max_new_tokens
+            if r.eos_id is not None:
+                eos_ids[row] = r.eos_id
+            temps[row] = r.temperature
+            topks[row] = r.top_k
+            topps[row] = r.top_p
+            seeds[row] = r.seed
+        maxrem = max(r.max_new_tokens for r in wave) - 1
+        # Power-of-two horizons bound the compile count; extra scan
+        # steps past the wave's last live row are all-dead idles.
+        horizon = 1 << (maxrem - 1).bit_length() if maxrem > 0 else 0
+        sampled = any(r.temperature > 0 for r in wave)
+        nucleus = any(
+            r.temperature > 0 and 0.0 < r.top_p < 1.0 for r in wave
+        )
+        tok0, toks, lives = self._offline_wave(
+            self.params, jnp.asarray(padded), jnp.asarray(true_lens),
+            jnp.asarray(rems), jnp.asarray(eos_ids), jnp.asarray(temps),
+            jnp.asarray(topks), jnp.asarray(topps), jnp.asarray(seeds),
+            horizon=horizon, sampled=sampled, nucleus=nucleus,
+        )
+        self.dispatches += 1
+        self.admission_waves += 1
+        tok0 = np.asarray(tok0)
+        toks, lives = np.asarray(toks), np.asarray(lives)
+        for row, r in enumerate(wave):
+            # live-going-in is a monotone true->false prefix per row, so
+            # the real tokens are exactly the first sum(lives) scan
+            # outputs — no per-token host loop.
+            cnt = int(lives[:, row].sum()) if horizon else 0
+            out = [int(tok0[row])] + toks[:cnt, row].astype(int).tolist()
+            self.tokens_emitted += len(out)
+            self._results[r.ticket] = out
 
     def result(self, ticket: int) -> list[int] | None:
         """Generated tokens (prompt excluded) or None if not finished."""

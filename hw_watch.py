@@ -59,6 +59,12 @@ STEPS: list[tuple[str, list[str]]] = [
                                    "--continuous", "--batch", "4", "--tokens",
                                    "32", "--layers", "4", "--spec-k", "4",
                                    "--horizon", "4"]),
+    # Offline drain: one fused dispatch per budget-sorted wave — the
+    # batch-inference configuration built to beat static batching on a
+    # dispatch-latency-bound link.
+    ("decode_continuous_offline", [sys.executable, "examples/decode_bench.py",
+                                   "--continuous", "--offline", "--batch", "4",
+                                   "--tokens", "32", "--layers", "4"]),
     # LM training headline (round-4 review item #4): tokens/s/chip + MFU.
     ("lm_bench", [sys.executable, "bench.py", "--lm", "--no-probe"]),
     # Fresh driver-style headline artifact (compile cache warm: ~70 s).

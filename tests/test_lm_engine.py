@@ -806,6 +806,51 @@ def test_admission_wave_batches_prefills():
     assert engine.stats()["admission_waves"] == engine.admission_waves
 
 
+def test_engine_run_offline_matches_generate():
+    """Offline drain: one fused prefill+decode dispatch per budget-
+    sorted wave, output identical to per-request generate() through
+    ragged budgets, eos truncation, budget-1, and sampled rows
+    (placement-independent keys make the re-grouping invisible)."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    rs = np.random.RandomState(81)
+    prompts = [rs.randint(1, 64, (n,)) for n in (3, 9, 5, 2, 6, 4)]
+    budgets = [7, 1, 12, 4, 9, 5]
+
+    # An eos that actually fires inside one rollout.
+    roll = generate(plain, params, jnp.asarray(prompts[2])[None],
+                    jax.random.PRNGKey(0), max_new_tokens=12, temperature=0.0)
+    gen = [int(x) for x in np.asarray(roll[0, len(prompts[2]):])]
+    eos = gen[4]
+
+    engine = LMEngine(model, params, slots=2, prefill_buckets=(8, 16))
+    tickets = [
+        engine.submit(p, max_new_tokens=b, eos_id=eos if i == 2 else None)
+        for i, (p, b) in enumerate(zip(prompts, budgets))
+    ]
+    ts = engine.submit(prompts[0], max_new_tokens=6, temperature=0.8,
+                       top_p=0.9, seed=31)
+    d0 = engine.dispatches
+    results = engine.run_offline()
+    assert engine.dispatches - d0 == -(-7 // 2)  # one dispatch per wave
+
+    for i, (p, b, t) in enumerate(zip(prompts, budgets, tickets)):
+        ref = generate(
+            plain, params, jnp.asarray(p)[None], jax.random.PRNGKey(0),
+            max_new_tokens=b, temperature=0.0,
+        )
+        expect = [int(x) for x in np.asarray(ref[0, len(p):])]
+        if i == 2:
+            expect = expect[: expect.index(eos) + 1]
+        assert results[t] == expect, (i, results[t], expect)
+    # The sampled row reproduces independently of offline re-grouping.
+    eng2 = LMEngine(model, params, slots=2, prefill_buckets=(8, 16))
+    t2 = eng2.submit(prompts[0], max_new_tokens=6, temperature=0.8,
+                     top_p=0.9, seed=31)
+    assert results[ts] == eng2.run()[t2]
+
+
 def test_admission_wave_mixed_sampling():
     """A MIXED greedy/sampled wave rides the sampled batched-prefill
     program: greedy rows stay bit-identical to generate() (exact argmax
