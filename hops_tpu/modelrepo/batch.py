@@ -82,3 +82,44 @@ def predict_with_model(
     return batch_predict(
         lambda x: module.apply(variables, x, train=False), inputs, per_chip_batch
     )
+
+
+def lm_generate_with_model(
+    name: str,
+    prompts: list,
+    max_new_tokens: int | list[int] = 32,
+    version: int | None = None,
+    slots: int = 8,
+    eos_id: int | None = None,
+    **sampling: Any,
+) -> list[list[int]]:
+    """LM batch inference from the registry: generate for every prompt
+    via :meth:`LMEngine.run_offline` — budget-sorted slot-waves, ONE
+    fused prefill+decode dispatch per wave (the §2.5 batch-inference
+    role for language models; classifiers use
+    :func:`predict_with_model`). ``max_new_tokens`` may be per-prompt.
+    ``sampling`` forwards per-request knobs (temperature, top_k, top_p,
+    seed). Returns generated token lists aligned with ``prompts``."""
+    from hops_tpu.modelrepo import registry
+    from hops_tpu.modelrepo.lm_engine import LMEngine
+
+    # Validate budgets BEFORE the checkpoint load / engine cache build:
+    # bad input should fail in microseconds, not after a multi-GB
+    # unpickle. np.ndim handles list/tuple/ndarray/scalar uniformly.
+    if np.ndim(max_new_tokens) == 0:
+        budgets = [int(max_new_tokens)] * len(prompts)
+    else:
+        budgets = [int(b) for b in np.asarray(max_new_tokens).reshape(-1)]
+    if len(budgets) != len(prompts):
+        raise ValueError(
+            f"{len(budgets)} budgets for {len(prompts)} prompts"
+        )
+    bundle = registry.load_flax(name, version)
+    module = bundle["module"].clone(ragged_decode=True)
+    engine = LMEngine(module, bundle["params"], slots=slots)
+    tickets = [
+        engine.submit(p, max_new_tokens=b, eos_id=eos_id, **sampling)
+        for p, b in zip(prompts, budgets)
+    ]
+    results = engine.run_offline()
+    return [results[t] for t in tickets]

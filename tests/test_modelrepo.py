@@ -230,6 +230,38 @@ class TestBatchInference:
         direct = np.asarray(apply_fn(jnp.asarray(inputs)))
         np.testing.assert_allclose(preds, direct, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow  # TransformerLM compiles (round-5 re-tiering)
+    def test_lm_generate_with_model_offline(self):
+        """LM batch inference from the registry rides the offline drain
+        and matches per-request generate() (ragged per-prompt budgets,
+        registry round-trip included)."""
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        from hops_tpu.models.generation import generate
+        from hops_tpu.models.transformer import TransformerLM
+
+        kw = dict(vocab_size=64, d_model=32, num_heads=4, num_layers=2,
+                  dtype=_jnp.float32, attention_impl="reference",
+                  max_decode_len=64)
+        plain = TransformerLM(**kw)
+        params = plain.init(
+            _jax.random.PRNGKey(0), _jnp.zeros((1, 8), _jnp.int32)
+        )["params"]
+        registry.save_flax(plain, params, "batch-lm", metrics={"loss": 1.0})
+
+        rs = np.random.RandomState(91)
+        prompts = [rs.randint(1, 64, (n,)) for n in (3, 7, 5)]
+        budgets = [6, 3, 8]
+        outs = batch.lm_generate_with_model(
+            "batch-lm", prompts, max_new_tokens=budgets, slots=2
+        )
+        for p, b, out in zip(prompts, budgets, outs):
+            ref = generate(plain, params, _jnp.asarray(p)[None],
+                           _jax.random.PRNGKey(0), max_new_tokens=b,
+                           temperature=0.0)
+            assert out == list(np.asarray(ref[0, len(p):]))
+
     def test_predict_with_model(self, trained_ffn):
         model, params = trained_ffn
         registry.save_flax(model, params, "batch-model")
