@@ -80,7 +80,25 @@ def main(n_images: int = 300, per_chip_batch: int = 32) -> dict:
         f"{n_images} images in chunks of {per_chip_batch}/chip -> "
         f"{len(readback)} predictions at {dest}"
     )
-    return {"rows": len(readback), "version": best["version"], "path": dest}
+
+    # The LM counterpart: registry LM -> offline continuous batching
+    # (budget-sorted waves, one fused prefill+decode dispatch each —
+    # modelrepo.batch.lm_generate_with_model / LMEngine.run_offline).
+    from hops_tpu.models.transformer import TransformerLM
+
+    lm = TransformerLM(vocab_size=64, d_model=32, num_heads=4, num_layers=2,
+                       dtype=jnp.float32, attention_impl="reference",
+                       max_decode_len=64)
+    lm_params = lm.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))["params"]
+    registry.save_flax(lm, lm_params, "batch-lm-demo", metrics={"loss": 1.0})
+    prompts = [rng.randint(1, 64, (n,)) for n in (4, 7, 3)]
+    gens = batch.lm_generate_with_model(
+        "batch-lm-demo", prompts, max_new_tokens=[6, 4, 8], slots=2
+    )
+    print(f"LM batch generate: {[len(g) for g in gens]} tokens per prompt "
+          "(offline waves)")
+    return {"rows": len(readback), "version": best["version"], "path": dest,
+            "lm_generated": [len(g) for g in gens]}
 
 
 if __name__ == "__main__":

@@ -2,14 +2,19 @@
 
 Runs, sequentially and with NO timeouts or kills (a killed client wedges
 the relay — BENCHMARKS.md operational note), every measurement the round
-needs on real hardware:
+needs on real hardware, under the relay lock:
 
-  1. relay health probe (kill-safe subprocess, bench.py --probe)
-  2. decode_bench: base / int8 / GQA / window / int8+GQA+window
-  3. decode_bench --valid-sweep (valid-length-proportional DMA check)
-  4. headline ResNet-50 bench (bench.py), then its --remat A/B — LAST,
-     because the relay has wedged itself on ResNet-sized compiles; the
-     small decode measurements must already be banked by then
+  1. relay health probe (kill-safe subprocess, bench.py --probe); the
+     sweep aborts here if the relay is wedged
+  2. the shared round-5 queue (hw_steps.MEASUREMENT_STEPS — the same
+     list hw_watch.py runs on recovery): int8 + composite decode knobs,
+     the 16k valid-sweep, the continuous-batching A/Bs
+     (h1/h8/spec/spec x h4/offline), then the two LARGE compiles last —
+     bench.py --lm (~180M-param LM training headline) and the ResNet-50
+     driver bench — because the relay has wedged itself on big compiles
+     and the small decode evidence must already be banked by then
+  3. bench-only extras: bf16/GQA/window decode baselines and the
+     ResNet --remat A/B
 
 Each step's stdout+stderr and wall time append to HW_MEASURE.jsonl so a
 later session (or a human) can transcribe the numbers into
@@ -31,23 +36,19 @@ from pathlib import Path
 ROOT = Path(__file__).parent
 OUT = ROOT / "HW_MEASURE.jsonl"
 
-# Small compiles FIRST: the relay has twice answered a ResNet-50-sized
-# compile with a 25-min UNAVAILABLE and wedged itself afterwards
-# (HW_MEASURE.jsonl 2026-07-31), so the decode measurements — tiny
-# TransformerLM programs — must be banked before the big compile gets
-# a chance to take the relay down.
+from hw_steps import MEASUREMENT_STEPS
+
+# probe first (abort the sweep against a wedged relay), then the shared
+# round-5 queue (hw_steps.py — same definition the watcher runs; its
+# internal order banks small decode compiles before the wedge-prone
+# large ones), then the lowest-priority extras LAST: re-confirmations
+# of rows that already have green round-4 artifacts.
 STEPS: list[tuple[str, list[str]]] = [
     ("probe", [sys.executable, "bench.py", "--probe"]),
+    *MEASUREMENT_STEPS,
     ("decode_base", [sys.executable, "examples/decode_bench.py"]),
-    ("decode_int8", [sys.executable, "examples/decode_bench.py", "--kv-dtype", "int8"]),
     ("decode_gqa", [sys.executable, "examples/decode_bench.py", "--kv-heads", "2"]),
     ("decode_window", [sys.executable, "examples/decode_bench.py", "--window", "256"]),
-    ("decode_all_knobs", [sys.executable, "examples/decode_bench.py",
-                          "--kv-dtype", "int8", "--kv-heads", "2", "--window", "256"]),
-    ("valid_sweep", [sys.executable, "examples/decode_bench.py", "--valid-sweep"]),
-    ("decode_continuous", [sys.executable, "examples/decode_bench.py", "--continuous",
-                           "--batch", "4", "--tokens", "32", "--layers", "4"]),
-    ("resnet50_bench", [sys.executable, "bench.py", "--no-probe"]),
     ("resnet50_bench_remat", [sys.executable, "bench.py", "--no-probe", "--remat"]),
 ]
 

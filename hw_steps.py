@@ -1,0 +1,56 @@
+"""The round-5 hardware measurement queue — ONE definition, imported by
+both `hw_measure.py` (run-now sweep) and `hw_watch.py` (run-on-recovery
+watcher), so the two entry points can never drift apart and log
+different configurations under the same HW_MEASURE.jsonl step names.
+
+Ordering rule: small compiles FIRST — the relay has twice wedged
+itself on big (ResNet/LM-sized) compiles, so the decode evidence must
+be banked before the large compiles get a chance to take it down.
+"""
+
+from __future__ import annotations
+
+import sys
+
+_DB = "examples/decode_bench.py"
+
+#: (step name, argv) — every currently-unlogged round-4 claim gains an
+#: HW_MEASURE.jsonl line (round-4 review item #1a), plus the round-5
+#: engine levers.
+MEASUREMENT_STEPS: list[tuple[str, list[str]]] = [
+    # int8 decode kernel: both round-4 logged attempts failed Mosaic
+    # lowering; the fix (4155d33) has no logged artifact.
+    ("decode_int8", [sys.executable, _DB, "--kv-dtype", "int8"]),
+    # The composite the cache-bytes story is sold on — never logged green.
+    ("decode_all_knobs", [sys.executable, _DB, "--kv-dtype", "int8",
+                          "--kv-heads", "2", "--window", "256"]),
+    # O(valid) DMA-clamp evidence at shapes where the effect clears the
+    # ~1 ms dispatch floor (round-5 defaults: d_head 128, cap 16k,
+    # fixed-valid capacity control row).
+    ("valid_sweep", [sys.executable, _DB, "--valid-sweep"]),
+    # Continuous-batching A/Bs: engine vs static, then the dispatch-
+    # floor levers (decode horizon, speculation, their composition,
+    # and the fused offline drain).
+    ("decode_continuous_h1", [sys.executable, _DB, "--continuous",
+                              "--batch", "4", "--tokens", "32",
+                              "--layers", "4"]),
+    ("decode_continuous_h8", [sys.executable, _DB, "--continuous",
+                              "--batch", "4", "--tokens", "32",
+                              "--layers", "4", "--horizon", "8"]),
+    ("decode_continuous_spec", [sys.executable, _DB, "--continuous",
+                                "--batch", "4", "--tokens", "32",
+                                "--layers", "4", "--spec-k", "4"]),
+    ("decode_continuous_spec_h4", [sys.executable, _DB, "--continuous",
+                                   "--batch", "4", "--tokens", "32",
+                                   "--layers", "4", "--spec-k", "4",
+                                   "--horizon", "4"]),
+    ("decode_continuous_offline", [sys.executable, _DB, "--continuous",
+                                   "--offline", "--batch", "4",
+                                   "--tokens", "32", "--layers", "4"]),
+    # LM training headline (round-4 review item #4): tokens/s/chip +
+    # MFU% at ~180M params — a LARGE compile, so it sits after the
+    # decode evidence is banked.
+    ("lm_bench", [sys.executable, "bench.py", "--lm", "--no-probe"]),
+    # Fresh driver-style headline artifact (compile cache warm: ~70 s).
+    ("resnet50_bench", [sys.executable, "bench.py", "--no-probe"]),
+]

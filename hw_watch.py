@@ -1,9 +1,7 @@
-"""Relay-recovery watcher: probe periodically, then run queued hardware
-measurements exactly once.
+"""Relay-recovery watcher: probe periodically, then run the shared
+round-5 measurement queue (``hw_steps.MEASUREMENT_STEPS`` — one
+definition with ``hw_measure.py``) exactly once, under the relay lock.
 
-The queue: the decode-horizon continuous-batching A/B, the speculative
-engine A/B, and the post-fix int8 decode re-run (the rest of the
-round-4 agenda was banked by ``hw_measure.py`` — `HW_MEASURE.jsonl`).
 Measurements run with NO timeout and are never killed: a SIGTERM'd
 client is what wedges the single-tenant relay in the first place
 (BENCHMARKS.md relay incident log).
@@ -24,52 +22,9 @@ ROOT = Path(__file__).parent
 OUT = ROOT / "HW_MEASURE.jsonl"
 PROBE_EVERY_S = 900
 
-# Round-5 queue (round-4 review item #1a): every currently-unlogged
-# claim gains an HW_MEASURE.jsonl line. Small compiles first — the
-# relay has wedged itself on big compiles, so the decode evidence must
-# be banked before the LM/ResNet compiles get a chance to take it down.
-STEPS: list[tuple[str, list[str]]] = [
-    # int8 decode kernel: both round-4 logged attempts failed Mosaic
-    # lowering; the fix (4155d33) has no logged artifact.
-    ("decode_int8", [sys.executable, "examples/decode_bench.py",
-                     "--kv-dtype", "int8"]),
-    # The composite the cache-bytes story is sold on — never logged green.
-    ("decode_all_knobs", [sys.executable, "examples/decode_bench.py",
-                          "--kv-dtype", "int8", "--kv-heads", "2",
-                          "--window", "256"]),
-    # O(valid) DMA-clamp evidence at shapes where the effect clears the
-    # ~1 ms dispatch floor (new defaults: d_head 128, cap 16k, fixed-
-    # valid capacity control row).
-    ("valid_sweep", [sys.executable, "examples/decode_bench.py",
-                     "--valid-sweep"]),
-    # Continuous-batching A/Bs: engine vs static, then the dispatch-
-    # floor levers (decode horizon, speculative decoding).
-    ("decode_continuous_h1", [sys.executable, "examples/decode_bench.py",
-                              "--continuous", "--batch", "4", "--tokens", "32",
-                              "--layers", "4"]),
-    ("decode_continuous_h8", [sys.executable, "examples/decode_bench.py",
-                              "--continuous", "--batch", "4", "--tokens", "32",
-                              "--layers", "4", "--horizon", "8"]),
-    ("decode_continuous_spec", [sys.executable, "examples/decode_bench.py",
-                                "--continuous", "--batch", "4", "--tokens", "32",
-                                "--layers", "4", "--spec-k", "4"]),
-    # The composed corner the dispatch-floor analysis asks for: one
-    # dispatch buys up to horizon * spec_k tokens.
-    ("decode_continuous_spec_h4", [sys.executable, "examples/decode_bench.py",
-                                   "--continuous", "--batch", "4", "--tokens",
-                                   "32", "--layers", "4", "--spec-k", "4",
-                                   "--horizon", "4"]),
-    # Offline drain: one fused dispatch per budget-sorted wave — the
-    # batch-inference configuration built to beat static batching on a
-    # dispatch-latency-bound link.
-    ("decode_continuous_offline", [sys.executable, "examples/decode_bench.py",
-                                   "--continuous", "--offline", "--batch", "4",
-                                   "--tokens", "32", "--layers", "4"]),
-    # LM training headline (round-4 review item #4): tokens/s/chip + MFU.
-    ("lm_bench", [sys.executable, "bench.py", "--lm", "--no-probe"]),
-    # Fresh driver-style headline artifact (compile cache warm: ~70 s).
-    ("resnet50_bench", [sys.executable, "bench.py", "--no-probe"]),
-]
+from hw_steps import MEASUREMENT_STEPS  # noqa: E402 — shared with hw_measure.py
+
+STEPS: list[tuple[str, list[str]]] = MEASUREMENT_STEPS
 
 
 def record(entry: dict) -> None:
