@@ -438,6 +438,44 @@ class LMEngine:
             return body(params, cache, padded_suffix, base_len, true_len,
                         temp, topk, topp, seed)
 
+        @functools.partial(jax.jit, static_argnames=("sampled", "nucleus"))
+        def spec_append(params, dparams, t_cache, d_cache, padded_suffix,
+                        base_len, true_len, temp, topk, topp, seed,
+                        sampled=False, nucleus=False):
+            # Prefix-cache admission on a speculative engine: the
+            # suffix appends onto COPIES of BOTH stored prefix caches
+            # (not donated — the prefixes are reused), and both indices
+            # rewind to base_len + true_len so target and draft enter
+            # the first speculative dispatch at the same position.
+            def body(params, dparams, t_cache, d_cache, padded_suffix,
+                     base_len, true_len, temp, topk, topp, seed):
+                logits, t_vars = local_model.apply(
+                    {"params": params, "cache": t_cache}, padded_suffix,
+                    decode=True, mutable=["cache"],
+                )
+                _, d_vars = local_draft.apply(
+                    {"params": dparams, "cache": d_cache}, padded_suffix,
+                    decode=True, mutable=["cache"],
+                )
+                first_tok, t_cache2 = _admit_tail(
+                    logits, t_vars, true_len, base_len + true_len,
+                    temp, topk, topp, seed, sampled, nucleus,
+                )
+                d_cache2 = _map_cache(
+                    d_vars["cache"], lambda leaf: leaf,
+                    lambda idx: jnp.full_like(idx, base_len + true_len),
+                )
+                return first_tok, t_cache2, d_cache2
+
+            body = sharded(
+                body,
+                (param_specs, draft_param_specs, cache_specs,
+                 draft_cache_specs) + (P(),) * 7,
+                (P(), cache_specs, draft_cache_specs),
+            )
+            return body(params, dparams, t_cache, d_cache, padded_suffix,
+                        base_len, true_len, temp, topk, topp, seed)
+
         def insert(big, one, row, true_len):
             # The b=1 tree shares the big tree's treedef — only the
             # leading dims differ — so _map_cache zips them.
@@ -1012,6 +1050,9 @@ class LMEngine:
         self._spec_prefill = (
             spec_prefill if draft_model is not None else None
         )
+        self._spec_append = (
+            spec_append if draft_model is not None else None
+        )
         self._spec_step = (
             jax.jit(spec_step, donate_argnums=(2, 3))
             if draft_model is not None else None
@@ -1031,7 +1072,14 @@ class LMEngine:
             if draft_model is not None else None
         )
         self._insert = jax.jit(insert, donate_argnums=(0,))
-        self._prefixes: dict[str, tuple[Any, int]] = {}
+        # (target cache, draft cache or None, length) per prefix name.
+        self._prefixes: dict[str, tuple[Any, Any | None, int]] = {}
+        # The effective cache capacity: a speculative engine is bounded
+        # by the SMALLER of the two caches — the single definition every
+        # capacity check uses.
+        self._cap = model.max_decode_len
+        if draft_model is not None:
+            self._cap = min(self._cap, draft_model.max_decode_len)
         self._step_greedy = jax.jit(step_greedy, donate_argnums=(1,))
         self._step_sampled = jax.jit(
             step_sampled, donate_argnums=(1,), static_argnames=("nucleus",)
@@ -1061,25 +1109,37 @@ class LMEngine:
         few-shot header) and cache its KV state; requests that
         ``submit(..., prefix_id=name)`` start from it and only compute
         their own suffix — the standard prefix-caching serving
-        optimization. Re-registering a name replaces it."""
+        optimization. On a speculative engine the DRAFT's prefix cache
+        is prefilled and stored alongside the target's (the draft must
+        enter every dispatch at the same position). Re-registering a
+        name replaces it."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size == 0:
             raise ValueError("empty prefix")
-        if tokens.size >= self.model.max_decode_len:
+        cap = self._cap
+        if tokens.size >= cap:
             raise ValueError(
                 f"prefix {tokens.size} leaves no room in "
-                f"max_decode_len {self.model.max_decode_len}"
+                f"max_decode_len {cap}"
             )
         L = tokens.size
-        bucket = min(self._bucket(L), self.model.max_decode_len)
+        bucket = min(self._bucket(L), cap)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :L] = tokens
-        _, cache = self._prefill(
-            self.params, jnp.asarray(padded), jnp.int32(L),
-            jnp.float32(0.0), jnp.int32(0), jnp.float32(0.0), jnp.int32(0),
-            sampled=False,
-        )
-        self._prefixes[name] = (cache, L)
+        zero_knobs = (jnp.float32(0.0), jnp.int32(0), jnp.float32(0.0),
+                      jnp.int32(0))
+        if self.spec_k:
+            _, cache, d_cache = self._spec_prefill(
+                self.params, self.draft_params, jnp.asarray(padded),
+                jnp.int32(L), *zero_knobs, sampled=False,
+            )
+        else:
+            _, cache = self._prefill(
+                self.params, jnp.asarray(padded), jnp.int32(L),
+                *zero_knobs, sampled=False,
+            )
+            d_cache = None
+        self._prefixes[name] = (cache, d_cache, L)
         return name
 
     def submit(
@@ -1112,7 +1172,7 @@ class LMEngine:
             # Snapshot: re-registering the name later must not swap the
             # prefix (or invalidate this validation) for queued work.
             prefix = self._prefixes[prefix_id]
-            prefix_len = prefix[1]
+            prefix_len = prefix[2]
         total = prefix_len + prompt.size + max_new_tokens
         if total > self.model.max_decode_len:
             raise ValueError(
@@ -1127,20 +1187,14 @@ class LMEngine:
         if top_p is not None and not 0.0 < top_p <= 1.0:
             raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if self.spec_k:
-            if prefix_id is not None:
-                raise NotImplementedError(
-                    "prefix caching on a speculative engine is not "
-                    "implemented (the draft would need its own prefix)"
-                )
-            cap2 = min(
-                self.model.max_decode_len, self.draft_model.max_decode_len
-            )
+            cap2 = self._cap
             # Deepest write: the final dispatch enters with at most
             # total - 2 written tokens (one emitted-but-unwritten, one
             # of the budget still to come) and writes spec_k positions.
             if total + self.spec_k - 2 > cap2:
                 raise ValueError(
-                    f"prompt {prompt.size} + {max_new_tokens} new tokens "
+                    f"prefix {prefix_len} + prompt {prompt.size} + "
+                    f"{max_new_tokens} new tokens "
                     f"(+{self.spec_k - 2} speculation slack) exceeds "
                     f"max_decode_len {cap2}"
                 )
@@ -1521,22 +1575,36 @@ class LMEngine:
 
     def _admit(self, req: _Request, row: int) -> int | None:
         """Prefix-append admission: prefill ``req``'s suffix onto its
-        stored prefix cache and splice it into slot ``row``. Returns
-        the ticket if the request finished at admission (budget of 1).
-        Non-prefix requests go through :meth:`_admit_wave` (batched)."""
+        stored prefix cache(s) and splice into slot ``row`` (both
+        caches on a speculative engine). Returns the ticket if the
+        request finished at admission (budget of 1). Non-prefix
+        requests go through :meth:`_admit_wave` (batched)."""
         L = req.prompt.size
-        base_cache, base_len = req.prefix
-        bucket = min(self._bucket(L), self.model.max_decode_len - base_len)
+        base_cache, base_draft, base_len = req.prefix
+        bucket = min(self._bucket(L), self._cap - base_len)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :L] = req.prompt
-        first_tok, one_cache = self._append(
-            self.params, base_cache, jnp.asarray(padded),
-            jnp.int32(base_len), jnp.int32(L),
-            jnp.float32(req.temperature), jnp.int32(req.top_k),
-            jnp.float32(req.top_p), jnp.int32(req.seed),
+        knobs = (jnp.float32(req.temperature), jnp.int32(req.top_k),
+                 jnp.float32(req.top_p), jnp.int32(req.seed))
+        kwargs = dict(
             sampled=req.temperature > 0,
             nucleus=req.temperature > 0 and 0.0 < req.top_p < 1.0,
         )
+        if self.spec_k:
+            first_tok, one_cache, one_draft = self._spec_append(
+                self.params, self.draft_params, base_cache, base_draft,
+                jnp.asarray(padded), jnp.int32(base_len), jnp.int32(L),
+                *knobs, **kwargs,
+            )
+            self._draft_cache = self._insert(
+                self._draft_cache, one_draft, jnp.int32(row),
+                jnp.int32(base_len + L),
+            )
+        else:
+            first_tok, one_cache = self._append(
+                self.params, base_cache, jnp.asarray(padded),
+                jnp.int32(base_len), jnp.int32(L), *knobs, **kwargs,
+            )
         self.prefix_hits += 1
         self._cache = self._insert(
             self._cache, one_cache, jnp.int32(row), jnp.int32(base_len + L)
@@ -1562,13 +1630,10 @@ class LMEngine:
             row, req = wave[0]
             done = self._admit_single(row, req)
             return [done] if done is not None else []
-        caps = [self.model.max_decode_len]
-        if self.spec_k:
-            # The padded chunk must fit the SMALLER cache: the draft
-            # prefills the same bucket.
-            caps.append(self.draft_model.max_decode_len)
+        # The padded chunk must fit the SMALLER cache on speculative
+        # engines (self._cap): the draft prefills the same bucket.
         bucket = max(
-            min(self._bucket(req.prompt.size), *caps) for _, req in wave
+            min(self._bucket(req.prompt.size), self._cap) for _, req in wave
         )
         padded = np.zeros((self.slots, bucket), np.int32)
         true_lens = np.zeros((self.slots,), np.int32)
@@ -1629,10 +1694,7 @@ class LMEngine:
         if self.spec_k:
             # The padded chunk must fit the SMALLER cache: the draft
             # prefills the same bucket.
-            bucket = min(
-                self._bucket(L), self.model.max_decode_len,
-                self.draft_model.max_decode_len,
-            )
+            bucket = min(self._bucket(L), self._cap)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :L] = req.prompt
             first_tok, one_cache, one_draft = self._spec_prefill(

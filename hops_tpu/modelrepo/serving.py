@@ -163,14 +163,6 @@ class LMEnginePredictor:
         bundle = pickle.loads((artifact_dir / "flax_model.pkl").read_bytes())
         module = bundle["module"].clone(ragged_decode=True)
         draft_module = draft_params = None
-        if cfg.get("draft_model") and cfg.get("prefixes"):
-            # Reject at startup, not per request: register_prefix would
-            # succeed (target cache only) but every prefix_id request
-            # would then fail in submit().
-            raise NotImplementedError(
-                "prefixes are not supported with draft_model "
-                "(speculative serving is prefix-less for now)"
-            )
         if cfg.get("draft_model"):
             # Speculative serving: the draft is a second registry model
             # ({"draft_model": name, "draft_version": int?, "spec_k": k}).

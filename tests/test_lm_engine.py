@@ -691,11 +691,48 @@ def test_engine_speculative_validation():
                  spec_k=1)
     engine = LMEngine(model, params, slots=1, prefill_buckets=(8,),
                       draft_model=model, draft_params=params, spec_k=4)
-    with pytest.raises(NotImplementedError, match="prefix"):
-        engine.register_prefix("sys", [1, 2, 3])
-        engine.submit([4], max_new_tokens=2, prefix_id="sys")
     with pytest.raises(ValueError, match="slack"):
         engine.submit(list(range(1, 30)), max_new_tokens=34)
+    # Prefix length counts against the speculative capacity bound too.
+    engine.register_prefix("sys", list(range(1, 20)))
+    with pytest.raises(ValueError, match="slack"):
+        engine.submit(list(range(1, 11)), max_new_tokens=34, prefix_id="sys")
+
+
+def test_engine_speculative_prefix_caching_matches_full_prompt():
+    """Prefix caching on a speculative engine (the last engine fence,
+    closed round 5): BOTH caches prefill the registered prefix once;
+    suffix admissions append to copies of both, and greedy output is
+    exactly generate(prefix + suffix) — mixed with non-prefix requests
+    sharing the same slots."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    draft_params = _params(plain, seed=5)
+    rs = np.random.RandomState(101)
+    prefix = list(rs.randint(1, 64, (9,)))
+    suffixes = [rs.randint(1, 64, (n,)) for n in (3, 5, 2)]
+    loose = rs.randint(1, 64, (6,))
+
+    engine = LMEngine(model, params, slots=2, prefill_buckets=(8, 16),
+                      draft_model=model, draft_params=draft_params,
+                      spec_k=3)
+    engine.register_prefix("sys", prefix)
+    ts = [engine.submit(s, max_new_tokens=7, prefix_id="sys")
+          for s in suffixes]
+    tl = engine.submit(loose, max_new_tokens=8)
+    r = engine.run()
+    assert engine.prefix_hits == 3
+    assert engine.spec_offered > 0
+    for s, t in zip(suffixes, ts):
+        full = np.concatenate([prefix, s])
+        ref = generate(plain, params, jnp.asarray(full)[None],
+                       jax.random.PRNGKey(0), max_new_tokens=7,
+                       temperature=0.0)
+        assert r[t] == list(np.asarray(ref[0, len(full):])), t
+    ref = generate(plain, params, jnp.asarray(loose)[None],
+                   jax.random.PRNGKey(0), max_new_tokens=8, temperature=0.0)
+    assert r[tl] == list(np.asarray(ref[0, len(loose):]))
 
 
 def test_engine_speculative_exact_capacity_boundary():
@@ -727,21 +764,32 @@ def test_lm_server_speculative_over_http():
     registry.save_flax(plain, params, "spec-lm", metrics={"loss": 1.0})
     registry.save_flax(plain, _params(plain, seed=8), "spec-draft",
                        metrics={"loss": 2.0})
+    sys_prefix = [11, 4, 8, 15, 2]
     serving.create_or_update(
         "spec-lm", model_name="spec-lm", model_server="LM",
         lm_config={"slots": 2, "prefill_buckets": [8],
-                   "draft_model": "spec-draft", "spec_k": 3},
+                   "draft_model": "spec-draft", "spec_k": 3,
+                   "prefixes": {"sys": sys_prefix}},
     )
     serving.start("spec-lm")
     try:
         p = [5, 9, 2, 7]
         resp = serving.make_inference_request(
-            "spec-lm", {"instances": [{"prompt": p, "max_new_tokens": 6}]}
+            "spec-lm", {"instances": [
+                {"prompt": p, "max_new_tokens": 6},
+                {"prompt": p, "max_new_tokens": 5, "prefix_id": "sys"},
+            ]}
         )
         ref = generate(plain, params, jnp.asarray(p)[None],
                        jax.random.PRNGKey(0), max_new_tokens=6,
                        temperature=0.0)
         assert resp["predictions"][0] == list(np.asarray(ref[0, 4:]))
+        # Prefix caching composes with speculation (round 5): output is
+        # exactly generate(prefix + suffix).
+        full = jnp.asarray(sys_prefix + p)[None]
+        ref2 = generate(plain, params, full, jax.random.PRNGKey(0),
+                        max_new_tokens=5, temperature=0.0)
+        assert resp["predictions"][1] == list(np.asarray(ref2[0, full.shape[1]:]))
         # GET /v1/models/<name>: TF-Serving status + engine telemetry.
         status = serving.get_model_status("spec-lm")
         assert status["model_version_status"][0]["state"] == "AVAILABLE"
