@@ -20,6 +20,10 @@ A ground-up re-design of the capabilities of the Hopsworks example suite
   (reference: hsfs, SURVEY.md §2.6).
 - ``hops_tpu.jobs`` — jobs/orchestration API + DAG operators
   (reference: jobs-client/, airflow/, SURVEY.md §2.7).
+- ``hops_tpu.telemetry`` — metrics registry, Prometheus ``/metrics``
+  export, pubsub metric shipping, span timers (reference: the
+  Kafka→ELK inference-log / Spark-executor-metrics pipeline,
+  SURVEY.md §5).
 - ``hops_tpu.parallel`` — meshes, shardings, collectives, ring attention.
 - ``hops_tpu.ops`` — Pallas TPU kernels for hot ops.
 - ``hops_tpu.models`` — model zoo (MNIST CNN/FFN, ResNet-50, wide&deep).

@@ -36,8 +36,12 @@ from hops_tpu.parallel.strategy import (
 )
 from hops_tpu.runtime import rundir
 from hops_tpu.runtime.logging import attach_run_log, detach_run_log, get_logger, scalarize
+from hops_tpu.telemetry.metrics import REGISTRY
 
 log = get_logger(__name__)
+
+#: Experiments span seconds (smoke tests) to hours (real training).
+_DURATION_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0, 7200.0)
 
 
 class _Tee(io.TextIOBase):
@@ -108,6 +112,19 @@ def _run_wrapper(
 
             _tb.close(run.logdir)
     final_path = run.finalize()
+    # Launcher telemetry: run outcomes by kind, and wall time. Step
+    # cadence (step time / steps/sec) rides the tensorboard.scalar
+    # stream and run_preemptible's StepTimer, not the launcher.
+    REGISTRY.counter(
+        "hops_tpu_experiment_runs_total",
+        "Experiment runs by launcher kind and final status",
+        labels=("kind", "status"),
+    ).inc(kind=kind, status=status)
+    REGISTRY.histogram(
+        "hops_tpu_experiment_duration_seconds",
+        "Wall time of experiment runs",
+        labels=("kind",), buckets=_DURATION_BUCKETS,
+    ).observe(time.time() - start, kind=kind)
     if chief:
         registry.register(
             {

@@ -12,6 +12,7 @@ the reference's `profile_batch` equivalent, SURVEY.md §5).
 from __future__ import annotations
 
 import contextlib
+import threading
 from pathlib import Path
 from typing import Iterator
 
@@ -19,8 +20,19 @@ import jax
 
 from hops_tpu.runtime import rundir
 from hops_tpu.runtime.logging import MetricLogger
+from hops_tpu.telemetry.spans import StepTimer
 
 _writers: dict[str, MetricLogger] = {}
+# Step-cadence telemetry derived from the scalar stream: the first
+# scalar() of each NEW step marks a step boundary, so existing training
+# wrappers feed hops_tpu_step_seconds / hops_tpu_steps_total (and the
+# heartbeat gauge) without code changes. One timer PER RUN DIR: search
+# trials log concurrently from a thread pool, and a shared clock would
+# measure inter-trial gaps instead of step times (they still feed the
+# same loop="experiment" series).
+_step_timers: dict[str, StepTimer] = {}
+_last_step: dict[str, int] = {}
+_step_lock = threading.Lock()
 
 
 def logdir() -> str:
@@ -36,8 +48,22 @@ def _writer() -> MetricLogger:
 
 
 def scalar(step: int, tag: str, value) -> None:
-    """Log a scalar event into the run's metric stream."""
+    """Log a scalar event into the run's metric stream (and tick the
+    step-telemetry clock when ``step`` advances)."""
+    ld = logdir()
     _writer().log(step, tag, value)
+    with _step_lock:
+        last = _last_step.get(ld)
+        if last is not None and step <= last:
+            return
+        _last_step[ld] = step
+        timer = _step_timers.get(ld)
+        if timer is None:
+            timer = _step_timers[ld] = StepTimer(loop="experiment")
+        if last is None:  # first scalar of a run only arms the clock
+            timer.arm()
+        else:
+            timer.tick()
 
 
 def flush() -> None:
@@ -50,6 +76,9 @@ def close(run_logdir: str | None = None) -> None:
     run). Launchers call this when a run finalizes so long-lived drivers
     don't accumulate open file handles."""
     key = run_logdir or rundir.logdir()
+    with _step_lock:
+        _last_step.pop(key, None)
+        _step_timers.pop(key, None)
     w = _writers.pop(key, None)
     if w is not None:
         w.close()

@@ -16,6 +16,8 @@ from typing import Any, Callable, Iterator
 
 import numpy as np
 
+from hops_tpu.telemetry.metrics import REGISTRY
+
 
 class DataFeeder:
     def __init__(self, td, target_name: str | None = None, split: str | None = None,
@@ -173,6 +175,17 @@ class DataFeeder:
         steps_per_epoch = max(1, (end + batch_size - 1) // batch_size)
         skip_epochs, skip_steps = divmod(start_step, steps_per_epoch)
 
+        # Feed throughput: rate(batches_total) is batches produced/sec,
+        # the input-pipeline half of the steps/sec picture.
+        m_batches = REGISTRY.counter(
+            "hops_tpu_feed_batches_total",
+            "Batches yielded by DataFeeder.numpy_iterator",
+        ).labels()
+        m_examples = REGISTRY.counter(
+            "hops_tpu_feed_examples_total",
+            "Examples yielded by DataFeeder.numpy_iterator (local rows)",
+        ).labels()
+
         epoch = 0
         while num_epochs is None or epoch < num_epochs:
             order = rng.permutation(n) if shuffle else np.arange(n)
@@ -192,6 +205,8 @@ class DataFeeder:
                     out = bx
                 else:
                     out = (bx, by)
+                m_batches.inc()
+                m_examples.inc(len(bx))
                 yield assemble(out) if sharding is not None else out
             epoch += 1
 

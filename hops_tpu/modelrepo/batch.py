@@ -16,6 +16,7 @@ import jax
 import numpy as np
 
 from hops_tpu.parallel.strategy import Strategy
+from hops_tpu.telemetry.metrics import RATIO_BUCKETS, REGISTRY
 
 
 def batch_predict(
@@ -32,6 +33,17 @@ def batch_predict(
     strategy = strategy or Strategy()
     chunk = per_chip_batch * strategy.num_replicas_in_sync
     jitted = jax.jit(apply_fn)
+    # Fill ratio says how much of each dispatch was pad waste (only the
+    # ragged tail dips below 1.0); rows_total's scrape-side rate() is
+    # batch-inference throughput.
+    m_fill = REGISTRY.histogram(
+        "hops_tpu_batch_fill_ratio",
+        "Valid rows per batch-inference chunk over the chunk size",
+        buckets=RATIO_BUCKETS,
+    ).labels()
+    m_rows = REGISTRY.counter(
+        "hops_tpu_batch_rows_total", "Batch-inference rows predicted"
+    ).labels()
 
     outs: list[np.ndarray] = []
     n = len(inputs)
@@ -43,6 +55,8 @@ def batch_predict(
             block = np.concatenate([block, pad], axis=0)
         placed = strategy.distribute_batch(block)
         preds = np.asarray(jitted(placed))
+        m_fill.observe(valid / chunk)
+        m_rows.inc(valid)
         outs.append(preds[:valid])
     if outs:
         return np.concatenate(outs, axis=0)

@@ -38,6 +38,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import time
 from typing import Any
 
 import jax
@@ -46,6 +47,7 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from hops_tpu.models.generation import top_p_mask
+from hops_tpu.telemetry.metrics import REGISTRY
 
 
 def _map_cache(cache: Any, fn_kv, fn_idx, *rest: Any) -> Any:
@@ -162,6 +164,8 @@ class _Request:
     # name later must not invalidate this request's capacity validation
     # or swap its prefix mid-queue.
     prefix: tuple[Any, int] | None = None
+    # monotonic submit time — the TTFT histogram's start mark.
+    submitted_at: float = 0.0
 
 
 @dataclasses.dataclass
@@ -1101,6 +1105,29 @@ class LMEngine:
         # offered is the acceptance rate (how good the draft is).
         self.spec_accepted = 0
         self.spec_offered = 0
+        # Registry metrics (hops_tpu.telemetry): process-wide, shared
+        # by every engine in the process — scrape-side rate() over the
+        # token counter is tokens/sec, occupancy is sampled at dispatch
+        # cadence in _mark_dispatch.
+        self._m_dispatches = REGISTRY.counter(
+            "hops_tpu_lm_dispatches_total", "LM engine device dispatches"
+        ).labels()
+        self._m_tokens = REGISTRY.counter(
+            "hops_tpu_lm_tokens_total", "Tokens emitted by the LM engine"
+        ).labels()
+        self._m_ttft = REGISTRY.histogram(
+            "hops_tpu_lm_ttft_seconds",
+            "Time from submit to a request's first emitted token",
+        ).labels()
+        self._m_occupancy = REGISTRY.gauge(
+            "hops_tpu_lm_slot_occupancy",
+            "Busy decode slots / total slots, sampled at dispatch time",
+        ).labels()
+        self._m_prefix_cache = REGISTRY.counter(
+            "hops_tpu_lm_prefix_cache_total",
+            "Admissions by prefix-cache outcome",
+            labels=("result",),
+        )
 
     # --- public API -----------------------------------------------------
 
@@ -1206,6 +1233,7 @@ class LMEngine:
                 ticket, prompt, max_new_tokens, eos_id,
                 temperature=float(temperature), top_k=int(top_k or 0),
                 top_p=float(top_p or 0.0), seed=int(seed), prefix=prefix,
+                submitted_at=time.monotonic(),
             )
         )
         return ticket
@@ -1287,6 +1315,7 @@ class LMEngine:
             st.remaining -= 1
             st.n_sampled += 1
             self.tokens_emitted += 1
+            self._m_tokens.inc()
             if st.remaining == 0 or (st.eos_id is not None and tok == st.eos_id):
                 finished.append(self._finish(row))
 
@@ -1309,7 +1338,7 @@ class LMEngine:
                     nucleus=nucleus,
                 )
             )
-            self.dispatches += 1
+            self._mark_dispatch()
             toks, emits = np.asarray(toks), np.asarray(emits)
             accs, lives = np.asarray(accs), np.asarray(lives)
             for i in range(self.decode_horizon):
@@ -1339,7 +1368,7 @@ class LMEngine:
                         self._draft_cache, tokens, active,
                     )
                 )
-            self.dispatches += 1
+            self._mark_dispatch()
             drafts = np.asarray(drafts)
             a_rows, bonus = np.asarray(a_rows), np.asarray(bonus)
             for row in range(self.slots):
@@ -1376,7 +1405,7 @@ class LMEngine:
                 horizon=self.decode_horizon, sampled=sampled,
                 nucleus=nucleus,
             )
-            self.dispatches += 1
+            self._mark_dispatch()
             toks, lives = np.asarray(toks), np.asarray(lives)
             for i in range(self.decode_horizon):
                 for row in range(self.slots):
@@ -1393,7 +1422,7 @@ class LMEngine:
             nxt, self._cache = self._step_greedy(
                 self.params, self._cache, tokens, active
             )
-        self.dispatches += 1
+        self._mark_dispatch()
         nxt = np.asarray(nxt)
         for row in range(self.slots):
             if self._slot_state[row] is not None:
@@ -1497,7 +1526,7 @@ class LMEngine:
             jnp.asarray(topks), jnp.asarray(topps), jnp.asarray(seeds),
             horizon=horizon, sampled=sampled, nucleus=nucleus,
         )
-        self.dispatches += 1
+        self._mark_dispatch()
         self.admission_waves += 1
         tok0 = np.asarray(tok0)
         toks, lives = np.asarray(toks), np.asarray(lives)
@@ -1508,6 +1537,12 @@ class LMEngine:
             cnt = int(lives[:, row].sum()) if horizon else 0
             out = [int(tok0[row])] + toks[:cnt, row].astype(int).tolist()
             self.tokens_emitted += len(out)
+            self._m_tokens.inc(len(out))
+            # Offline waves never carry prefixes (run_offline falls
+            # back to run() for those) — every admission is a miss.
+            self._m_prefix_cache.inc(result="miss")
+            if r.submitted_at:
+                self._m_ttft.observe(time.monotonic() - r.submitted_at)
             self._results[r.ticket] = out
 
     def result(self, ticket: int) -> list[int] | None:
@@ -1566,6 +1601,16 @@ class LMEngine:
         )
 
     # --- internals ------------------------------------------------------
+
+    def _mark_dispatch(self) -> None:
+        """The one dispatch-accounting path: the legacy ``dispatches``
+        counter plus the registry metrics; batch-slot occupancy is
+        sampled here because dispatch cadence IS the engine's clock."""
+        self.dispatches += 1
+        self._m_dispatches.inc()
+        self._m_occupancy.set(
+            sum(st is not None for st in self._slot_state) / self.slots
+        )
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
@@ -1721,6 +1766,12 @@ class LMEngine:
         """Shared admission bookkeeping: record the first emitted token
         and occupy (or immediately finish) the slot."""
         self.tokens_emitted += 1
+        self._m_tokens.inc()
+        self._m_prefix_cache.inc(
+            result="hit" if req.prefix is not None else "miss"
+        )
+        if req.submitted_at:
+            self._m_ttft.observe(time.monotonic() - req.submitted_at)
         st = _SlotState(
             ticket=req.ticket,
             emitted=[tok],

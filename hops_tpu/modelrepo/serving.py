@@ -37,6 +37,9 @@ from hops_tpu.messaging import pubsub
 from hops_tpu.modelrepo import registry
 from hops_tpu.runtime import fs
 from hops_tpu.runtime.logging import get_logger
+from hops_tpu.telemetry import export as telemetry_export
+from hops_tpu.telemetry.metrics import RATIO_BUCKETS, REGISTRY
+from hops_tpu.telemetry.spans import span
 
 log = get_logger(__name__)
 
@@ -316,7 +319,7 @@ class DynamicBatcher:
     """
 
     def __init__(self, predict_fn, max_batch_size: int = 64,
-                 timeout_ms: float = 5.0):
+                 timeout_ms: float = 5.0, model: str = ""):
         import queue
 
         self._predict = predict_fn
@@ -326,6 +329,16 @@ class DynamicBatcher:
         self._stopped = False
         self.batches_run = 0
         self.rows_run = 0
+        self._m_queue_depth = REGISTRY.gauge(
+            "hops_tpu_serving_batch_queue_depth",
+            "Requests waiting in the dynamic batcher",
+            labels=("model",),
+        ).labels(model=model)
+        self._m_fill = REGISTRY.histogram(
+            "hops_tpu_serving_batch_fill_ratio",
+            "Rows per coalesced batch over max_batch_size",
+            labels=("model",), buckets=RATIO_BUCKETS,
+        ).labels(model=model)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -336,6 +349,7 @@ class DynamicBatcher:
             raise RuntimeError("serving stopped")
         fut: Future = Future()
         self._queue.put((list(instances), fut))
+        self._m_queue_depth.set(self._queue.qsize())
         return fut.result()
 
     def stop(self) -> None:
@@ -393,6 +407,10 @@ class DynamicBatcher:
 
     def _run(self, pending) -> None:
         flat = [row for instances, _ in pending for row in instances]
+        self._m_queue_depth.set(self._queue.qsize())
+        # An over-cap single request runs alone, unsplit — clamp so the
+        # ratio histogram stays in [0, 1].
+        self._m_fill.observe(min(len(flat) / self.max_batch_size, 1.0))
         try:
             preds = self._predict(flat)
         except Exception as e:  # noqa: BLE001 — fail THIS batch only
@@ -423,10 +441,29 @@ class _RunningServing:
                 self.predictor.predict,
                 max_batch_size=int(bc.get("max_batch_size", 64)),
                 timeout_ms=float(bc.get("timeout_ms", 5.0)),
+                model=name,
             )
         predictor = self.batcher or self.predictor
         raw_predictor = self.predictor
         producer = self.producer
+        # Per-endpoint request telemetry (the reference's per-serving
+        # Kafka metrics role): counters + the latency histogram the
+        # `/metrics` route on THIS server's port exposes.
+        m_requests = REGISTRY.counter(
+            "hops_tpu_serving_requests_total",
+            "Predict requests received, per serving endpoint",
+            labels=("model",),
+        ).labels(model=name)
+        m_errors = REGISTRY.counter(
+            "hops_tpu_serving_errors_total",
+            "Predict requests that raised, per serving endpoint",
+            labels=("model",),
+        ).labels(model=name)
+        m_logged = REGISTRY.counter(
+            "hops_tpu_serving_inference_log_total",
+            "Request/response pairs tee'd onto the serving's pubsub topic",
+            labels=("model",),
+        ).labels(model=name)
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args: Any) -> None:  # silence stderr spam
@@ -439,6 +476,11 @@ class _RunningServing:
                 # LM engine's dispatches, occupancy, prefix hits, and
                 # speculation acceptance.
                 try:
+                    # Prometheus scrape rides the serving's own port
+                    # (GET /metrics, GET /metrics.json) — the whole
+                    # process's registry, not just this endpoint.
+                    if telemetry_export.handle_metrics_path(self):
+                        return
                     # Exact TF-Serving routes only: /v1/models/<name>
                     # and the versioned /v1/models/<name>/versions/<N>
                     # form (a suffix match would accept arbitrary
@@ -479,13 +521,20 @@ class _RunningServing:
                     if instances is None:
                         self._reply(400, {"error": "payload must carry 'instances'"})
                         return
-                    preds = predictor.predict(instances)
+                    m_requests.inc()
+                    # span() records into the request-latency histogram
+                    # even when predict raises — error latency is
+                    # latency; the error counter increments below.
+                    with span("hops_tpu_serving_request", model=name):
+                        preds = predictor.predict(instances)
                     response = {"predictions": preds}
                     producer.send(
                         {"request": payload, "response": response}, key=name
                     )
+                    m_logged.inc()
                     self._reply(200, response)
                 except Exception as e:  # noqa: BLE001 — server must stay up
+                    m_errors.inc()
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
             def _reply(self, code: int, body: dict[str, Any]) -> None:

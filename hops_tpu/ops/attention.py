@@ -36,9 +36,20 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float("-inf")
 _LANES = 128  # VPU lane width: per-row stats are broadcast across lanes
 
+# JAX renamed pltpu.TPUCompilerParams -> CompilerParams; resolve
+# whichever the installed version carries so the module imports on both.
+_CompilerParams = getattr(
+    pltpu, "CompilerParams", getattr(pltpu, "TPUCompilerParams", None)
+)
+if _CompilerParams is None:  # pragma: no cover — future rename
+    raise ImportError(
+        "jax.experimental.pallas.tpu has neither CompilerParams nor "
+        "TPUCompilerParams; update the compat shim in ops/attention.py"
+    )
+
 # The (batch·heads) grid dim is embarrassingly parallel; the q/k block
 # dims carry scratch state between steps and must stay "arbitrary".
-_COMPILER_PARAMS = pltpu.CompilerParams(
+_COMPILER_PARAMS = _CompilerParams(
     dimension_semantics=("parallel", "arbitrary", "arbitrary")
 )
 
@@ -839,7 +850,7 @@ def decode_attention(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((bh, q_rows, d), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")
         ),
         interpret=interpret,

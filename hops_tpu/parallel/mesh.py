@@ -178,7 +178,12 @@ def pvary(x: Any, axes: Sequence[str | None]) -> Any:
         return x
     if hasattr(jax.lax, "pcast"):  # current API; pvary is its deprecated alias
         return jax.lax.pcast(x, axes, to="varying")
-    return jax.lax.pvary(x, axes)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axes)
+    # Neither exists: this JAX predates varying-manual-axes typing
+    # (<= 0.4.x), where shard_map carries broadcast constants without
+    # any vma marking — nothing to do.
+    return x
 
 
 def batch_sharding(mesh: Mesh, axis: str | tuple[str, ...] = "data") -> NamedSharding:

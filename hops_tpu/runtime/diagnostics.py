@@ -58,12 +58,26 @@ class Watchdog:
     just log, letting an external supervisor restart the host. This is
     the framework-level replacement for the failure detection the
     reference outsourced to YARN container restarts (SURVEY.md §5).
+
+    ``watch_heartbeat_gauge`` reads the telemetry heartbeat gauge
+    (maintained by ``runtime/preemption.run_preemptible`` and
+    ``telemetry.StepTimer``) instead of requiring explicit
+    :meth:`heartbeat` calls — a watchdog in ANY thread of the process
+    can then supervise an instrumented loop it has no handle on. Pass
+    the LOOP NAME (e.g. ``"preemptible"``) to watch one specific loop;
+    ``True`` accepts a beat from any loop in the process (process
+    liveness — in multi-loop processes a healthy loop then masks a hung
+    one, so prefer the name form). The comparison uses the gauge's
+    monotonic twin, immune to wall-clock steps. Falls back to the
+    explicit clock until the gauge first beats.
     """
 
-    def __init__(self, timeout_s: float = 300.0, fatal: bool = False, on_hang=None):
+    def __init__(self, timeout_s: float = 300.0, fatal: bool = False, on_hang=None,
+                 watch_heartbeat_gauge: bool | str = False):
         self.timeout_s = timeout_s
         self.fatal = fatal
         self.on_hang = on_hang
+        self.watch_heartbeat_gauge = watch_heartbeat_gauge
         self._last = time.monotonic()
         self._stop = threading.Event()
         self._fired = False
@@ -72,13 +86,39 @@ class Watchdog:
     def heartbeat(self) -> None:
         self._last = time.monotonic()
 
+    def _beat_age(self) -> float:
+        """Seconds since the newest heartbeat: the explicit clock,
+        optionally superseded by the telemetry gauge (whichever beat
+        most recently wins, so arming the watchdog before the first
+        tick doesn't fire on gauge silence)."""
+        age = time.monotonic() - self._last
+        if self.watch_heartbeat_gauge:
+            from hops_tpu.telemetry.metrics import REGISTRY
+            from hops_tpu.telemetry.spans import HEARTBEAT_MONO_GAUGE
+
+            want = (
+                self.watch_heartbeat_gauge
+                if isinstance(self.watch_heartbeat_gauge, str) else None
+            )
+            gauge = REGISTRY.get(HEARTBEAT_MONO_GAUGE)
+            if gauge is not None:
+                # Read via samples() — value(loop=...) would CREATE a
+                # zero child and pollute the export.
+                beats = [
+                    v for _s, labels, v in gauge.samples()
+                    if v > 0 and (want is None or labels.get("loop") == want)
+                ]
+                if beats:
+                    age = min(age, time.monotonic() - max(beats))
+        return age
+
     @property
     def fired(self) -> bool:
         return self._fired
 
     def _watch(self) -> None:
         while not self._stop.wait(min(self.timeout_s / 4, 5.0)):
-            if time.monotonic() - self._last > self.timeout_s:
+            if self._beat_age() > self.timeout_s:
                 self._fired = True
                 log.error(
                     "watchdog: no heartbeat for %.0fs — possible collective "

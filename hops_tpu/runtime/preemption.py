@@ -32,8 +32,21 @@ import threading
 from typing import Any
 
 from hops_tpu.runtime.logging import get_logger
+from hops_tpu.telemetry.spans import StepTimer
 
 log = get_logger(__name__)
+
+
+def _batch_examples(batch: Any) -> int | None:
+    """Leading-dim row count of a batch pytree (None if shapeless)."""
+    try:
+        import jax
+
+        leaf = jax.tree.leaves(batch)[0]
+        shape = getattr(leaf, "shape", ())
+        return int(shape[0]) if len(shape) >= 1 else None
+    except Exception:  # noqa: BLE001 — telemetry must not fail the step
+        return None
 
 
 class PreemptionGuard:
@@ -155,6 +168,12 @@ def run_preemptible(
         stream = enumerate(batches(start), start=start)
     else:
         stream = enumerate(batches)
+    # Step-cadence telemetry: step time, steps/examples counters, and
+    # the heartbeat gauges — the signal a diagnostics.Watchdog(
+    # watch_heartbeat_gauge="preemptible") reads instead of needing an
+    # explicit heartbeat() call wired into the loop.
+    timer = StepTimer(loop="preemptible")
+    timer.arm()
     try:
         with CheckpointManager(directory, save_interval_steps=save_every) as ckpt:
             saved = ran = False
@@ -163,6 +182,7 @@ def run_preemptible(
                     continue  # consumed by a previous incarnation
                 ran = True
                 state, metrics = train_step(state, batch)
+                timer.tick(examples=_batch_examples(batch))
                 saved = ckpt.save(step, state)  # interval save
                 if guard.should_stop(sync=sync):
                     if not saved:

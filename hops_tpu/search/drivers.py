@@ -44,6 +44,7 @@ from hops_tpu.search.optimizers import (
 )
 from hops_tpu.search.reporter import Reporter, TrialStopped
 from hops_tpu.search.searchspace import Searchspace
+from hops_tpu.telemetry.metrics import REGISTRY
 
 log = get_logger(__name__)
 
@@ -103,6 +104,14 @@ class TrialDriver:
         self._reporters: dict[str, Reporter] = {}
         self._finished_finals: list[float] = []
         self._lock = threading.Lock()
+        # Trial lifecycle counters: started / finished / early_stopped /
+        # failed, per driver kind (lagom, grid_search, ...). rate() on
+        # "finished" is search throughput.
+        self._m_trials = REGISTRY.counter(
+            "hops_tpu_search_trials_total",
+            "Search trials by lifecycle event",
+            labels=("kind", "event"),
+        )
 
     # -- heartbeat handler (driver side of the RPC channel) -------------------
 
@@ -122,6 +131,7 @@ class TrialDriver:
         parent_dir: Path,
         rpc_address: tuple[str, int] | None,
     ) -> TrialResult:
+        self._m_trials.inc(kind=self.kind, event="started")
         reporter = Reporter(trial_id, rpc_address, self.hb_interval)
         with self._lock:
             self._reporters[trial_id] = reporter
@@ -154,6 +164,14 @@ class TrialDriver:
             from hops_tpu.experiment import tensorboard as _tb
 
             _tb.close(trial_dir.logdir)
+        self._m_trials.inc(
+            kind=self.kind,
+            event=(
+                "early_stopped" if stopped
+                else "failed" if error is not None
+                else "finished"
+            ),
+        )
         (Path(trial_dir.logdir) / "trial.json").write_text(
             json.dumps(
                 {

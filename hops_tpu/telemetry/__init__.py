@@ -1,0 +1,48 @@
+"""``hops_tpu.telemetry`` — metrics registry, export, and span timers.
+
+The observability subsystem (SURVEY.md §5: the reference shipped
+per-serving Kafka inference logs to ELK and scraped Spark executor
+metrics; MLPerf-scale TPU work treats step-time/throughput telemetry as
+a first-class subsystem):
+
+- :mod:`~hops_tpu.telemetry.metrics` — thread-safe, label-aware
+  ``Counter`` / ``Gauge`` / ``Histogram`` in a process-global
+  ``REGISTRY``, host-tagged like ``runtime/logging.py``.
+- :mod:`~hops_tpu.telemetry.export` — Prometheus text exposition
+  (``GET /metrics`` standalone or mounted on a serving's port), JSON
+  snapshots, and periodic export onto ``messaging.pubsub``.
+- :mod:`~hops_tpu.telemetry.spans` — ``with span(...)`` / ``@timed``
+  block timers feeding histograms, nesting inside
+  ``diagnostics.trace`` profiler captures; ``StepTimer`` for training
+  loops.
+
+Instrumented out of the box: serving request/error/latency per model,
+LM engine TTFT / tokens / slot occupancy / prefix-cache hits /
+dispatches, dynamic-batcher queue depth and fill, experiment step
+time, search trial lifecycle, feature-store feed throughput, and the
+preemption heartbeat gauge the Watchdog can read.
+"""
+
+from hops_tpu.telemetry.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    RATIO_BUCKETS,
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    hosttag,
+)
+from hops_tpu.telemetry.export import (  # noqa: F401
+    MetricsServer,
+    PubsubExporter,
+    render_prometheus,
+    snapshot,
+    start_http_server,
+)
+from hops_tpu.telemetry.spans import (  # noqa: F401
+    HEARTBEAT_GAUGE,
+    StepTimer,
+    span,
+    timed,
+)

@@ -1,0 +1,224 @@
+"""Metric export: Prometheus text exposition, JSON snapshots, pubsub.
+
+Three consumers, one registry (``metrics.REGISTRY``):
+
+- **Prometheus scrape** — :func:`render_prometheus` produces text
+  exposition format 0.0.4; :func:`start_http_server` serves it at
+  ``GET /metrics`` from a standalone daemon thread, and
+  ``modelrepo/serving.py`` mounts the same rendering on every started
+  serving's own port (scrape the model server directly, the way the
+  reference's serving containers were scraped).
+- **JSON snapshot** — :func:`snapshot` for dashboards/tests; also
+  served at ``GET /metrics.json``.
+- **Pubsub tail** — :class:`PubsubExporter` periodically appends
+  snapshots onto a ``messaging.pubsub`` topic: the TPU-native stand-in
+  for the reference's Kafka→ELK metrics pipeline (SURVEY.md §5) —
+  consumers replay/tail it exactly like the inference logs.
+
+Every exported series carries a ``host`` label (the
+``runtime/logging.py`` hosttag convention) so multi-host scrapes and a
+shared pubsub topic stay disambiguated.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from hops_tpu.telemetry import metrics as _metrics
+from hops_tpu.telemetry.metrics import REGISTRY, Registry
+
+
+def _escape(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace("\n", "\\n").replace('"', '\\"')
+    )
+
+
+def _format_value(v: float) -> str:
+    # Non-finite values use the exposition-format spellings; int()
+    # comparison on them would raise and permanently 500 the scrape
+    # (one diverged-loss observe must not kill /metrics).
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def render_prometheus(registry: Registry = REGISTRY) -> str:
+    """Text exposition format 0.0.4 — what ``GET /metrics`` returns."""
+    host = _metrics.hosttag()
+    out: list[str] = []
+    for metric in registry.collect():
+        if metric.help:
+            out.append(f"# HELP {metric.name} {_escape(metric.help)}")
+        out.append(f"# TYPE {metric.name} {metric.type}")
+        for suffix, labels, value in metric.samples():
+            labeled = {"host": host, **labels}
+            body = ",".join(
+                f'{k}="{_escape(str(v))}"' for k, v in labeled.items()
+            )
+            out.append(f"{metric.name}{suffix}{{{body}}} {_format_value(value)}")
+    return "\n".join(out) + "\n"
+
+
+def snapshot(registry: Registry = REGISTRY) -> dict[str, Any]:
+    """JSON-able point-in-time dump of every metric family."""
+    families: dict[str, Any] = {}
+    for metric in registry.collect():
+        rows = [
+            {"suffix": suffix, "labels": labels, "value": value}
+            for suffix, labels, value in metric.samples()
+        ]
+        families[metric.name] = {
+            "type": metric.type,
+            "help": metric.help,
+            "samples": rows,
+        }
+    return {"time": time.time(), "host": _metrics.hosttag(), "metrics": families}
+
+
+def handle_metrics_path(handler: BaseHTTPRequestHandler,
+                        registry: Registry = REGISTRY) -> bool:
+    """Serve ``GET /metrics`` / ``GET /metrics.json`` on an existing
+    ``BaseHTTPRequestHandler`` — the hook ``modelrepo/serving.py`` uses
+    to mount the scrape route on each serving's own port. Returns True
+    if the request path was a metrics route (and was answered)."""
+    path = handler.path.split("?", 1)[0].rstrip("/")
+    if path == "/metrics":
+        data = render_prometheus(registry).encode()
+        ctype = "text/plain; version=0.0.4; charset=utf-8"
+    elif path == "/metrics.json":
+        data = json.dumps(snapshot(registry)).encode()
+        ctype = "application/json"
+    else:
+        return False
+    handler.send_response(200)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(data)
+    return True
+
+
+class MetricsServer:
+    """Standalone scrape endpoint: a daemon HTTP thread serving
+    ``/metrics`` (Prometheus text) and ``/metrics.json`` — for
+    processes that have no serving port of their own (training jobs,
+    the search driver)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 registry: Registry = REGISTRY):
+        registry_ = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args: Any) -> None:  # silence stderr
+                pass
+
+            def do_GET(self) -> None:
+                try:
+                    if not handle_metrics_path(self, registry_):
+                        self.send_response(404)
+                        self.end_headers()
+                except Exception:  # noqa: BLE001 — scrape must not kill the thread
+                    try:
+                        self.send_response(500)
+                        self.end_headers()
+                    except Exception:  # noqa: BLE001 — client went away
+                        pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="hops-metrics-http",
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self) -> "MetricsServer":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+def start_http_server(port: int = 0, registry: Registry = REGISTRY) -> MetricsServer:
+    """Start a :class:`MetricsServer`; ``port=0`` picks a free one
+    (read it back from ``.port``)."""
+    return MetricsServer(port=port, registry=registry)
+
+
+class PubsubExporter:
+    """Periodic snapshot export onto a ``messaging.pubsub`` topic.
+
+    The reference shipped per-serving metrics over Kafka into ELK;
+    here every ``interval_s`` a :func:`snapshot` is appended to
+    ``topic`` (default ``telemetry-metrics``), keyed by host tag —
+    durable, replayable, shared-filesystem-wide. A final snapshot is
+    flushed on :meth:`stop` so short-lived jobs still leave a record.
+    """
+
+    def __init__(self, topic: str = "telemetry-metrics",
+                 interval_s: float = 10.0,
+                 registry: Registry = REGISTRY):
+        self.topic = topic
+        self.interval_s = interval_s
+        self._registry = registry
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._producer = None
+
+    def _send(self) -> None:
+        from hops_tpu.messaging import pubsub
+
+        if self._producer is None:
+            self._producer = pubsub.Producer(self.topic)
+        self._producer.send(snapshot(self._registry), key=_metrics.hosttag())
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._send()
+            except Exception:  # noqa: BLE001 — export must not kill the host
+                from hops_tpu.runtime.logging import get_logger
+
+                get_logger(__name__).exception("pubsub metrics export failed")
+
+    def start(self) -> "PubsubExporter":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="hops-metrics-pubsub"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        try:
+            self._send()  # final flush: short jobs still leave a record
+        except Exception:  # noqa: BLE001
+            pass
+
+    def __enter__(self) -> "PubsubExporter":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()

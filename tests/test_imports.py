@@ -1,0 +1,46 @@
+"""Version-drift guard: every ``hops_tpu`` module must import cleanly.
+
+API drift in a pinned dependency used to surface as opaque pytest
+collection errors spanning nine test modules (``pltpu.CompilerParams``
+vs ``TPUCompilerParams``, ``jax.distributed.is_initialized`` absent in
+older JAX). Importing every module directly — one parametrized case
+per module, under the CPU backend — turns the next drift into one
+NAMED failure per module instead.
+
+Optional third-party dependencies (tensorflow, torch, ...) are
+skip-worthy: a module may guard them at call time; only failures
+rooted in ``hops_tpu`` itself, or non-ImportError drift
+(AttributeError, TypeError), fail the guard.
+"""
+
+from pathlib import Path
+
+import importlib
+
+import pytest
+
+import hops_tpu
+
+_ROOT = Path(hops_tpu.__file__).parent
+
+
+def _module_names() -> list[str]:
+    names = {"hops_tpu"}
+    for p in _ROOT.rglob("*.py"):
+        rel = p.relative_to(_ROOT).with_suffix("")
+        parts = ("hops_tpu",) + rel.parts
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        names.add(".".join(parts))
+    return sorted(names)
+
+
+@pytest.mark.parametrize("name", _module_names())
+def test_module_imports(name):
+    try:
+        importlib.import_module(name)
+    except ModuleNotFoundError as e:
+        missing = (e.name or "").split(".")[0]
+        if missing == "hops_tpu" or name.startswith(f"hops_tpu.{missing}"):
+            raise
+        pytest.skip(f"optional dependency not installed: {e.name}")
