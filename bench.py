@@ -152,6 +152,7 @@ def run_bench(
     scan_chunk: int = 16,
     multihost: bool = False,
     remat: bool = False,
+    grad_comms: str = "none",
 ) -> dict:
     """Time the ResNet-50 train step with a device-side training loop.
 
@@ -161,14 +162,41 @@ def run_bench(
     host→device overhead per dispatch (measured, BENCHMARKS.md
     roofline section), which a per-step Python loop pays 16× more often.
     Pass ``scan_chunk=1`` for the per-dispatch variant.
+
+    ``grad_comms`` picks the gradient-communication schedule
+    (``none`` = XLA's implicit fp32 AllReduce; ``quantized`` /
+    ``zero1`` / ``quantized+zero1`` route through
+    ``hops_tpu.parallel.grad_comms``) so the trajectory can attribute
+    comms wins; the chosen mode and its compression ratio travel in
+    the result.
     """
     from hops_tpu.models import common
     from hops_tpu.models.resnet import ResNet18ish, ResNet50
+    from hops_tpu.parallel import grad_comms as gc_lib
     from hops_tpu.parallel.strategy import CollectiveAllReduceStrategy, Strategy
 
-    if smoke:
+    gc_cfg = gc_lib.GradCommsConfig.parse(grad_comms)
+
+    platform = jax.devices()[0].platform
+    if not smoke and platform == "cpu":
+        # Plumbing-validation tier: ResNet-50 at TPU sizing costs ~9 min
+        # of XLA:CPU compile plus hours of stepping. The smoke path
+        # already established the precedent of emitting this metric from
+        # ResNet18ish on CPU; the non-smoke CPU tier does the same at a
+        # slightly larger shape so every grad-comms collective still
+        # runs end-to-end. There is no recorded CPU baseline, so this
+        # sizing IS the CPU-platform config (the per-platform baseline
+        # file keeps later runs comparable).
+        per_chip_batch = min(per_chip_batch, 8)
+        image_size = min(image_size, 96)
+        steps, warmup, scan_chunk = min(steps, 4), min(warmup, 2), min(scan_chunk, 2)
+        _note(f"cpu platform: ResNet18ish tier, batch={per_chip_batch} "
+              f"image={image_size} steps={steps}")
+
+    if smoke or platform == "cpu":
         model = ResNet18ish(dtype=jnp.float32, remat=remat)
-        per_chip_batch, image_size, steps, warmup, scan_chunk = 8, 32, 4, 2, 2
+        if smoke:
+            per_chip_batch, image_size, steps, warmup, scan_chunk = 8, 32, 4, 2, 2
     else:
         model = ResNet50(num_classes=1000, remat=remat)
 
@@ -180,7 +208,7 @@ def run_bench(
     n_chips = strategy.num_replicas_in_sync
     global_batch = per_chip_batch * n_chips
     local_batch = per_chip_batch * (jax.local_device_count() if multihost else n_chips)
-    _note(f"backend up: {n_chips} chip(s), platform={jax.devices()[0].platform}")
+    _note(f"backend up: {n_chips} chip(s), platform={platform}")
 
     # Init under ONE jit at a tiny batch: params and BN stats are
     # batch-independent, and an eager init dispatches every conv as its
@@ -195,10 +223,13 @@ def run_bench(
         model,
         input_shape=(8, image_size, image_size, 3),
     )
-    make_state = lambda: strategy.replicate(jax.jit(init_fn)(jax.random.PRNGKey(0)))  # noqa: E731
+    # One jit wrapper, hoisted: a fresh ``jax.jit(init_fn)`` per
+    # remake_state call would recompile init on every transient-retry.
+    jit_init = jax.jit(init_fn)
+    make_state = lambda: strategy.replicate(jit_init(jax.random.PRNGKey(0)))  # noqa: E731
     state = make_state()
     _note("params initialized")
-    train_step = common.make_bn_train_step()
+    train_step = common.make_bn_train_step(grad_comms=gc_cfg)
 
     def multi_step(state, batch):
         def body(st, _):
@@ -208,7 +239,14 @@ def run_bench(
         state, losses = jax.lax.scan(body, state, None, length=scan_chunk)
         return state, losses[-1]
 
-    step_fn = strategy.step(multi_step)
+    # Propagate the inner step's grad-comms marker (and the scan factor,
+    # so the wire-byte counters account every fused optimizer step).
+    multi_step.grad_comms = gc_cfg
+    multi_step.grad_comms_steps = scan_chunk
+    step_fn = strategy.step(multi_step, grad_comms=gc_cfg)
+    gc_pre, gc_post = (
+        gc_lib.wire_bytes(state.params, gc_cfg) if gc_cfg is not None else (0, 0)
+    )
 
     # Each process contributes its own local shard of the global batch.
     rs = np.random.RandomState(jax.process_index())
@@ -224,7 +262,7 @@ def run_bench(
         scan_chunk=scan_chunk, remake_state=make_state,
     )
     samples_per_sec = global_batch * total_steps / elapsed
-    return {
+    result = {
         "samples_per_sec": samples_per_sec,
         "samples_per_sec_per_chip": samples_per_sec / n_chips,
         "step_time_ms": elapsed / total_steps * 1e3,
@@ -232,6 +270,10 @@ def run_bench(
         "global_batch": global_batch,
         "platform": jax.devices()[0].platform,
     }
+    if gc_cfg is not None:
+        result["grad_comms"] = gc_cfg.mode
+        result["grad_comms_compression"] = round(gc_pre / gc_post, 2) if gc_post else 1.0
+    return result
 
 
 def run_lm_bench(
@@ -290,7 +332,9 @@ def run_lm_bench(
     init_fn = functools.partial(
         common.create_train_state, model, input_shape=(1, 8), input_dtype=jnp.int32
     )
-    make_state = lambda: strategy.replicate(jax.jit(init_fn)(jax.random.PRNGKey(0)))  # noqa: E731
+    # Hoisted jit wrapper — same recompile-on-retry fix as run_bench.
+    jit_init = jax.jit(init_fn)
+    make_state = lambda: strategy.replicate(jit_init(jax.random.PRNGKey(0)))  # noqa: E731
     state = make_state()
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
     n_embed = state.params["embed"]["embedding"].size
@@ -424,6 +468,14 @@ def main() -> None:
         help="skip the pre-run relay health probe (saves ~20s when known-healthy)",
     )
     parser.add_argument(
+        "--grad-comms",
+        choices=["none", "quantized", "zero1", "quantized+zero1"],
+        default="none",
+        help="gradient-communication schedule for the ResNet bench: "
+        "block-scaled int8 quantized all-reduce, ZeRO-1 cross-replica "
+        "sharded weight update, or both (hops_tpu.parallel.grad_comms)",
+    )
+    parser.add_argument(
         "--remat", action="store_true",
         help="per-block rematerialization: trade recompute FLOPs for "
         "activation HBM bytes (A/B lever on the bandwidth-bound step)",
@@ -468,6 +520,12 @@ def main() -> None:
                 "path is exercised by dryrun_multichip and the multihost "
                 "integration tests; the LM headline is single-chip"
             )
+        if args.grad_comms != "none":
+            parser.error(
+                "--grad-comms applies to the ResNet data-parallel bench; "
+                "the LM headline is single-chip (no gradient collective "
+                "to optimize)"
+            )
         metric, unit, value_key = "lm_tokens_per_sec_per_chip", "tokens/s/chip", "tokens_per_sec_per_chip"
         batch = args.batch if args.batch is not None else 8
         steps = args.steps if args.steps is not None else 16
@@ -489,7 +547,8 @@ def main() -> None:
         def do_run(**overrides):
             return run_bench(
                 per_chip_batch=batch, steps=steps,
-                scan_chunk=scan_chunk, remat=args.remat, **overrides,
+                scan_chunk=scan_chunk, remat=args.remat,
+                grad_comms=args.grad_comms, **overrides,
             )
 
     if args.smoke:
@@ -545,6 +604,10 @@ def main() -> None:
         entry = recorded.get(baseline_key)
         if entry is not None:
             baseline = entry.get(value_key)
+        elif result.get("grad_comms", "none") != "none":
+            # An optimized-comms run must not become the platform
+            # baseline it is supposed to be compared against.
+            baseline = None
         else:
             recorded[baseline_key] = {
                 value_key: value,
@@ -560,6 +623,13 @@ def main() -> None:
         "unit": unit,
         "vs_baseline": round(value / baseline, 4) if baseline else 1.0,
     }
+    if result.get("grad_comms", "none") != "none":
+        # Attribution: which comms schedule produced this number, and
+        # how many wire bytes it saved (telemetry gauge's value).
+        line.update(
+            grad_comms=result["grad_comms"],
+            grad_comms_compression=result["grad_comms_compression"],
+        )
     if args.lm:
         # The roofline context travels with the number (review item #4:
         # "tokens/s/chip AND MFU% with the same roofline treatment").

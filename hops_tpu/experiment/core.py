@@ -163,13 +163,19 @@ def mirrored(
     name: str = "no-name",
     local_logdir: bool = False,
     metric_key: str | None = None,
+    grad_comms: Any | None = None,
 ) -> tuple[str, dict[str, Any]]:
     """Single-host data-parallel training over this host's chips
     (reference: ``experiment.mirrored`` + ``MirroredStrategy``,
     mirroredstrategy_mnist_example.ipynb:231). The wrapper sees the
     strategy via ``parallel.get_strategy()`` or by constructing
-    ``MirroredStrategy()`` itself."""
-    return _run_wrapper(fn, args, name, "mirrored", local_logdir, metric_key, MirroredStrategy())
+    ``MirroredStrategy()`` itself. ``grad_comms`` (a
+    ``parallel.grad_comms.GradCommsConfig``) becomes the strategy's
+    default gradient-communication config."""
+    return _run_wrapper(
+        fn, args, name, "mirrored", local_logdir, metric_key,
+        MirroredStrategy(grad_comms=grad_comms),
+    )
 
 
 def collective_all_reduce(
@@ -178,14 +184,21 @@ def collective_all_reduce(
     name: str = "no-name",
     local_logdir: bool = False,
     metric_key: str | None = None,
+    grad_comms: Any | None = None,
+    update_sharding: str = "replicated",
 ) -> tuple[str, dict[str, Any]]:
     """Whole-slice data-parallel training; gradient AllReduce over
     ICI/DCN (reference: multi-worker ``experiment.mirrored`` with
     ``MultiWorkerMirroredStrategy``+NCCL, and the
-    ``collective_all_reduce`` mode named in BASELINE.json)."""
+    ``collective_all_reduce`` mode named in BASELINE.json).
+    ``grad_comms``/``update_sharding`` pass through to
+    ``CollectiveAllReduceStrategy`` — ``update_sharding=
+    "cross_replica"`` selects the ZeRO-1 sharded weight update."""
     return _run_wrapper(
         fn, args, name, "collective_all_reduce", local_logdir, metric_key,
-        CollectiveAllReduceStrategy(),
+        CollectiveAllReduceStrategy(
+            update_sharding=update_sharding, grad_comms=grad_comms
+        ),
     )
 
 

@@ -278,27 +278,52 @@ class DataFeeder:
         return schema
 
 
-def prefetch_to_device(iterator: Iterator, size: int = 2, sharding=None) -> Iterator:
+def prefetch_to_device(
+    iterator: Iterator, size: int = 2, sharding=None, name: str = "default"
+) -> Iterator:
     """Overlap H2D transfer with compute: keep ``size`` batches in flight
     on device. With ``sharding`` (a ``jax.sharding.Sharding``) batches land
-    already sharded across the mesh — the multi-chip input path."""
+    already sharded across the mesh — the multi-chip input path.
+
+    The queue refills BEFORE each yield, so the pipeline holds ``size``
+    in-flight batches throughout (not ``size - 1`` after the first
+    yield, which would under-overlap exactly when compute is fastest).
+    Depth is exported as the ``hops_tpu_feed_prefetch_depth`` gauge,
+    labelled ``pipeline=name`` so concurrent feeds (train + eval) don't
+    clobber each other's series.
+    """
     import collections
 
     import jax
 
+    depth = REGISTRY.gauge(
+        "hops_tpu_feed_prefetch_depth",
+        "Batches currently in flight on device in prefetch_to_device",
+        labels=("pipeline",),
+    ).labels(pipeline=name)
+
     queue: collections.deque = collections.deque()
+    it = iter(iterator)
 
     def put(batch):
         if sharding is not None:
             return jax.device_put(batch, sharding)
         return jax.device_put(batch)
 
-    for batch in iterator:
-        queue.append(put(batch))
-        if len(queue) >= size:
-            yield queue.popleft()
+    def refill():
+        while len(queue) < size:
+            try:
+                queue.append(put(next(it)))
+            except StopIteration:
+                return
+
+    refill()
     while queue:
-        yield queue.popleft()
+        out = queue.popleft()
+        refill()
+        depth.set(len(queue))
+        yield out
+    depth.set(0)
 
 
 def pack_documents(

@@ -51,6 +51,8 @@ def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 def make_train_step(
     loss_fn: Callable[..., Any] | None = None,
+    grad_comms: Any | None = None,
+    axis_name: Any = "data",
 ) -> Callable[[TrainState, dict[str, jax.Array]], tuple[TrainState, dict[str, jax.Array]]]:
     """Classification train step: grads + update + loss/accuracy metrics.
 
@@ -60,6 +62,13 @@ def make_train_step(
     mutable collection. The dropout RNG is folded per step from
     ``state.rng``. The presence of ``batch_stats`` is static at trace
     time, so both paths jit cleanly.
+
+    With a ``grad_comms`` config (``parallel.grad_comms.GradCommsConfig``)
+    the step takes explicit control of gradient synchronization —
+    bucketed/quantized all-reduce or the ZeRO-1 sharded update — and
+    must then run inside ``shard_map`` over ``axis_name``, which
+    ``Strategy.step(fn, grad_comms=cfg)`` arranges. Metrics and
+    BatchNorm updates are pmean'd across the axis on that path.
     """
 
     def train_step(state: TrainState, batch: dict[str, jax.Array]):
@@ -88,6 +97,27 @@ def make_train_step(
         (loss, (logits, updates)), grads = jax.value_and_grad(compute_loss, has_aux=True)(
             state.params
         )
+        if grad_comms is not None:
+            # Inside shard_map nothing is implicit: grads/metrics/BN
+            # stats are per-replica and reduced explicitly through the
+            # grad-comms layer (quantized / bucketed / ZeRO-1 sharded).
+            from hops_tpu.parallel import grad_comms as gc
+
+            extra = {}
+            if has_bn:
+                extra["batch_stats"] = jax.tree.map(
+                    lambda x: jax.lax.pmean(x, axis_name), updates["batch_stats"]
+                )
+            new_state = gc.apply_gradients(
+                state, grads, grad_comms, axis_name=axis_name, extra_updates=extra
+            )
+            metrics = {
+                "loss": jax.lax.pmean(loss, axis_name),
+                "accuracy": jax.lax.pmean(
+                    accuracy(logits, batch["label"]), axis_name
+                ),
+            }
+            return new_state, metrics
         # Replicated-params + sharded-batch shardings make XLA reduce
         # `grads` across the data axis here (AllReduce over ICI).
         if has_bn:
@@ -96,6 +126,10 @@ def make_train_step(
             new_state = state.apply_gradients(grads=grads)
         return new_state, {"loss": loss, "accuracy": accuracy(logits, batch["label"])}
 
+    # Marker read by Strategy.step: a step that syncs its own gradients
+    # (grad_comms set) must not run under the implicit-AllReduce jit,
+    # and vice versa — mismatches would train without sync, silently.
+    train_step.grad_comms = grad_comms
     return train_step
 
 
@@ -134,9 +168,11 @@ def create_bn_train_state(
 
 def make_bn_train_step(
     loss_fn: Callable[..., Any] | None = None,
+    grad_comms: Any | None = None,
+    axis_name: Any = "data",
 ) -> Callable[[BNTrainState, dict[str, jax.Array]], tuple[BNTrainState, dict[str, jax.Array]]]:
     """Alias of :func:`make_train_step`, which handles BatchNorm states."""
-    return make_train_step(loss_fn)
+    return make_train_step(loss_fn, grad_comms=grad_comms, axis_name=axis_name)
 
 
 def make_eval_step() -> Callable[..., dict[str, jax.Array]]:

@@ -7,7 +7,13 @@ collectives (AllReduce/AllGather/ReduceScatter) over ICI within a slice
 and DCN across slices.
 """
 
-from hops_tpu.parallel import mesh, multihost, strategy  # noqa: F401
+from hops_tpu.parallel import grad_comms, mesh, multihost, strategy  # noqa: F401
+from hops_tpu.parallel.grad_comms import (  # noqa: F401
+    GradCommsConfig,
+    all_reduce_grads,
+    psum_quantized,
+    sharded_apply_gradients,
+)
 from hops_tpu.parallel.tp_inference import (  # noqa: F401
     tp_generate,
     tp_generate_speculative,

@@ -38,10 +38,19 @@ class Strategy:
     """Base: data-parallel SPMD over an arbitrary mesh."""
 
     def __init__(
-        self, mesh: Mesh | None = None, data_axis: str | tuple[str, ...] = "data"
+        self,
+        mesh: Mesh | None = None,
+        data_axis: str | tuple[str, ...] = "data",
+        grad_comms: "Any | None" = None,
     ):
         self.mesh = mesh if mesh is not None else mesh_lib.global_mesh()
         self.data_axis = data_axis
+        #: Default ``grad_comms.GradCommsConfig`` for :meth:`step` — None
+        #: keeps XLA's implicit gradient AllReduce.
+        self.grad_comms = grad_comms
+        # Compiled steps memoized per (fn, donate_state, config): a fresh
+        # ``jax.jit`` wrapper per call would recompile every time.
+        self._step_cache: dict[Any, Callable[..., Any]] = {}
 
     # -- introspection (reference: strategy.num_replicas_in_sync) ------------
 
@@ -76,18 +85,83 @@ class Strategy:
         self,
         fn: Callable[..., Any],
         donate_state: bool = True,
+        grad_comms: "Any | None" = None,
     ) -> Callable[..., Any]:
-        """Compile ``fn(state, batch, ...) -> (state, aux)`` as one SPMD
-        step: state replicated, batch sharded, XLA inserts the gradient
-        collectives. The compiled step is cached by jit."""
-        rep = mesh_lib.replicated(self.mesh)
-        data = NamedSharding(self.mesh, P(self.data_axis))
-        return jax.jit(
-            fn,
-            in_shardings=(rep, data),
-            out_shardings=(rep, rep),
-            donate_argnums=(0,) if donate_state else (),
-        )
+        """Compile ``fn(state, batch) -> (state, aux)`` as one SPMD step:
+        state replicated, batch sharded.
+
+        Default path: XLA inserts the gradient collectives. With a
+        ``grad_comms.GradCommsConfig`` (argument here or on the
+        strategy), ``fn`` instead runs inside ``shard_map`` over the
+        data axis and must do its own cross-replica reduction — build it
+        with ``models.common.make_train_step(grad_comms=cfg)``, which
+        routes gradients through the bucketed/quantized/ZeRO-1
+        collectives in :mod:`hops_tpu.parallel.grad_comms`. Compiled
+        steps are memoized per ``(fn, donate_state, config)`` so
+        repeated :meth:`step`/:meth:`run` calls reuse the executable.
+        """
+        cfg = grad_comms if grad_comms is not None else self.grad_comms
+        key = (fn, donate_state, cfg)
+        cached = self._step_cache.get(key)
+        if cached is not None:
+            return cached
+        donate = (0,) if donate_state else ()
+        # Inside shard_map nothing syncs gradients implicitly, so a step
+        # fn that was not built for explicit comms would train WITHOUT
+        # cross-replica reduction and silently diverge per device (and a
+        # grad-comms fn under plain jit hits unbound psum axes). The
+        # ``grad_comms`` marker that make_train_step stamps on its steps
+        # (copy it onto wrappers that close over one) makes both
+        # mismatches loud here instead.
+        marker = getattr(fn, "grad_comms", None)
+        if cfg is not None:
+            if marker is None:
+                raise ValueError(
+                    "Strategy.step(grad_comms=...) runs fn inside shard_map "
+                    "with NO implicit gradient AllReduce; fn must reduce its "
+                    "own gradients. Build it with models.common."
+                    "make_train_step(grad_comms=cfg) (or set fn.grad_comms = "
+                    "cfg on a wrapper around such a step)."
+                )
+            if marker != cfg:
+                raise ValueError(
+                    f"fn was built for grad_comms config {marker}, but the "
+                    f"step was asked to run {cfg}; pass the same config to "
+                    "make_train_step and Strategy.step"
+                )
+            from jax.experimental.shard_map import shard_map
+
+            from hops_tpu.parallel import grad_comms as gc
+
+            inner = shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(P(), P(self.data_axis)),
+                out_specs=(P(), P()),
+                check_rep=False,
+            )
+            stepped = gc.instrument_step(
+                jax.jit(inner, donate_argnums=donate),
+                cfg,
+                steps_per_call=getattr(fn, "grad_comms_steps", 1),
+            )
+        elif marker is not None:
+            raise ValueError(
+                "fn was built with an explicit grad_comms config "
+                f"({marker}) and reduces its own gradients inside "
+                "shard_map; run it via Strategy.step(fn, grad_comms=cfg)"
+            )
+        else:
+            rep = mesh_lib.replicated(self.mesh)
+            data = NamedSharding(self.mesh, P(self.data_axis))
+            stepped = jax.jit(
+                fn,
+                in_shardings=(rep, data),
+                out_shardings=(rep, rep),
+                donate_argnums=donate,
+            )
+        self._step_cache[key] = stepped
+        return stepped
 
     def run(self, fn: Callable[..., Any], state: Any, batch: Any) -> Any:
         return self.step(fn)(state, self.distribute_batch(batch))
@@ -107,17 +181,36 @@ class MirroredStrategy(Strategy):
     """Data parallelism over the chips of ONE host (reference:
     single-host ``tf.distribute.MirroredStrategy``)."""
 
-    def __init__(self, data_axis: str = "data"):
-        super().__init__(mesh_lib.local_mesh((data_axis,)), data_axis)
+    def __init__(self, data_axis: str = "data", grad_comms: Any | None = None):
+        super().__init__(mesh_lib.local_mesh((data_axis,)), data_axis, grad_comms)
 
 
 class CollectiveAllReduceStrategy(Strategy):
     """Data parallelism over the WHOLE slice; gradients AllReduce over
     ICI/DCN (reference: ``MultiWorkerMirroredStrategy`` with NCCL —
-    SURVEY.md §2.9 row 2)."""
+    SURVEY.md §2.9 row 2).
 
-    def __init__(self, data_axis: str = "data"):
-        super().__init__(mesh_lib.global_mesh((data_axis,)), data_axis)
+    ``update_sharding="cross_replica"`` switches the weight update to
+    the ZeRO-1 reduce-scatter/sharded-update/all-gather schedule
+    (:mod:`hops_tpu.parallel.grad_comms`); ``grad_comms`` takes a full
+    ``GradCommsConfig`` (quantization, bucket size) and wins over the
+    shorthand's defaults.
+    """
+
+    def __init__(
+        self,
+        data_axis: str = "data",
+        update_sharding: str = "replicated",
+        grad_comms: Any | None = None,
+    ):
+        if update_sharding != "replicated":
+            import dataclasses
+
+            from hops_tpu.parallel.grad_comms import GradCommsConfig
+
+            base = grad_comms if grad_comms is not None else GradCommsConfig()
+            grad_comms = dataclasses.replace(base, update_sharding=update_sharding)
+        super().__init__(mesh_lib.global_mesh((data_axis,)), data_axis, grad_comms)
 
 
 # The reference docs name ParameterServerStrategy as a supported mode but
@@ -183,8 +276,25 @@ class ShardedStrategy(Strategy):
 
     # FSDP/TP state is heterogeneous, so jit infers shardings from the
     # placed arguments instead of the base class's uniform in_shardings.
-    def step(self, fn: Callable[..., Any], donate_state: bool = True) -> Callable[..., Any]:
-        return jax.jit(fn, donate_argnums=(0,) if donate_state else ())
+    def step(
+        self,
+        fn: Callable[..., Any],
+        donate_state: bool = True,
+        grad_comms: Any | None = None,
+    ) -> Callable[..., Any]:
+        if grad_comms is not None or self.grad_comms is not None:
+            raise ValueError(
+                "ShardedStrategy already owns its collectives via GSPMD "
+                "annotations; grad_comms applies to the data-parallel "
+                "strategies (Strategy/Mirrored/CollectiveAllReduce)"
+            )
+        key = (fn, donate_state, None)
+        cached = self._step_cache.get(key)
+        if cached is None:
+            cached = self._step_cache[key] = jax.jit(
+                fn, donate_argnums=(0,) if donate_state else ()
+            )
+        return cached
 
 
 def current_strategy() -> "Strategy | None":

@@ -205,7 +205,13 @@ class _HistogramChild:
 
     def observe(self, value: float) -> None:
         value = float(value)
-        i = bisect.bisect_left(self.bounds, value)
+        # NaN compares false against every bound (bisect would file it
+        # under the SMALLEST bucket); Prometheus clients count it only
+        # in +Inf/_count, so route it to the overflow slot.
+        if math.isnan(value):
+            i = len(self.bounds)
+        else:
+            i = bisect.bisect_left(self.bounds, value)
         with self._lock:
             self.counts[i] += 1
             self.sum += value
@@ -290,6 +296,19 @@ class Registry:
                         f"{existing.type}{existing.label_names}, "
                         f"conflicting re-declaration"
                     )
+                if "buckets" in kwargs:
+                    # Same normalization the Histogram ctor applies —
+                    # silently handing back differently-bucketed series
+                    # would corrupt the second declarer's quantiles.
+                    wanted = tuple(sorted(
+                        float(b) for b in kwargs["buckets"] if not math.isinf(b)
+                    ))
+                    if wanted != existing.buckets:
+                        raise ValueError(
+                            f"histogram {name!r} already registered with "
+                            f"buckets {existing.buckets}, conflicting "
+                            f"re-declaration with {wanted}"
+                        )
                 return existing
             metric = cls(name, help, label_names, **kwargs)
             self._metrics[name] = metric

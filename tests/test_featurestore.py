@@ -991,3 +991,29 @@ def test_pack_documents_lm_layout():
 
     with pytest.raises(ValueError, match="too short"):
         pack_documents([[1]], seq_len=8, eos_id=99)
+
+
+def test_prefetch_to_device_keeps_full_depth():
+    """After the first yield the pipeline must still hold ``size``
+    batches in flight (the refill happens BEFORE the yield), and the
+    depth gauge reports it."""
+    from hops_tpu.featurestore.feed import prefetch_to_device
+    from hops_tpu.telemetry import REGISTRY
+
+    produced = []
+
+    def gen():
+        for i in range(6):
+            produced.append(i)
+            yield np.full((2,), i, np.float32)
+
+    it = prefetch_to_device(gen(), size=3, name="t-prefetch")
+    first = next(it)
+    assert first[0] == 0
+    # 3 on device + the one just handed out -> 4 produced, not 3.
+    assert len(produced) == 4
+    depth = REGISTRY.gauge("hops_tpu_feed_prefetch_depth", labels=("pipeline",))
+    assert depth.value(pipeline="t-prefetch") == 3
+    rest = [int(b[0]) for b in it]
+    assert rest == [1, 2, 3, 4, 5]
+    assert depth.value(pipeline="t-prefetch") == 0
