@@ -55,14 +55,21 @@ def train(ckpt_dir: str, batches, preempt_at: int | None = None) -> dict:
 def main(num_steps: int = 10, preempt_at: int = 4) -> dict:
     import numpy as np
 
+    from hops_tpu.featurestore.loader import ArraySource, DataLoader
+
+    # The staged parallel input pipeline (featurestore/loader.py) as the
+    # batch stream: run_preemptible checkpoints its (seed, epoch, step)
+    # position in a data-state sidecar, so the second incarnation
+    # resumes the EXACT remaining batch stream — no batches re-seen, no
+    # batches skipped — with decode overlapped on worker threads.
     rs = np.random.RandomState(0)
-    batches = [
-        {
-            "image": rs.rand(8, 28, 28, 1).astype(np.float32),
-            "label": rs.randint(0, 10, 8),
-        }
-        for _ in range(num_steps)
-    ]
+    batches = DataLoader(
+        ArraySource({
+            "image": rs.rand(num_steps * 8, 28, 28, 1).astype(np.float32),
+            "label": rs.randint(0, 10, num_steps * 8),
+        }),
+        batch_size=8, num_epochs=1, seed=0, num_workers=2,
+    )
     ckpt_dir = tempfile.mkdtemp(prefix="preemptible_")
 
     first = train(ckpt_dir, batches, preempt_at=preempt_at)

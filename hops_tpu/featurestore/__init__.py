@@ -28,6 +28,12 @@ from __future__ import annotations
 from hops_tpu.featurestore.connection import Connection, connection  # noqa: F401
 from hops_tpu.featurestore.feature import Feature, Filter, Logic  # noqa: F401
 from hops_tpu.featurestore.feature_group import FeatureGroup  # noqa: F401
+from hops_tpu.featurestore.loader import (  # noqa: F401
+    ArraySource,
+    DataLoader,
+    RecordIOSource,
+    Source,
+)
 from hops_tpu.featurestore.query import Query  # noqa: F401
 from hops_tpu.featurestore.statistics import StatisticsConfig  # noqa: F401
 from hops_tpu.featurestore.training_dataset import TrainingDataset  # noqa: F401
@@ -37,6 +43,10 @@ from hops_tpu.featurestore import bias  # noqa: F401
 __all__ = [
     "Connection",
     "connection",
+    "ArraySource",
+    "DataLoader",
+    "RecordIOSource",
+    "Source",
     "Feature",
     "Filter",
     "Logic",

@@ -133,28 +133,7 @@ class DataFeeder:
         layout_checked = False
 
         def check_layout(global_shape):
-            # The slicing above hands this process global rows
-            # [lo, lo + local_bs); the sharding must place this
-            # process's addressable shards at exactly those rows, or
-            # assembly would silently permute the global batch.
-            import jax  # noqa: F811
-
-            rows: set[int] = set()
-            for idx in sharding.addressable_devices_indices_map(
-                tuple(global_shape)
-            ).values():
-                start, stop, _ = idx[0].indices(global_shape[0])
-                rows.update(range(start, stop))
-            want = set(range(lo, lo + local_bs))
-            if rows != want:
-                raise ValueError(
-                    f"sharding assigns this process global rows "
-                    f"{sorted(rows)[:4]}.., but process_sharded slicing "
-                    f"yields rows {lo}..{lo + local_bs - 1}: the batch "
-                    "sharding must be process-major over the leading dim "
-                    "(mesh built from jax.devices() order, batch axis "
-                    "first)"
-                )
+            check_process_batch_layout(sharding, global_shape, lo, local_bs)
 
         def assemble(batch):
             import jax
@@ -209,6 +188,33 @@ class DataFeeder:
                 m_examples.inc(len(bx))
                 yield assemble(out) if sharding is not None else out
             epoch += 1
+
+    # -- parallel pipeline ----------------------------------------------------
+
+    def loader(
+        self,
+        batch_size: int,
+        num_workers: int = 2,
+        **kwargs: Any,
+    ):
+        """The staged parallel pipeline over this feeder's materialized
+        split: a :class:`hops_tpu.featurestore.loader.DataLoader` with
+        snapshot/restore and per-stage telemetry (shuffle defaults to
+        ``is_training``). Its stream is byte-identical across the
+        loader's own worker counts — but NOT to
+        :meth:`numpy_iterator`'s: the two derive shuffle orders from
+        different RNG streams, so a seed that reproduced one does not
+        reproduce the other (mid-run migration should resume via the
+        loader's own ``state_dict``, not ``start_step``). See
+        ``loader.py`` for the knobs (``queue_depth``, ``transform``,
+        ``process_sharded``, ``reuse_buffers``...)."""
+        from hops_tpu.featurestore.loader import ArraySource, DataLoader
+
+        kwargs.setdefault("shuffle", self.is_training)
+        return DataLoader(
+            ArraySource.from_feeder(self), batch_size,
+            num_workers=num_workers, **kwargs,
+        )
 
     # -- tf.data compatibility ------------------------------------------------
 
@@ -276,6 +282,31 @@ class DataFeeder:
             else:
                 schema[f.name] = tf.io.FixedLenFeature([], tf.string)
         return schema
+
+
+def check_process_batch_layout(sharding, global_shape, lo: int, local_bs: int) -> None:
+    """Validate that ``sharding`` places THIS process's addressable
+    shards at exactly global rows ``[lo, lo + local_bs)`` — the rows the
+    process-sharded slicing yields. A mismatched layout would silently
+    permute the global batch during ``make_array_from_process_local_data``
+    assembly. Shared by ``DataFeeder.numpy_iterator`` and the parallel
+    ``loader.DataLoader`` pipeline."""
+    rows: set[int] = set()
+    for idx in sharding.addressable_devices_indices_map(
+        tuple(global_shape)
+    ).values():
+        start, stop, _ = idx[0].indices(global_shape[0])
+        rows.update(range(start, stop))
+    want = set(range(lo, lo + local_bs))
+    if rows != want:
+        raise ValueError(
+            f"sharding assigns this process global rows "
+            f"{sorted(rows)[:4]}.., but process-sharded slicing "
+            f"yields rows {lo}..{lo + local_bs - 1}: the batch "
+            "sharding must be process-major over the leading dim "
+            "(mesh built from jax.devices() order, batch axis "
+            "first)"
+        )
 
 
 def prefetch_to_device(

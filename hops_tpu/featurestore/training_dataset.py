@@ -325,6 +325,17 @@ class TrainingDataset:
         return DataFeeder(self, target_name=target_name, split=split,
                           feature_names=feature_names, is_training=is_training)
 
+    def loader(self, batch_size: int, target_name: str | None = None,
+               split: str | None = None, is_training: bool = True,
+               **kwargs: Any):
+        """The staged parallel input pipeline over this TD
+        (``featurestore/loader.py``): sharded readers → threaded decode
+        → packed batch assembly → ``prefetch_to_device``, with
+        snapshot/restore for preemption resume. Equivalent to
+        ``td.tf_data(...).loader(batch_size, ...)``."""
+        return self.tf_data(target_name=target_name, split=split,
+                            is_training=is_training).loader(batch_size, **kwargs)
+
     # -- online serving vectors ----------------------------------------------
 
     @property

@@ -160,10 +160,10 @@ class _Request:
     top_k: int = 0  # 0 = no top-k truncation
     top_p: float = 0.0  # 0 = no nucleus truncation
     seed: int = 0
-    # (cache, length) snapshot taken at submit time: re-registering the
-    # name later must not invalidate this request's capacity validation
-    # or swap its prefix mid-queue.
-    prefix: tuple[Any, int] | None = None
+    # (target_cache, draft_cache_or_None, length) snapshot taken at
+    # submit time: re-registering the name later must not invalidate
+    # this request's capacity validation or swap its prefix mid-queue.
+    prefix: tuple[Any, Any | None, int] | None = None
     # monotonic submit time — the TTFT histogram's start mark.
     submitted_at: float = 0.0
 

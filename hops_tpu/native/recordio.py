@@ -102,7 +102,14 @@ class RecordWriter:
 
 
 class RecordReader:
-    """O(1) random access over a record file."""
+    """O(1) random access over a record file.
+
+    Thread-safety: the native path is safe to share across threads
+    (``rio_read``/``rio_read_batch`` use positioned ``pread`` — no
+    seek state); the pure-Python fallback serializes its shared file
+    object's seek+read under a lock, so a reader handed to a decode
+    pool (``featurestore/loader.py``) behaves identically on both
+    paths — the fallback just doesn't overlap its reads."""
 
     def __init__(self, path: str | Path):
         self._path = str(path)
@@ -114,6 +121,9 @@ class RecordReader:
             self._n = int(lib.rio_num_records(self._h))
         else:
             self._lib = None
+            import threading
+
+            self._f_lock = threading.Lock()
             self._f = open(self._path, "rb")
             idx = Path(self._path + ".idx")
             if idx.exists():
@@ -149,9 +159,10 @@ class RecordReader:
             finally:
                 self._lib.rio_free(out)
         off = self._offsets[i]
-        self._f.seek(off)
-        (length,) = _HDR.unpack(self._f.read(_HDR.size))
-        return self._f.read(length)
+        with self._f_lock:
+            self._f.seek(off)
+            (length,) = _HDR.unpack(self._f.read(_HDR.size))
+            return self._f.read(length)
 
     def read_batch(self, indices, n_threads: int = 4) -> list[bytes]:
         """Gather many records in ONE native call.

@@ -367,7 +367,7 @@ def sharded_apply_gradients(
 
     # 1. Bucket + pad the gradients and reduce-scatter each buffer;
     #    every replica ends up with the mean-gradient slice it owns.
-    gbufs, layout = flatten_buckets(grads, cfg.bucket_bytes, pad_multiple=n)
+    gbufs, _ = flatten_buckets(grads, cfg.bucket_bytes, pad_multiple=n)
     gshards = []
     for buf in gbufs:
         if cfg.quantize and jnp.issubdtype(buf.dtype, jnp.floating):
@@ -377,7 +377,10 @@ def sharded_apply_gradients(
 
     # 2. Slice the same flat layout out of params and the param-shaped
     #    optimizer-state subtrees (no communication: state is replicated).
-    pbufs, _ = flatten_buckets(state.params, cfg.bucket_bytes, pad_multiple=n)
+    #    The params layout is kept for the unflatten in step 4: grads
+    #    may arrive in a different dtype (bf16 comms casts), and the
+    #    grads layout's dtypes would silently downcast the params.
+    pbufs, playout = flatten_buckets(state.params, cfg.bucket_bytes, pad_multiple=n)
     pshards = [_shard_slice(b, n, idx) for b in pbufs]
     is_param_like = _param_subtree_pred(state.params)
     opt_vals, opt_def = jax.tree.flatten(state.opt_state, is_leaf=is_param_like)
@@ -400,7 +403,7 @@ def sharded_apply_gradients(
     # 4. All-gather updated params (and moments, to keep the state
     #    contract replicated) and restore the original tree layout.
     new_params = unflatten_buckets(
-        [lax.all_gather(s, axis_name, tiled=True) for s in new_pshards], layout
+        [lax.all_gather(s, axis_name, tiled=True) for s in new_pshards], playout
     )
     new_opt_vals = []
     # flatten_up_to keeps each leaf slot's value intact (a param-shaped

@@ -40,6 +40,50 @@ def _default_dir() -> str:
     return str(Path(rundir.logdir()) / "checkpoints")
 
 
+def default_directory() -> str:
+    """The directory a ``CheckpointManager()`` with no argument uses:
+    the active run's ``checkpoints/`` subdir (or the logdir fallback)."""
+    return _default_dir()
+
+
+# -- data-state sidecars ------------------------------------------------------
+#
+# Input-pipeline iterator state (epoch, shard cursor, seed — see
+# featurestore/loader.py) is a tiny JSON-able dict, not a sharded array
+# pytree; storing it INSIDE the orbax tree would change the checkpoint
+# structure for every restore template that predates it. It rides
+# alongside instead: one small JSON file per checkpointed step, written
+# atomically, so `run_preemptible` can resume the exact batch stream.
+
+
+def _data_state_path(directory: str | Path, step: int) -> Path:
+    return Path(directory) / f"data_state_{int(step)}.json"
+
+
+def save_data_state(directory: str | Path | None, step: int, state: dict) -> None:
+    """Persist an input-pipeline snapshot next to checkpoint ``step``."""
+    import json
+    import os
+
+    path = _data_state_path(directory or _default_dir(), step)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps(state))
+    os.replace(tmp, path)
+
+
+def load_data_state(directory: str | Path | None, step: int) -> dict | None:
+    """The input-pipeline snapshot saved with checkpoint ``step``, or
+    None if that step carries no data state (pre-loader checkpoints)."""
+    import json
+
+    path = _data_state_path(directory or _default_dir(), step)
+    try:
+        return json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+
+
 def abstract_state(state: Any) -> Any:
     """Shape/dtype/sharding skeleton of a pytree, for targeted restore."""
 
@@ -79,6 +123,29 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         return self._mgr.save(int(step), args=ocp.args.StandardSave(state), force=force)
+
+    def save_data_state(self, step: int, state: dict) -> None:
+        """Sidecar snapshot of input-pipeline state for ``step`` (see
+        :func:`save_data_state`). Sidecars whose checkpoint step orbax
+        has pruned (``max_to_keep``) are unlinked here — they no longer
+        correspond to any restorable step and would otherwise
+        accumulate one file per save forever."""
+        save_data_state(self.directory, step, state)
+        keep = set(self.all_steps())
+        keep.add(int(step))  # an async save may not be finalized yet
+        for p in self.directory.glob("data_state_*.json"):
+            try:
+                s = int(p.stem.rsplit("_", 1)[-1])
+            except ValueError:
+                continue
+            if s not in keep:
+                try:
+                    p.unlink()
+                except FileNotFoundError:
+                    pass
+
+    def load_data_state(self, step: int) -> dict | None:
+        return load_data_state(self.directory, step)
 
     def restore(self, state_template: Any, step: int | None = None) -> Any:
         """Restore into the template's shapes/dtypes/shardings.

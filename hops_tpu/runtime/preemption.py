@@ -149,13 +149,24 @@ def run_preemptible(
     ``batches`` is either a plain iterable — steps already completed
     before resume are drawn and discarded — or a callable
     ``batches(start_step) -> iterable`` that produces the stream
-    already fast-forwarded (e.g.
+    already fast-forwarded (e.g. a ``featurestore.DataLoader``, or
     ``lambda k: feeder.numpy_iterator(..., start_step=k)``), so resume
     skips no data materialization at all.
+
+    Resumable iterators (anything exposing ``state_dict`` /
+    ``load_state_dict`` — the loader pipeline's iterators): each
+    checkpoint save also writes a data-state sidecar
+    (``checkpoint.save_data_state``), and resume repositions the
+    iterator from the restored step's sidecar, so the exact remaining
+    batch stream replays deterministically.
     """
     import jax
 
-    from hops_tpu.runtime.checkpoint import CheckpointManager, restore_or_init
+    from hops_tpu.runtime.checkpoint import (
+        CheckpointManager,
+        load_data_state,
+        restore_or_init,
+    )
 
     own_guard = guard is None
     guard = guard or PreemptionGuard()
@@ -164,10 +175,19 @@ def run_preemptible(
     state, start = restore_or_init(state, directory)
     metrics = None
     step = start - 1
-    if callable(batches):
-        stream = enumerate(batches(start), start=start)
+    src = batches(start) if callable(batches) else batches
+    resumable = hasattr(src, "state_dict") and hasattr(src, "load_state_dict")
+    data_state = load_data_state(directory, start - 1) if start else None
+    if resumable and data_state is not None:
+        # The sidecar's position (next-unyielded batch at save time) is
+        # authoritative — it repositions even streams the callable path
+        # already fast-forwarded, covering iterators whose position is
+        # not a pure function of the step count.
+        src.load_state_dict(data_state)
+    if callable(batches) or (resumable and data_state is not None):
+        stream = enumerate(src, start=start)
     else:
-        stream = enumerate(batches)
+        stream = enumerate(src)
     # Step-cadence telemetry: step time, steps/examples counters, and
     # the heartbeat gauges — the signal a diagnostics.Watchdog(
     # watch_heartbeat_gauge="preemptible") reads instead of needing an
@@ -184,12 +204,16 @@ def run_preemptible(
                 state, metrics = train_step(state, batch)
                 timer.tick(examples=_batch_examples(batch))
                 saved = ckpt.save(step, state)  # interval save
+                if saved and resumable:
+                    ckpt.save_data_state(step, src.state_dict())
                 if guard.should_stop(sync=sync):
                     if not saved:
                         # orbax refuses to overwrite an existing step
                         # even with force=True — only save if the
                         # interval save didn't just write this step.
                         ckpt.save(step, state, force=True)
+                        if resumable:
+                            ckpt.save_data_state(step, src.state_dict())
                     log.warning("preempted: checkpointed step %d, exiting "
                                 "cleanly", step)
                     break
@@ -199,6 +223,8 @@ def run_preemptible(
                 # redone by the next incarnation after a hard kill.
                 if ran and not saved:
                     ckpt.save(step, state, force=True)
+                    if resumable:
+                        ckpt.save_data_state(step, src.state_dict())
             ckpt.wait()
     finally:
         if own_guard:

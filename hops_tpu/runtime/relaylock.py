@@ -40,6 +40,12 @@ from typing import Iterator
 ENV_LOCK_PATH = "HOPS_TPU_RELAY_LOCK"
 ENV_TOKEN = "HOPS_TPU_RELAY_TOKEN"
 
+#: How long an existing-but-unparsable lock file (empty / corrupt JSON)
+#: may persist before it is treated as stale and broken. Long enough to
+#: never race a healthy acquirer's create->write window (microseconds),
+#: short enough that a crash mid-write can't wedge every future client.
+UNREADABLE_GRACE_S = 1.0
+
 
 def lock_path() -> Path:
     override = os.environ.get(ENV_LOCK_PATH)
@@ -107,6 +113,28 @@ def _break_stale(path: Path, stale_pid: int) -> None:
                 pass
 
 
+def _break_unreadable(path: Path, grace_s: float) -> None:
+    """Unlink a lock that exists but cannot be parsed, iff it has been
+    sitting unreadable for at least ``grace_s`` (by mtime). Serialized
+    under the same flock'd guard as :func:`_break_stale` so two racers
+    can't double-break and unlink a NEW holder's fresh lock; the mtime
+    re-check under the guard keeps a mid-write (fresh, briefly-empty)
+    lock safe."""
+    import fcntl
+
+    guard = path.with_name(path.name + ".guard")
+    with open(guard, "w") as g:
+        fcntl.flock(g, fcntl.LOCK_EX)
+        try:
+            if (
+                _read_owner(path) is None
+                and time.time() - path.stat().st_mtime >= grace_s
+            ):
+                path.unlink()
+        except FileNotFoundError:
+            pass  # vanished while we checked: nothing to break
+
+
 def current_owner() -> dict | None:
     """The live holder's `{pid, purpose, ts}`, or None if the lock is free.
 
@@ -146,13 +174,40 @@ def relay_lock(purpose: str, wait_s: float = 0.0, poll_s: float = 5.0) -> Iterat
         yield
         return
     deadline = time.monotonic() + wait_s
+    unreadable_since: float | None = None
     while True:
         owner = current_owner()  # also breaks stale locks
         if owner is None:
             try:
                 fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
             except FileExistsError:
-                continue  # raced another acquirer; re-check liveness
+                # The lock exists but current_owner() saw no owner:
+                # either it vanished mid-race (retry immediately) or it
+                # is unreadable (empty/corrupt — a crash mid-write).
+                # The latter used to busy-spin here forever; now it
+                # sleeps, raises RelayBusy at the deadline, and breaks
+                # a persistently unreadable lock after a grace period.
+                if _read_owner(path) is not None or not path.exists():
+                    unreadable_since = None
+                    continue  # readable/gone: the next probe classifies it
+                now = time.monotonic()
+                if unreadable_since is None:
+                    unreadable_since = now
+                elif now - unreadable_since >= UNREADABLE_GRACE_S:
+                    _break_unreadable(path, UNREADABLE_GRACE_S)
+                    unreadable_since = None
+                    continue
+                if now >= deadline:
+                    raise RelayBusy({
+                        "pid": None,
+                        "purpose": f"unreadable lock file at {path} "
+                                   "(empty or corrupt; not holder JSON)",
+                        "ts": "?",
+                    })
+                time.sleep(min(poll_s, 0.05,
+                               max(0.01, deadline - time.monotonic())))
+                continue
+            unreadable_since = None
             with os.fdopen(fd, "w") as f:
                 json.dump(
                     {"pid": os.getpid(), "purpose": purpose,

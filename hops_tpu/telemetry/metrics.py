@@ -296,10 +296,13 @@ class Registry:
                         f"{existing.type}{existing.label_names}, "
                         f"conflicting re-declaration"
                     )
-                if "buckets" in kwargs:
+                if kwargs.get("buckets") is not None:
                     # Same normalization the Histogram ctor applies —
                     # silently handing back differently-bucketed series
                     # would corrupt the second declarer's quantiles.
+                    # buckets=None (a read-back, not a declaration)
+                    # skips the check: readers must not have to restate
+                    # the declarer's buckets.
                     wanted = tuple(sorted(
                         float(b) for b in kwargs["buckets"] if not math.isinf(b)
                     ))
@@ -310,6 +313,8 @@ class Registry:
                             f"re-declaration with {wanted}"
                         )
                 return existing
+            if "buckets" in kwargs and kwargs["buckets"] is None:
+                kwargs["buckets"] = DEFAULT_BUCKETS
             metric = cls(name, help, label_names, **kwargs)
             self._metrics[name] = metric
             return metric
@@ -324,7 +329,11 @@ class Registry:
 
     def histogram(self, name: str, help: str = "",
                   labels: Iterable[str] = (),
-                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+                  buckets: Iterable[float] | None = None) -> Histogram:
+        """``buckets=None`` means "declarer's default" on first
+        registration (:data:`DEFAULT_BUCKETS`) and "whatever was
+        declared" on read-back — explicit buckets are a declaration and
+        must match any existing one."""
         return self._get_or_create(Histogram, name, help, labels,
                                    buckets=buckets)
 

@@ -43,6 +43,15 @@ def test_grad_comms_registered_in_drift_guard():
     assert "hops_tpu.parallel.grad_comms" in _module_names()
 
 
+def test_loader_registered_in_drift_guard():
+    """The parallel input pipeline is the training hot path's host half
+    and sits on APIs with rename history (numpy Generator seeding,
+    jax.process_index for per-host sharding); pin it here so a file
+    move or rename surfaces as one named failure instead of a silent
+    drop from the parametrized sweep."""
+    assert "hops_tpu.featurestore.loader" in _module_names()
+
+
 @pytest.mark.parametrize("name", _module_names())
 def test_module_imports(name):
     try:

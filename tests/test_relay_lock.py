@@ -12,6 +12,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 import pytest
@@ -92,6 +93,43 @@ def test_stale_lock_broken_automatically(isolated_lock):
     assert current_owner() is None  # stale: broken on inspection
     with relay_lock("after crash"):
         assert json.loads(isolated_lock.read_text())["pid"] == os.getpid()
+
+
+def test_unreadable_lock_refused_not_spun(isolated_lock):
+    """Regression: an empty/corrupt lock file used to busy-spin
+    relay_lock forever (current_owner saw no owner, O_EXCL create hit
+    FileExistsError, repeat). wait_s=0 must refuse immediately with an
+    'unreadable lock' owner instead."""
+    isolated_lock.write_text("")  # crashed holder mid-write
+    with pytest.raises(RelayBusy) as e:
+        with relay_lock("client"):
+            pass
+    assert "unreadable lock" in str(e.value)
+    assert e.value.owner["pid"] is None
+    assert isolated_lock.exists()  # wait_s=0 never breaks it
+
+
+def test_unreadable_lock_broken_after_grace(isolated_lock, monkeypatch):
+    """A waiter outlasting the grace period treats the unparsable lock
+    as stale, breaks it under the flock guard, and acquires."""
+    monkeypatch.setattr(relaylock, "UNREADABLE_GRACE_S", 0.15)
+    isolated_lock.write_text("{corrupt")
+    with relay_lock("patient client", wait_s=5.0, poll_s=0.05):
+        owner = json.loads(isolated_lock.read_text())
+        assert owner["pid"] == os.getpid()
+
+
+def test_fresh_unreadable_lock_survives_grace_check(isolated_lock, monkeypatch):
+    """The mtime re-check under the guard: a lock younger than the
+    grace period is presumed mid-write and left alone."""
+    isolated_lock.write_text("")
+    relaylock._break_unreadable(isolated_lock, grace_s=60.0)
+    assert isolated_lock.exists()
+    # ...but one past the grace age is broken.
+    old = time.time() - 120
+    os.utime(isolated_lock, (old, old))
+    relaylock._break_unreadable(isolated_lock, grace_s=60.0)
+    assert not isolated_lock.exists()
 
 
 def test_wait_times_out_to_busy(isolated_lock, monkeypatch):

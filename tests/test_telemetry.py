@@ -51,6 +51,21 @@ class TestRegistry:
         with pytest.raises(ValueError):
             reg.counter("t_total", "x", labels=("other",))
 
+    def test_histogram_bucketless_readback_is_not_a_declaration(self):
+        """Readers must not have to restate the declarer's buckets:
+        histogram(name) with no buckets returns the existing metric
+        whatever it was declared with; only EXPLICIT buckets are checked
+        for conflict (and None declares DEFAULT_BUCKETS on creation)."""
+        reg = tmetrics.Registry()
+        h = reg.histogram("t_ratio", "x", buckets=(0.5, 1.0))
+        assert reg.histogram("t_ratio") is h  # read-back, custom buckets
+        with pytest.raises(ValueError):
+            reg.histogram("t_ratio", buckets=(0.25, 1.0))  # real conflict
+        d = reg.histogram("t_default_seconds", "x")  # None -> defaults
+        assert d.buckets == tmetrics.DEFAULT_BUCKETS
+        assert reg.histogram("t_default_seconds",
+                             buckets=tmetrics.DEFAULT_BUCKETS) is d
+
     def test_label_name_mismatch_raises(self):
         reg = tmetrics.Registry()
         c = reg.counter("t_total", "x", labels=("model",))
