@@ -1,0 +1,66 @@
+"""graftlint — AST-based JAX/TPU correctness linter for the hops_tpu tree.
+
+The worst bugs in a traced-and-threaded codebase are invisible to
+pytest on CPU: a silent dtype downcast inside a jitted step (PR 2), a
+busy-spin in a lock acquire path (PR 3), a donated buffer read on the
+next loop iteration that only explodes on a real device. This package
+machine-checks those invariants: a rule engine over Python ASTs
+(:mod:`.engine`), a findings/baseline model with justified suppressions
+(:mod:`.model`, :mod:`.baseline`), six TPU/JAX-specific rules
+(:mod:`.rules`), and a CLI (:mod:`.cli`, ``python -m hops_tpu.analysis``)
+whose zero-findings exit code gates CI via
+``tests/test_analysis_selfcheck.py``.
+
+The analysis code itself is stdlib-only (``ast`` + ``tokenize`` — it
+never imports JAX or touches a backend); note that running it as
+``python -m hops_tpu.analysis`` still pays the parent package's import
+cost, since ``-m`` imports ``hops_tpu`` first.
+
+Quick use::
+
+    from hops_tpu import analysis
+    findings = analysis.lint([Path("hops_tpu")])
+    for f in findings:
+        print(f.render())
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable
+
+from hops_tpu.analysis.baseline import Baseline, BaselineError
+from hops_tpu.analysis.engine import Context, Rule, all_rules, register, run
+from hops_tpu.analysis.model import Finding, ParsedFile
+
+__all__ = [
+    "Baseline",
+    "BaselineError",
+    "Context",
+    "Finding",
+    "ParsedFile",
+    "Rule",
+    "all_rules",
+    "lint",
+    "register",
+    "run",
+]
+
+
+def lint(
+    paths: Iterable[Path | str],
+    baseline: Path | str | None = None,
+    docs_path: Path | str | None = None,
+) -> list[Finding]:
+    """Lint ``paths`` and return non-baselined findings — the in-process
+    equivalent of the CLI (used by the tier-1 self-check test): same
+    root resolution, same default docs discovery."""
+    from hops_tpu.analysis import cli
+
+    targets = [Path(p) for p in paths]
+    root = cli.lint_root(targets)
+    docs = Path(docs_path) if docs_path is not None else cli.default_docs(root)
+    findings = run(targets, root=root, docs_path=docs)
+    if baseline is not None:
+        findings, _, _ = Baseline.load(Path(baseline)).split(findings)
+    return findings

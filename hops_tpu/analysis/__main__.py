@@ -1,0 +1,13 @@
+"""``python -m hops_tpu.analysis`` — run graftlint (see :mod:`.cli`).
+
+The ``__name__`` guard matters: the import drift-guard sweep imports
+this module as ``hops_tpu.analysis.__main__`` and must not trigger a
+lint run with pytest's argv.
+"""
+
+import sys
+
+from hops_tpu.analysis.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

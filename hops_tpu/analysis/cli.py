@@ -1,0 +1,212 @@
+"""graftlint CLI — ``python -m hops_tpu.analysis``.
+
+Exit codes follow the CI contract: **0** clean (after baseline), **1**
+non-baselined findings, **2** usage error (bad flags, unparsable
+target, malformed/unjustified baseline). ``--format json`` emits the
+machine schema the self-check test and external tooling consume;
+``--write-baseline`` snapshots current findings with placeholder
+justifications that the loader refuses until a human replaces them.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Sequence
+
+from hops_tpu.analysis import baseline as baseline_mod
+from hops_tpu.analysis import engine
+
+JSON_SCHEMA_VERSION = 1
+
+EXIT_CLEAN = 0
+EXIT_FINDINGS = 1
+EXIT_USAGE = 2
+
+
+def default_target() -> Path:
+    """The installed ``hops_tpu`` package directory."""
+    import hops_tpu
+
+    return Path(hops_tpu.__file__).parent
+
+
+def lint_root(paths: list[Path]) -> Path:
+    """Directory finding paths are made relative to.
+
+    When every target sits under the repo the ``hops_tpu`` package lives
+    in, use that repo root — baseline entries then read
+    ``hops_tpu/featurestore/loader.py`` regardless of which subtree was
+    linted or where the CLI ran. Anything else (snippet dirs in tests)
+    falls back to the targets' common ancestor.
+    """
+    repo = default_target().parent
+    if all(p.resolve().is_relative_to(repo.resolve()) for p in paths):
+        return repo
+    return engine._common_root(paths)
+
+
+def default_docs(root: Path) -> Path | None:
+    """``docs/operations.md`` next to the lint root, if present."""
+    for base in (root, root.parent):
+        cand = base / "docs" / "operations.md"
+        if cand.is_file():
+            return cand
+    return None
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m hops_tpu.analysis",
+        description="graftlint: JAX/TPU correctness linter for the hops_tpu tree",
+    )
+    parser.add_argument(
+        "paths", nargs="*", type=Path,
+        help="files/directories to lint (default: the hops_tpu package)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="output format (default: text)",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=None,
+        help="justified-findings baseline JSON to subtract (default: "
+             "analysis_baseline.json at the lint root, when present)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any default baseline file",
+    )
+    parser.add_argument(
+        "--write-baseline", type=Path, default=None,
+        help="write current findings as a baseline (placeholder "
+             "justifications; fill them in before committing)",
+    )
+    parser.add_argument(
+        "--docs", type=Path, default=None,
+        help="operations doc for metric-name-consistency "
+             "(default: docs/operations.md near the lint root)",
+    )
+    parser.add_argument(
+        "--rules", default=None,
+        help="comma-separated rule names to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return parser
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    parser = build_parser()
+    args = parser.parse_args(argv)
+
+    rules = engine.all_rules()
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.name}: {rule.description}")
+        return EXIT_CLEAN
+
+    if args.rules is not None:
+        wanted = {r.strip() for r in args.rules.split(",") if r.strip()}
+        known = {r.name for r in rules}
+        unknown = wanted - known
+        if unknown:
+            print(
+                f"error: unknown rule(s): {', '.join(sorted(unknown))} "
+                f"(known: {', '.join(sorted(known))})",
+                file=sys.stderr,
+            )
+            return EXIT_USAGE
+        rules = [r for r in rules if r.name in wanted]
+
+    paths = args.paths or [default_target()]
+    for p in paths:
+        if not p.exists():
+            print(f"error: no such lint target: {p}", file=sys.stderr)
+            return EXIT_USAGE
+    root = lint_root([Path(p) for p in paths])
+    docs = args.docs if args.docs is not None else default_docs(root)
+    if args.docs is not None and not args.docs.is_file():
+        print(f"error: --docs file not found: {args.docs}", file=sys.stderr)
+        return EXIT_USAGE
+
+    try:
+        findings = engine.run(paths, root=root, docs_path=docs, rules=rules)
+    except engine.ParseError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return EXIT_USAGE
+
+    if args.write_baseline is not None:
+        baseline_mod.write(args.write_baseline, findings)
+        print(
+            f"wrote {len(findings)} finding(s) to {args.write_baseline} — "
+            "replace every placeholder justification before committing",
+            file=sys.stderr,
+        )
+
+    baseline_path = args.baseline
+    if args.write_baseline is not None:
+        # A regeneration run reports the raw findings it just wrote;
+        # subtracting the old (or the freshly written, still-placeholder)
+        # baseline here would only obscure what went into the file.
+        baseline_path = None
+    elif baseline_path is None and not args.no_baseline:
+        default_bl = root / "analysis_baseline.json"
+        if default_bl.is_file():
+            baseline_path = default_bl
+    baselined: list = []
+    stale: list[dict] = []
+    if baseline_path is not None:
+        try:
+            bl = baseline_mod.Baseline.load(baseline_path)
+        except baseline_mod.BaselineError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return EXIT_USAGE
+        findings, baselined, stale = bl.split(findings)
+        if args.rules is not None:
+            # A subset run can't see the findings the other rules'
+            # entries match — calling them stale would tell the user to
+            # delete entries a full run still needs.
+            stale = []
+
+    if args.format == "json":
+        print(json.dumps(report(findings, baselined, stale), indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        for e in stale:
+            print(
+                f"warning: stale baseline entry (no matching finding): "
+                f"{e['rule']} in {e['path']}: {e['message']}",
+                file=sys.stderr,
+            )
+        summary = f"{len(findings)} finding(s)"
+        if baselined:
+            summary += f", {len(baselined)} baselined"
+        if stale:
+            summary += f", {len(stale)} stale baseline entrie(s)"
+        print(summary, file=sys.stderr)
+
+    return EXIT_FINDINGS if findings else EXIT_CLEAN
+
+
+def report(findings, baselined, stale) -> dict:
+    """The ``--format json`` document."""
+    by_rule: dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "version": JSON_SCHEMA_VERSION,
+        "findings": [f.to_dict() for f in findings],
+        "baselined": [f.to_dict() for f in baselined],
+        "stale_baseline_entries": stale,
+        "summary": {"count": len(findings), "by_rule": by_rule},
+    }
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

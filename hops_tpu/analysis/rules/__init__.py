@@ -1,0 +1,20 @@
+"""Built-in graftlint rules — importing this package registers them.
+
+Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
+
+- :mod:`.jit_purity` — ``jit-purity``
+- :mod:`.donation` — ``use-after-donation``
+- :mod:`.host_sync` — ``host-sync-in-loop``
+- :mod:`.lock_discipline` — ``lock-discipline``
+- :mod:`.metric_consistency` — ``metric-name-consistency``
+- :mod:`.swallowed_exception` — ``swallowed-exception``
+"""
+
+from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effects
+    donation,
+    host_sync,
+    jit_purity,
+    lock_discipline,
+    metric_consistency,
+    swallowed_exception,
+)

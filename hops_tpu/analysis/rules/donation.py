@@ -1,0 +1,236 @@
+"""use-after-donation: reading a buffer after handing it to XLA.
+
+``donate_argnums`` (and ``Strategy.step``'s default
+``donate_state=True``) tells XLA it may overwrite the argument's buffer
+in place — the standard trick that halves train-state memory. The
+Python name still points at the donated array, and touching it again
+raises at best (``Array has been deleted``) and at worst silently reads
+repurposed memory on backends that don't track deletion. The correct
+pattern rebinds in the same statement (``state, m = step(state, b)``);
+everything else is a latent crash that only fires on a real device,
+never under pytest on CPU.
+
+Detected shapes, per function (or module) scope:
+
+- ``g = jax.jit(f, donate_argnums=(0,)); g(x); ... x ...`` — ``x``
+  read after the donating call without an intervening rebind;
+- the same with the jitted callable invoked inline;
+- ``step = strategy.step(fn)`` (donation on by default) called inside
+  a ``for``/``while`` loop without rebinding the donated argument —
+  iteration 2 passes a dead buffer.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from hops_tpu.analysis.engine import Context, Rule, call_name, register
+from hops_tpu.analysis.model import Finding, ParsedFile
+
+
+def _pos(node: ast.AST) -> tuple[int, int]:
+    return (node.lineno, node.col_offset)
+
+
+def _end_pos(node: ast.AST) -> tuple[int, int]:
+    return (
+        getattr(node, "end_lineno", node.lineno),
+        getattr(node, "end_col_offset", node.col_offset),
+    )
+
+
+def _donated_positions(call: ast.Call) -> tuple[int, ...] | None:
+    """Donated argument indices if ``call`` builds a donating callable."""
+    name = call_name(call.func)
+    if name in ("jit", "pjit"):
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    out = []
+                    for e in v.elts:
+                        if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                            out.append(e.value)
+                        else:
+                            return None
+                    return tuple(out)
+                return None
+        return None
+    if (
+        isinstance(call.func, ast.Attribute)
+        and name == "step"
+        and call.args
+        and isinstance(call.args[0], ast.Name)
+    ):
+        # Strategy.step(fn): donate_state defaults to True.
+        for kw in call.keywords:
+            if kw.arg == "donate_state" and isinstance(kw.value, ast.Constant):
+                if not kw.value.value:
+                    return None
+        return (0,)
+    return None
+
+
+def _scope_walk(scope: ast.AST) -> Iterator[ast.AST]:
+    """Walk a scope without descending into nested function/class defs."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if hasattr(node, "lineno"):
+            yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class UseAfterDonationRule(Rule):
+    name = "use-after-donation"
+    description = (
+        "an argument read after being passed through donate_argnums/"
+        "donate_state — the buffer belongs to XLA now"
+    )
+
+    def check_file(self, pf: ParsedFile, ctx: Context) -> list[Finding]:
+        findings: list[Finding] = []
+        scopes: list[ast.AST] = [pf.tree] + [
+            n
+            for n in ast.walk(pf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            findings.extend(self._check_scope(pf, scope))
+        return findings
+
+    def _check_scope(self, pf: ParsedFile, scope: ast.AST) -> list[Finding]:
+        nodes = list(_scope_walk(scope))
+        parents: dict[int, ast.AST] = {}
+        for n in [scope] + nodes:
+            for child in ast.iter_child_nodes(n):
+                parents[id(child)] = n
+
+        donors: dict[str, tuple[int, ...]] = {}
+        donation_calls: list[tuple[ast.Call, tuple[int, ...]]] = []
+        for n in sorted(
+            (x for x in nodes if isinstance(x, (ast.Assign, ast.Call))),
+            key=_pos,
+        ):
+            if isinstance(n, ast.Assign):
+                donated = (
+                    _donated_positions(n.value)
+                    if isinstance(n.value, ast.Call)
+                    else None  # rebound to a non-call: no longer a donor
+                )
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        if donated is not None:
+                            donors[t.id] = donated
+                        else:
+                            donors.pop(t.id, None)
+            elif isinstance(n, ast.Call):
+                if isinstance(n.func, ast.Name) and n.func.id in donors:
+                    donation_calls.append((n, donors[n.func.id]))
+                elif isinstance(n.func, ast.Call):
+                    donated = _donated_positions(n.func)
+                    if donated is not None:
+                        donation_calls.append((n, donated))
+
+        findings: list[Finding] = []
+        for call, positions in donation_calls:
+            for i in positions:
+                if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                    findings.extend(
+                        self._check_donated_name(
+                            pf, scope, nodes, parents, call, call.args[i].id
+                        )
+                    )
+        return findings
+
+    def _check_donated_name(
+        self,
+        pf: ParsedFile,
+        scope: ast.AST,
+        nodes: list[ast.AST],
+        parents: dict[int, ast.AST],
+        call: ast.Call,
+        var: str,
+    ) -> list[Finding]:
+        # Does the statement holding the call rebind the name (the
+        # sanctioned `state, m = step(state, b)` shape)?
+        anc = parents.get(id(call))
+        rebinding_stmt = False
+        loop: ast.For | ast.While | None = None
+        while anc is not None and anc is not scope:
+            if isinstance(anc, ast.Assign) and any(
+                isinstance(t2, ast.Name) and t2.id == var or var in _store_names(t2)
+                for t2 in anc.targets
+            ):
+                rebinding_stmt = True
+            if isinstance(anc, (ast.For, ast.While)) and loop is None:
+                loop = anc
+            anc = parents.get(id(anc))
+
+        if loop is not None:
+            stored_in_loop = any(
+                isinstance(n, ast.Name)
+                and n.id == var
+                and isinstance(n.ctx, ast.Store)
+                for n in ast.walk(loop)
+            )
+            if not stored_in_loop:
+                return [
+                    pf.finding(
+                        self.name,
+                        call,
+                        f"`{var}` is donated by `{ast.unparse(call.func)}` "
+                        "inside a loop but never rebound there — iteration 2 "
+                        "passes a deleted buffer; rebind it "
+                        f"(`{var}, ... = ...`)",
+                    )
+                ]
+            return []  # rebound somewhere in the loop: stream-carried
+
+        if rebinding_stmt:
+            return []
+        end = _end_pos(call)
+        later_stores = sorted(
+            (
+                _pos(n)
+                for n in nodes
+                if isinstance(n, ast.Name)
+                and n.id == var
+                and isinstance(n.ctx, ast.Store)
+                and _pos(n) > end
+            ),
+        )
+        horizon = later_stores[0] if later_stores else (1 << 30, 0)
+        out = []
+        for n in sorted(nodes, key=_pos):
+            if (
+                isinstance(n, ast.Name)
+                and n.id == var
+                and isinstance(n.ctx, ast.Load)
+                and end < _pos(n) < horizon
+            ):
+                out.append(
+                    pf.finding(
+                        self.name,
+                        n,
+                        f"`{var}` read after being donated to "
+                        f"`{ast.unparse(call.func)}` — the buffer belongs "
+                        "to XLA; use the call's result instead",
+                    )
+                )
+        return out
+
+
+def _store_names(target: ast.AST) -> set[str]:
+    return {
+        n.id
+        for n in ast.walk(target)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+    }

@@ -1,0 +1,211 @@
+"""jit-purity: Python side effects reachable inside traced functions.
+
+``jax.jit``/``shard_map`` trace a function ONCE and replay the captured
+XLA program; Python-level side effects inside the traced body run at
+trace time only (or once per recompile) — so a ``print`` shows stale
+values, ``time.time()`` freezes the timestamp of the first trace,
+stdlib ``random`` draws one constant, a telemetry ``.inc()`` counts
+compilations instead of steps, and a ``global`` write mutates host
+state on a schedule nobody can predict. PR 2's silent fp32->bf16 param
+downcast lived exactly here: a traced step quietly doing host-visible
+work nobody could see in pytest.
+
+A function is considered traced when it is:
+
+- decorated with ``jit``/``jax.jit``/``pjit``/``shard_map`` (bare,
+  called, or via ``functools.partial``),
+- passed as the first argument to a ``jit(...)``/``shard_map(...)``
+  call or a ``<strategy>.step(...)`` call, or
+- defined inside (and thus returned by) a ``make_*step*`` factory —
+  the ``make_train_step`` convention this repo compiles via
+  ``Strategy.step``.
+
+``jax.debug.print`` / ``jax.debug.callback`` / ``io_callback`` are the
+sanctioned escape hatches and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from hops_tpu.analysis.engine import (
+    Context,
+    Rule,
+    call_name,
+    dotted_name,
+    register,
+)
+from hops_tpu.analysis.model import Finding, ParsedFile
+
+_JIT_NAMES = {"jit", "pjit", "shard_map"}
+_FACTORY_RE = re.compile(r"^make\w*step\w*$")
+_METRIC_MUTATORS = {"inc", "dec", "observe", "set_to_current_time"}
+_METRIC_RECEIVER_RE = re.compile(
+    r"(^|\.)(_?m_\w+|REGISTRY|registry|\w*(metric|counter|gauge|histogram)\w*)",
+    re.IGNORECASE,
+)
+
+
+def _is_at_indexer(node: ast.AST) -> bool:
+    """``x.at[i]`` — the receiver of JAX's pure functional-update
+    ``.set()``/``.add()``, which must never read as a metric mutation
+    even on an array named ``metrics``."""
+    return (
+        isinstance(node, ast.Subscript)
+        and isinstance(node.value, ast.Attribute)
+        and node.value.attr == "at"
+    )
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` / ``pjit`` / ``shard_map`` (possibly via
+    ``partial(jax.jit, ...)``), as a decorator or call target."""
+    if call_name(node) in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        if call_name(node.func) in _JIT_NAMES:
+            return True
+        if call_name(node.func) == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+def _collect_traced(tree: ast.Module) -> list[ast.FunctionDef]:
+    """Function defs whose bodies will be traced."""
+    by_name: dict[str, list[ast.FunctionDef]] = {}
+    traced: dict[int, ast.FunctionDef] = {}
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, []).append(node)
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                traced[id(node)] = node
+            if _FACTORY_RE.match(node.name):
+                # Only the def(s) the factory RETURNS are traced; other
+                # inner helpers run at factory (plain Python) time.
+                returned = {
+                    r.value.id
+                    for r in ast.walk(node)
+                    if isinstance(r, ast.Return) and isinstance(r.value, ast.Name)
+                }
+                for child in ast.walk(node):
+                    if (
+                        isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and child is not node
+                        and child.name in returned
+                    ):
+                        traced[id(child)] = child
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target: ast.AST | None = None
+        if _is_jit_expr(node.func) and not isinstance(node.func, ast.Call):
+            target = node.args[0] if node.args else None
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == "step":
+            # <strategy>.step(fn, ...) compiles fn; only count plain
+            # Name args that resolve to a local def (engine.step() and
+            # friends take no function argument).
+            target = node.args[0] if node.args else None
+        if isinstance(target, ast.Name):
+            for fn in by_name.get(target.id, ()):
+                traced[id(fn)] = fn
+        elif isinstance(target, (ast.FunctionDef, ast.Lambda)):
+            pass  # lambdas have no statements worth flagging
+    return list(traced.values())
+
+
+@register
+class JitPurityRule(Rule):
+    name = "jit-purity"
+    description = (
+        "Python side effects (print, time.*, stdlib random, telemetry "
+        "mutation, global writes) inside jit/shard_map-traced functions"
+    )
+
+    def check_file(self, pf: ParsedFile, ctx: Context) -> list[Finding]:
+        # Only treat `time.*`/`random.*` as the stdlib modules when the
+        # file actually imports them bare — otherwise `time` may be an
+        # array argument (timestep code) and `random` a jax.random alias.
+        std_imports = {
+            a.name
+            for n in ast.walk(pf.tree)
+            if isinstance(n, ast.Import)
+            for a in n.names
+            if a.asname is None
+        }
+        findings: list[Finding] = []
+        seen: set[tuple[int, int]] = set()
+        for fn in _collect_traced(pf.tree):
+            for node in ast.walk(fn):
+                f = self._check_node(pf, fn, node, std_imports)
+                if f is not None and (f.line, f.col) not in seen:
+                    seen.add((f.line, f.col))
+                    findings.append(f)
+        return findings
+
+    def _check_node(
+        self,
+        pf: ParsedFile,
+        fn: ast.FunctionDef,
+        node: ast.AST,
+        std_imports: set[str],
+    ) -> Finding | None:
+        where = f"traced function `{fn.name}`"
+        if isinstance(node, ast.Global):
+            return pf.finding(
+                self.name,
+                node,
+                f"`global {', '.join(node.names)}` write inside {where} "
+                "mutates host state at trace time only; return the value "
+                "or use jax.debug.callback",
+            )
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if isinstance(func, ast.Name) and func.id == "print":
+            return pf.finding(
+                self.name,
+                node,
+                f"`print` inside {where} runs at trace time only; use "
+                "jax.debug.print for runtime values",
+            )
+        dn = dotted_name(func)
+        if "time" in std_imports and dn.startswith("time."):
+            return pf.finding(
+                self.name,
+                node,
+                f"`{dn}` inside {where} freezes the clock at trace time; "
+                "take timestamps outside the step",
+            )
+        if "random" in std_imports and dn.startswith("random."):
+            return pf.finding(
+                self.name,
+                node,
+                f"stdlib `{dn}` inside {where} draws ONE value at trace "
+                "time; thread a jax.random key instead",
+            )
+        if (
+            isinstance(func, ast.Attribute)
+            and (func.attr in _METRIC_MUTATORS or func.attr == "set")
+            and not _is_at_indexer(func.value)
+            and self._metric_receiver(func.value)
+        ):
+            recv = ast.unparse(func.value)
+            return pf.finding(
+                self.name,
+                node,
+                f"telemetry mutation `{recv}.{func.attr}(...)` inside "
+                f"{where} counts trace-time compilations, not steps; "
+                "update metrics outside the traced body",
+            )
+        return None
+
+    @staticmethod
+    def _metric_receiver(node: ast.AST) -> bool:
+        try:
+            text = ast.unparse(node)
+        except Exception:  # pragma: no cover - unparse is total on exprs
+            return False
+        return bool(_METRIC_RECEIVER_RE.search(text))

@@ -257,7 +257,7 @@ class _BufferPool:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._free: list = []
+        self._free: list = []  # guarded by: self._lock
 
     def take(self, template: Any) -> Any:
         with self._lock:

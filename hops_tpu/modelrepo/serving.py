@@ -49,7 +49,7 @@ LM = "LM"  # continuous-batching text generation (lm_engine.LMEngine)
 # Accepted for reference parity; flax bundles are the native path.
 TENSORFLOW_SERVING = FLAX
 
-_servers: dict[str, "_RunningServing"] = {}
+_servers: dict[str, "_RunningServing"] = {}  # guarded by: _lock
 _lock = threading.Lock()
 
 
@@ -193,7 +193,7 @@ class LMEnginePredictor:
         for pname, ptokens in (cfg.get("prefixes") or {}).items():
             self._engine.register_prefix(pname, ptokens)
         self._cv = threading.Condition()
-        self._stopping = False
+        self._stopping = False  # guarded by: self._cv
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 

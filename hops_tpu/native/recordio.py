@@ -124,7 +124,7 @@ class RecordReader:
             import threading
 
             self._f_lock = threading.Lock()
-            self._f = open(self._path, "rb")
+            self._f = open(self._path, "rb")  # guarded by: self._f_lock
             idx = Path(self._path + ".idx")
             if idx.exists():
                 raw = idx.read_bytes()
@@ -214,7 +214,10 @@ class RecordReader:
                 self._lib.rio_reader_close(self._h)
                 self._h = None
         else:
-            self._f.close()
+            # Under the read lock: closing mid-read would raise a
+            # ValueError on whichever decode worker holds the file.
+            with self._f_lock:
+                self._f.close()
 
     def __enter__(self) -> "RecordReader":
         return self

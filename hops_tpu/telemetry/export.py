@@ -131,7 +131,10 @@ class MetricsServer:
                     try:
                         self.send_response(500)
                         self.end_headers()
-                    except Exception:  # noqa: BLE001 — client went away
+                    # The scrape client hung up mid-error-reply: nothing
+                    # to tell it, and a log line per disconnect would
+                    # spam on every flaky scrape.
+                    except Exception:  # graftlint: disable=swallowed-exception
                         pass
 
         self._server = ThreadingHTTPServer((host, port), Handler)
@@ -214,8 +217,10 @@ class PubsubExporter:
             self._thread = None
         try:
             self._send()  # final flush: short jobs still leave a record
-        except Exception:  # noqa: BLE001
-            pass
+        except Exception:  # noqa: BLE001 — flush is best-effort, but say so
+            from hops_tpu.runtime.logging import get_logger
+
+            get_logger(__name__).exception("final pubsub metrics flush failed")
 
     def __enter__(self) -> "PubsubExporter":
         return self.start()

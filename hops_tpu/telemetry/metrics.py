@@ -59,8 +59,8 @@ def hosttag() -> str:
             import jax
 
             return f"h{jax.process_index()}"
-    except Exception:
-        pass
+    except Exception:  # graftlint: disable=swallowed-exception
+        pass  # by contract: a metrics scrape must NEVER raise or init jax
     return "h?"
 
 
@@ -74,7 +74,7 @@ class _Metric:
         self.help = help
         self.label_names = tuple(label_names)
         self._lock = threading.Lock()
-        self._children: dict[tuple[str, ...], Any] = {}
+        self._children: dict[tuple[str, ...], Any] = {}  # guarded by: self._lock
 
     def _key(self, labels: dict[str, Any]) -> tuple[str, ...]:
         if set(labels) != set(self.label_names):
@@ -91,7 +91,7 @@ class _Metric:
         with self._lock:
             return self._child(key)
 
-    def _child(self, key: tuple[str, ...]) -> Any:  # under self._lock
+    def _child(self, key: tuple[str, ...]) -> Any:  # guarded by: self._lock
         raise NotImplementedError
 
     def samples(self) -> list[tuple[str, dict[str, str], float]]:
@@ -118,7 +118,7 @@ class Counter(_Metric):
 
     type = "counter"
 
-    def _child(self, key: tuple[str, ...]) -> _CounterChild:
+    def _child(self, key: tuple[str, ...]) -> _CounterChild:  # guarded by: self._lock
         child = self._children.get(key)
         if child is None:
             child = self._children[key] = _CounterChild(self._lock)
@@ -163,7 +163,7 @@ class Gauge(_Metric):
 
     type = "gauge"
 
-    def _child(self, key: tuple[str, ...]) -> _GaugeChild:
+    def _child(self, key: tuple[str, ...]) -> _GaugeChild:  # guarded by: self._lock
         child = self._children.get(key)
         if child is None:
             child = self._children[key] = _GaugeChild(self._lock)
@@ -239,7 +239,7 @@ class Histogram(_Metric):
             bounds = tuple(b for b in bounds if not math.isinf(b))
         self.buckets = bounds
 
-    def _child(self, key: tuple[str, ...]) -> _HistogramChild:
+    def _child(self, key: tuple[str, ...]) -> _HistogramChild:  # guarded by: self._lock
         child = self._children.get(key)
         if child is None:
             child = self._children[key] = _HistogramChild(self._lock, self.buckets)
@@ -282,7 +282,7 @@ class Registry:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._metrics: dict[str, _Metric] = {}
+        self._metrics: dict[str, _Metric] = {}  # guarded by: self._lock
 
     def _get_or_create(self, cls, name: str, help: str,
                        labels: Iterable[str], **kwargs: Any) -> Any:

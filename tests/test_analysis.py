@@ -1,0 +1,826 @@
+"""graftlint unit coverage: per-rule true-positive AND must-not-flag
+snippets, suppression pragmas, baseline round-trip, CLI exit codes, and
+the JSON output schema.
+
+Each rule's contract is pinned by a pair: a snippet that MUST produce
+the finding and a near-miss that must NOT (the false-positive budget is
+what makes a zero-findings gate enforceable — one spurious finding and
+the tree rots into blanket suppressions)."""
+
+from __future__ import annotations
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from hops_tpu import analysis
+from hops_tpu.analysis import baseline as baseline_mod
+from hops_tpu.analysis import cli, engine
+
+
+def lint_code(tmp_path: Path, code: str, rule: str | None = None,
+              docs: str | None = None, filename: str = "snip.py"):
+    """Write ``code`` into a scratch tree and lint it."""
+    target = tmp_path / filename
+    target.write_text(textwrap.dedent(code))
+    docs_path = None
+    if docs is not None:
+        docs_path = tmp_path / "operations.md"
+        docs_path.write_text(docs)
+    rules = None
+    if rule is not None:
+        rules = [r for r in engine.all_rules() if r.name == rule]
+        assert rules, f"unknown rule {rule}"
+    return engine.run([target], root=tmp_path, docs_path=docs_path, rules=rules)
+
+
+def rule_names(findings) -> list[str]:
+    return [f.rule for f in findings]
+
+
+# -- jit-purity ---------------------------------------------------------------
+
+
+def test_jit_purity_flags_print_time_random_in_decorated_fn(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import random
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            print("x =", x)
+            t = time.monotonic()
+            r = random.random()
+            return x + t + r
+        """,
+        rule="jit-purity",
+    )
+    messages = " | ".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "`print`" in messages
+    assert "time.monotonic" in messages
+    assert "random.random" in messages
+    assert all(f.symbol == "step" for f in findings)
+
+
+def test_jit_purity_flags_telemetry_and_global_in_step_factory(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        _steps = 0
+
+        def make_train_step(counter):
+            def train_step(state, batch):
+                global _steps
+                counter = self._m_steps
+                counter.inc()
+                return state
+            return train_step
+        """,
+        rule="jit-purity",
+    )
+    messages = " | ".join(f.message for f in findings)
+    assert "`global _steps`" in messages
+    assert "telemetry mutation" in messages
+
+
+def test_jit_purity_must_not_flag_untraced_or_sanctioned(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import time
+        import jax
+        from jax import random  # jax.random, not stdlib
+
+        def host_side():
+            print("fine: not traced")
+            return time.time()
+
+        @jax.jit
+        def step(x, key):
+            jax.debug.print("x = {}", x)       # sanctioned escape hatch
+            return x + random.normal(key, ())  # jax.random, fine
+        """,
+        rule="jit-purity",
+    )
+    assert findings == []
+
+
+def test_jit_purity_sees_fn_passed_to_jit_call(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import jax
+
+        def impure(x):
+            print(x)
+            return x
+
+        compiled = jax.jit(impure)
+        """,
+        rule="jit-purity",
+    )
+    assert rule_names(findings) == ["jit-purity"]
+
+
+# -- use-after-donation -------------------------------------------------------
+
+
+def test_donation_flags_read_after_donating_call(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import jax
+
+        def train(f, state, batch):
+            g = jax.jit(f, donate_argnums=(0,))
+            out = g(state, batch)
+            return state.params  # state's buffer belongs to XLA now
+        """,
+        rule="use-after-donation",
+    )
+    assert len(findings) == 1
+    assert "`state` read after being donated" in findings[0].message
+
+
+def test_donation_flags_unrebound_loop_argument(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        def train(strategy, fn, state, batches):
+            step = strategy.step(fn)
+            for b in batches:
+                out = step(state, b)
+            return out
+        """,
+        rule="use-after-donation",
+    )
+    assert len(findings) == 1
+    assert "never rebound" in findings[0].message
+
+
+def test_donation_must_not_flag_rebinding_patterns(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import jax
+
+        def train(strategy, fn, state, batches):
+            step = strategy.step(fn)
+            for b in batches:
+                state, metrics = step(state, b)  # rebound: stream-carried
+            return state, metrics
+
+        def one_shot(f, x, y):
+            g = jax.jit(f, donate_argnums=(0,))
+            x = g(x, y)  # rebound in the same statement
+            return x
+
+        def no_donation(strategy, fn, state, batches):
+            step = strategy.step(fn, donate_state=False)
+            for b in batches:
+                out = step(state, b)  # nothing donated
+            return out
+        """,
+        rule="use-after-donation",
+    )
+    assert findings == []
+
+
+# -- host-sync-in-loop --------------------------------------------------------
+
+
+def test_host_sync_flags_item_float_asarray_blocking_in_step_loop(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        def train(step, state, batches):
+            for batch in batches:
+                state, metrics = step(state, batch)
+                loss = float(metrics["loss"])
+                acc = metrics["accuracy"].item()
+                host = np.asarray(metrics["grads"])
+                jax.block_until_ready(state)
+            return state
+        """,
+        rule="host-sync-in-loop",
+    )
+    assert len(findings) == 4
+    messages = " | ".join(f.message for f in findings)
+    assert "float(metrics['loss'])" in messages
+    assert ".item()" in messages
+    assert "np.asarray" in messages
+    assert "block_until_ready" in messages
+
+
+def test_host_sync_must_not_flag_outside_loop_or_non_step_loop(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import numpy as np
+
+        def train(step, state, batches):
+            for batch in batches:
+                state, metrics = step(state, batch)
+            return float(metrics["loss"])  # ONE sync after the loop: fine
+
+        def host_math(rows):
+            total = 0.0
+            for r in rows:               # not a step loop
+                total += float(np.mean(r))
+            return total
+        """,
+        rule="host-sync-in-loop",
+    )
+    assert findings == []
+
+
+# -- lock-discipline ----------------------------------------------------------
+
+
+def test_lock_discipline_flags_unguarded_attribute_access(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._free = []  # guarded by: self._lock
+
+            def bad(self):
+                return self._free.pop()
+        """,
+        rule="lock-discipline",
+    )
+    assert len(findings) == 1
+    assert findings[0].symbol == "Pool.bad"
+    assert "guarded by `self._lock`" in findings[0].message
+
+
+def test_lock_discipline_module_level_guard(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import threading
+
+        _servers = {}  # guarded by: _lock
+        _lock = threading.Lock()
+
+        def good(name):
+            with _lock:
+                return name in _servers
+
+        def bad(name):
+            return _servers.get(name)
+        """,
+        rule="lock-discipline",
+    )
+    assert len(findings) == 1
+    assert findings[0].symbol == "bad"
+
+
+def test_lock_discipline_must_not_flag_sanctioned_shapes(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import threading
+
+        class Base:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._children = {}  # guarded by: self._lock
+                self._children["warm"] = 1  # __init__ is single-threaded
+
+            def labels(self):
+                with self._lock:
+                    return self._child()
+
+            def _child(self):  # guarded by: self._lock
+                return self._children.get("x")
+
+        class Sub(Base):
+            def samples(self):
+                with self._lock:
+                    return list(self._children.items())
+        """,
+        rule="lock-discipline",
+    )
+    assert findings == []
+
+
+def test_lock_discipline_covers_subclasses(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import threading
+
+        class Base:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._children = {}  # guarded by: self._lock
+
+        class Sub(Base):
+            def bad(self):
+                return len(self._children)
+        """,
+        rule="lock-discipline",
+    )
+    assert [f.symbol for f in findings] == ["Sub.bad"]
+
+
+# -- metric-name-consistency --------------------------------------------------
+
+_METRIC_SNIPPET = """
+from hops_tpu.telemetry.metrics import REGISTRY
+
+c = REGISTRY.counter("hops_tpu_widget_total", "Widgets")
+"""
+
+
+def test_metric_consistency_flags_undocumented_metric(tmp_path):
+    findings = lint_code(
+        tmp_path, _METRIC_SNIPPET,
+        rule="metric-name-consistency",
+        docs="# Ops\n\nNo metrics table here.\n",
+    )
+    assert len(findings) == 1
+    assert "missing from docs/operations.md" in findings[0].message
+
+
+def test_metric_consistency_documented_metric_is_clean(tmp_path):
+    findings = lint_code(
+        tmp_path, _METRIC_SNIPPET,
+        rule="metric-name-consistency",
+        docs="# Ops\n\n- `hops_tpu_widget_total` counts widgets.\n",
+    )
+    assert findings == []
+
+
+def test_metric_consistency_flags_type_and_bucket_conflicts(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        from hops_tpu.telemetry.metrics import REGISTRY
+
+        a = REGISTRY.counter("hops_tpu_thing_total", "As counter")
+        b = REGISTRY.gauge("hops_tpu_thing_total", "As gauge")
+        h1 = REGISTRY.histogram("hops_tpu_lat_seconds", "L", buckets=(0.1, 1.0))
+        h2 = REGISTRY.histogram("hops_tpu_lat_seconds", "L", buckets=(0.5, 5.0))
+        h3 = REGISTRY.histogram("hops_tpu_lat_seconds", "L")  # read-back: fine
+        """,
+        rule="metric-name-consistency",
+        docs="`hops_tpu_thing_total` `hops_tpu_lat_seconds`",
+    )
+    assert len(findings) == 2
+    messages = " | ".join(f.message for f in findings)
+    assert "one name, one type" in messages
+    assert "quantiles would disagree" in messages
+
+
+def test_metric_consistency_resolves_module_constants(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        from hops_tpu.telemetry.metrics import REGISTRY
+
+        HEARTBEAT = "hops_tpu_beat_time"
+        g = REGISTRY.gauge(HEARTBEAT, "Last beat")
+        """,
+        rule="metric-name-consistency",
+        docs="nothing documented",
+    )
+    assert len(findings) == 1
+    assert "hops_tpu_beat_time" in findings[0].message
+
+
+# -- swallowed-exception ------------------------------------------------------
+
+
+def test_swallowed_exception_flags_bare_and_broad_pass(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        def a():
+            try:
+                return 1
+            except:
+                return None
+
+        def b():
+            try:
+                return 1
+            except Exception:
+                pass
+        """,
+        rule="swallowed-exception",
+    )
+    assert len(findings) == 2
+    assert "bare `except:`" in findings[0].message
+    assert "swallows the error" in findings[1].message
+
+
+def test_swallowed_exception_must_not_flag_handled_or_narrow(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import logging
+
+        def a():
+            try:
+                return 1
+            except Exception:
+                logging.exception("boom")  # handled: logged
+                return None
+
+        def b(path):
+            try:
+                path.unlink()
+            except FileNotFoundError:
+                pass  # narrow type: a legitimate "already gone"
+        """,
+        rule="swallowed-exception",
+    )
+    assert findings == []
+
+
+# -- suppression --------------------------------------------------------------
+
+
+def test_inline_disable_silences_one_line(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        def a():
+            try:
+                return 1
+            except Exception:  # graftlint: disable=swallowed-exception
+                pass
+
+        def b():
+            try:
+                return 2
+            except Exception:
+                pass
+        """,
+        rule="swallowed-exception",
+    )
+    assert [f.symbol for f in findings] == ["b"]
+
+
+def test_file_disable_silences_whole_file(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        # graftlint: disable-file=swallowed-exception
+
+        def a():
+            try:
+                return 1
+            except:
+                pass
+        """,
+        rule="swallowed-exception",
+    )
+    assert findings == []
+
+
+# -- fingerprints and baseline ------------------------------------------------
+
+_FINDING_SNIPPET = """
+def a():
+    try:
+        return 1
+    except Exception:
+        pass
+"""
+
+
+def test_fingerprint_survives_line_shift(tmp_path):
+    (tmp_path / "one").mkdir()
+    (tmp_path / "two").mkdir()
+    f1 = lint_code(tmp_path / "one", _FINDING_SNIPPET)
+    f2 = lint_code(tmp_path / "two", "\n\n\n# moved down\n" + _FINDING_SNIPPET)
+    assert len(f1) == len(f2) == 1
+    assert f1[0].line != f2[0].line
+    assert f1[0].fingerprint == f2[0].fingerprint
+
+
+def test_baseline_round_trip(tmp_path):
+    findings = lint_code(tmp_path, _FINDING_SNIPPET)
+    assert len(findings) == 1
+    bl_path = tmp_path / "baseline.json"
+    baseline_mod.write(bl_path, findings)
+
+    # The generated placeholder must NOT load — justification is human work.
+    with pytest.raises(baseline_mod.BaselineError, match="placeholder"):
+        baseline_mod.Baseline.load(bl_path)
+
+    data = json.loads(bl_path.read_text())
+    data["entries"][0]["justification"] = "teardown path; close() is explicit everywhere else"
+    bl_path.write_text(json.dumps(data))
+    bl = baseline_mod.Baseline.load(bl_path)
+
+    new, baselined, stale = bl.split(findings)
+    assert new == [] and len(baselined) == 1 and stale == []
+
+    # Finding gone -> the entry goes stale (and is reported, not hidden).
+    new, baselined, stale = bl.split([])
+    assert new == [] and baselined == [] and len(stale) == 1
+
+
+def test_baseline_rejects_missing_justification(tmp_path):
+    bl_path = tmp_path / "baseline.json"
+    bl_path.write_text(json.dumps({
+        "version": 1,
+        "entries": [{"rule": "r", "path": "p.py", "message": "m", "justification": "  "}],
+    }))
+    with pytest.raises(baseline_mod.BaselineError, match="justification"):
+        baseline_mod.Baseline.load(bl_path)
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def test_cli_exit_0_on_clean_tree(tmp_path, capsys):
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    assert cli.main([str(tmp_path)]) == cli.EXIT_CLEAN
+
+
+def test_cli_exit_1_on_findings(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(_FINDING_SNIPPET)
+    assert cli.main([str(tmp_path)]) == cli.EXIT_FINDINGS
+    out = capsys.readouterr().out
+    assert "swallowed-exception" in out
+
+
+def test_cli_exit_2_on_usage_errors(tmp_path, capsys):
+    assert cli.main([str(tmp_path / "missing")]) == cli.EXIT_USAGE
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    assert cli.main([str(tmp_path), "--rules", "no-such-rule"]) == cli.EXIT_USAGE
+    bad_bl = tmp_path / "bl.json"
+    bad_bl.write_text("{not json")
+    assert cli.main([str(tmp_path), "--baseline", str(bad_bl)]) == cli.EXIT_USAGE
+    # argparse's own usage failures are exit code 2 as well
+    with pytest.raises(SystemExit) as exc:
+        cli.main(["--format", "yaml"])
+    assert exc.value.code == cli.EXIT_USAGE
+
+
+def test_cli_json_schema(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(_FINDING_SNIPPET)
+    rc = cli.main([str(tmp_path), "--format", "json"])
+    assert rc == cli.EXIT_FINDINGS
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == cli.JSON_SCHEMA_VERSION
+    assert set(doc) == {
+        "version", "findings", "baselined", "stale_baseline_entries", "summary",
+    }
+    (finding,) = doc["findings"]
+    assert set(finding) == {
+        "rule", "path", "line", "col", "message", "symbol", "fingerprint",
+    }
+    assert finding["rule"] == "swallowed-exception"
+    assert doc["summary"] == {"count": 1, "by_rule": {"swallowed-exception": 1}}
+
+
+def test_cli_baseline_flow_end_to_end(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(_FINDING_SNIPPET)
+    bl = tmp_path / "analysis_baseline.json"
+    assert cli.main([str(tmp_path), "--write-baseline", str(bl)]) == cli.EXIT_FINDINGS
+    data = json.loads(bl.read_text())
+    data["entries"][0]["justification"] = "known, accepted, tracked here"
+    bl.write_text(json.dumps(data))
+    capsys.readouterr()
+    assert cli.main([str(tmp_path), "--baseline", str(bl)]) == cli.EXIT_CLEAN
+    assert "1 baselined" in capsys.readouterr().err
+
+
+def test_cli_list_rules_names_all_six(capsys):
+    assert cli.main(["--list-rules"]) == cli.EXIT_CLEAN
+    out = capsys.readouterr().out
+    for rule in (
+        "jit-purity", "use-after-donation", "host-sync-in-loop",
+        "lock-discipline", "metric-name-consistency", "swallowed-exception",
+    ):
+        assert rule in out
+
+
+def test_baseline_entry_absorbs_at_most_one_finding(tmp_path):
+    """Two identical violations in one symbol share a fingerprint; the
+    single justified entry must NOT hide the second one."""
+    findings = lint_code(
+        tmp_path,
+        """
+        def a(x, y):
+            try:
+                x()
+            except Exception:
+                pass
+            try:
+                y()
+            except Exception:
+                pass
+        """,
+        rule="swallowed-exception",
+    )
+    assert len(findings) == 2
+    assert findings[0].fingerprint == findings[1].fingerprint
+    bl_path = tmp_path / "baseline.json"
+    baseline_mod.write(bl_path, findings[:1])
+    data = json.loads(bl_path.read_text())
+    data["entries"][0]["justification"] = "the first one is fine"
+    bl_path.write_text(json.dumps(data))
+    new, baselined, stale = baseline_mod.Baseline.load(bl_path).split(findings)
+    assert len(baselined) == 1 and len(new) == 1 and stale == []
+
+
+def test_engine_rejects_undecodable_file_as_usage_error(tmp_path, capsys):
+    bad = tmp_path / "latin.py"
+    bad.write_bytes(b"x = '\xe9'\n")  # latin-1 bytes, no coding cookie
+    with pytest.raises(engine.ParseError):
+        engine.run([bad], root=tmp_path)
+    assert cli.main([str(bad)]) == cli.EXIT_USAGE
+    # A PEP 263 cookie makes the same bytes legal — and lintable.
+    ok = tmp_path / "cookied.py"
+    ok.write_bytes(b"# -*- coding: latin-1 -*-\nx = '\xe9'\n")
+    assert engine.run([ok], root=tmp_path) == []
+
+
+def test_engine_rejects_null_bytes_as_usage_error(tmp_path):
+    bad = tmp_path / "nul.py"
+    bad.write_bytes(b"x = 1\x00\n")
+    with pytest.raises(engine.ParseError):
+        engine.run([bad], root=tmp_path)
+    assert cli.main([str(bad)]) == cli.EXIT_USAGE
+
+
+def test_cli_rules_subset_does_not_call_other_entries_stale(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(_FINDING_SNIPPET)
+    bl = tmp_path / "analysis_baseline.json"
+    baseline_mod.write(bl, engine.run([tmp_path], root=tmp_path))
+    data = json.loads(bl.read_text())
+    data["entries"][0]["justification"] = "accepted"
+    bl.write_text(json.dumps(data))
+    capsys.readouterr()
+    # jit-purity alone can't see the swallowed-exception finding; its
+    # baseline entry must not be reported as deletable.
+    assert cli.main([str(tmp_path), "--rules", "jit-purity"]) == cli.EXIT_CLEAN
+    assert "stale" not in capsys.readouterr().err
+
+
+def test_engine_deduplicates_overlapping_targets(tmp_path):
+    (tmp_path / "m.py").write_text(_FINDING_SNIPPET)
+    findings = engine.run([tmp_path, tmp_path / "m.py"], root=tmp_path)
+    assert len(findings) == 1
+
+
+def test_donation_cleared_by_non_call_rebind(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import jax
+
+        def train(f, other_fn, x, y):
+            g = jax.jit(f, donate_argnums=(0,))
+            g = other_fn       # no longer the donating callable
+            g(x, y)
+            return x.shape     # fine: nothing was donated
+        """,
+        rule="use-after-donation",
+    )
+    assert findings == []
+
+
+def test_jit_purity_time_requires_stdlib_import(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(state, time):
+            return time.mean()  # `time` is an array argument here
+        """,
+        rule="jit-purity",
+    )
+    assert findings == []
+
+
+def test_jit_purity_must_not_flag_at_set_or_factory_helpers(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(metrics, loss):
+            return metrics.at[0].set(loss)  # pure functional update
+
+        def make_train_step(cfg):
+            def build_schedule():
+                print("runs ONCE at factory time, never traced")
+                return cfg
+            schedule = build_schedule()
+
+            def train_step(state, batch):
+                return state
+            return train_step
+        """,
+        rule="jit-purity",
+    )
+    assert findings == []
+
+
+def test_host_sync_must_not_flag_jnp_asarray(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import jax.numpy as jnp
+
+        def train(step, state, batches):
+            for batch in batches:
+                state, metrics = step(state, batch)
+                staged = jnp.asarray(metrics["loss"])  # device op, no sync
+            return state
+        """,
+        rule="host-sync-in-loop",
+    )
+    assert findings == []
+
+
+def test_swallowed_exception_flags_tuple_clause(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        def a():
+            try:
+                return 1
+            except (Exception, ValueError):
+                pass
+        """,
+        rule="swallowed-exception",
+    )
+    assert len(findings) == 1
+
+
+def test_write_baseline_preserves_existing_justifications(tmp_path):
+    (tmp_path / "bad.py").write_text(_FINDING_SNIPPET)
+    findings = engine.run([tmp_path], root=tmp_path)
+    bl = tmp_path / "bl.json"
+    baseline_mod.write(bl, findings)
+    data = json.loads(bl.read_text())
+    data["entries"][0]["justification"] = "human-written, must survive"
+    # An unrelated justified entry a partial run can't see must survive too.
+    data["entries"].append({
+        "rule": "jit-purity", "path": "other.py", "symbol": "f",
+        "message": "elsewhere", "justification": "also accepted",
+    })
+    bl.write_text(json.dumps(data))
+    baseline_mod.write(bl, findings)  # regenerate
+    regen = json.loads(bl.read_text())
+    justs = {e["justification"] for e in regen["entries"]}
+    assert justs == {"human-written, must survive", "also accepted"}
+
+
+def test_metric_consistency_docs_match_is_whole_word(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        from hops_tpu.telemetry.metrics import REGISTRY
+
+        c = REGISTRY.counter("hops_tpu_feed", "Truncated name")
+        """,
+        rule="metric-name-consistency",
+        docs="only `hops_tpu_feed_batches_total` is documented",
+    )
+    assert len(findings) == 1
+    assert "hops_tpu_feed" in findings[0].message
+
+
+# -- docs rendering -----------------------------------------------------------
+
+
+def test_make_renders_analysis_doc_pages():
+    """Every analysis module yields a docs-site page (make.py walks the
+    package; this pins the new subtree in)."""
+    import sys
+
+    sys.path.insert(0, str(Path(analysis.__file__).parents[2]))
+    import make
+
+    pkg = Path(analysis.__file__).parent
+    for mod in sorted(pkg.rglob("*.py")):
+        rendered = make.render_module(mod)
+        assert rendered is not None, f"{mod} rendered no docs page"
+        page, first_line = rendered
+        assert first_line, f"{mod} docstring first line empty"

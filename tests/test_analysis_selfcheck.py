@@ -1,0 +1,63 @@
+"""Tier-1 gate: graftlint over the real ``hops_tpu`` tree must be clean.
+
+This is the test that turns the linter from advice into an invariant:
+any new jit-impurity, donation misuse, host sync in a step loop,
+unguarded annotated attribute, undocumented/conflicting metric, or
+swallowed exception fails CI until it is fixed or explicitly baselined
+with a written justification. The baseline itself is audited too —
+unjustified or stale entries fail — so accepted debt stays visible and
+current.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from hops_tpu import analysis
+from hops_tpu.analysis import engine
+from hops_tpu.analysis.baseline import Baseline
+from hops_tpu.analysis.cli import default_docs, lint_root
+
+PACKAGE = Path(analysis.__file__).parents[1]  # hops_tpu/
+REPO = PACKAGE.parent
+BASELINE = REPO / "analysis_baseline.json"
+
+
+def test_tree_has_zero_nonbaselined_findings():
+    findings = analysis.lint(
+        [PACKAGE],
+        baseline=BASELINE if BASELINE.is_file() else None,
+    )
+    assert not findings, (
+        "graftlint found new issues (fix them, or baseline with a written "
+        "justification in analysis_baseline.json):\n"
+        + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_baseline_is_justified_and_current():
+    """Every baseline entry still matches a real finding (no stale
+    suppressions shadowing future regressions) and carries a
+    justification — enforced by Baseline.load itself."""
+    if not BASELINE.is_file():
+        return  # an empty ledger is the ideal state
+    bl = Baseline.load(BASELINE)  # raises on missing/placeholder justification
+    root = lint_root([PACKAGE])
+    findings = engine.run([PACKAGE], root=root, docs_path=default_docs(root))
+    _, _, stale = bl.split(findings)
+    assert not stale, (
+        "stale baseline entries (their findings no longer exist — delete "
+        "them):\n" + "\n".join(f"{e['rule']}: {e['path']}: {e['message']}" for e in stale)
+    )
+
+
+def test_docs_metric_tables_match_code_without_baseline():
+    """The metric-name-consistency rule must hold with NO baseline help:
+    docs/operations.md is the operator contract, and 'documented' via an
+    accepted-debt ledger would defeat the point."""
+    root = lint_root([PACKAGE])
+    rules = [r for r in engine.all_rules() if r.name == "metric-name-consistency"]
+    findings = engine.run(
+        [PACKAGE], root=root, docs_path=default_docs(root), rules=rules
+    )
+    assert not findings, "\n".join(f.render() for f in findings)

@@ -43,6 +43,29 @@ def test_grad_comms_registered_in_drift_guard():
     assert "hops_tpu.parallel.grad_comms" in _module_names()
 
 
+def test_analysis_registered_in_drift_guard():
+    """The static-analysis gate must never silently fall out of the
+    sweep: if graftlint's modules stop importing (or move), the
+    self-check test stops protecting the tree and nothing else would
+    notice — pin the package and its rule modules by name."""
+    names = _module_names()
+    for mod in (
+        "hops_tpu.analysis",
+        "hops_tpu.analysis.engine",
+        "hops_tpu.analysis.model",
+        "hops_tpu.analysis.baseline",
+        "hops_tpu.analysis.cli",
+        "hops_tpu.analysis.rules",
+        "hops_tpu.analysis.rules.jit_purity",
+        "hops_tpu.analysis.rules.donation",
+        "hops_tpu.analysis.rules.host_sync",
+        "hops_tpu.analysis.rules.lock_discipline",
+        "hops_tpu.analysis.rules.metric_consistency",
+        "hops_tpu.analysis.rules.swallowed_exception",
+    ):
+        assert mod in names
+
+
 def test_loader_registered_in_drift_guard():
     """The parallel input pipeline is the training hot path's host half
     and sits on APIs with rename history (numpy Generator seeding,
