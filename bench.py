@@ -499,6 +499,47 @@ def run_input_pipeline_bench(
     }
 
 
+def run_fault_overhead_bench(calls: int = 1_000_000) -> dict:
+    """Disarmed fault-injection overhead: the zero-cost claim, measured.
+
+    Every hot path in the stack (loader batch production, serving
+    handlers, checkpoint saves) carries a ``faultinject.fire(point)``
+    call. The contract is that a DISARMED registry costs one attribute
+    load + ``is None`` test — this smoke times a tight loop of disarmed
+    fires against an empty same-shape loop and reports ns/call, so a
+    regression (someone adds work before the arm check) shows up as a
+    number, not a vibe. Host-only: no accelerator, no relay."""
+    from hops_tpu.runtime import faultinject
+
+    if faultinject.armed():
+        raise RuntimeError("disarm HOPS_TPU_FAULTS before the overhead bench")
+    fire = faultinject.fire
+
+    def loop_fire(n: int) -> None:
+        for _ in range(n):
+            fire("loader.read")
+
+    def loop_empty(n: int) -> None:
+        for _ in range(n):
+            pass
+
+    loop_fire(10_000)  # warm caches / specialize
+    loop_empty(10_000)
+    t0 = time.perf_counter()
+    loop_fire(calls)
+    fire_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    loop_empty(calls)
+    empty_s = time.perf_counter() - t0
+    ns_per_call = max(0.0, (fire_s - empty_s) / calls * 1e9)
+    return {
+        "calls": calls,
+        "ns_per_disarmed_fire": round(ns_per_call, 1),
+        "fire_loop_s": round(fire_s, 4),
+        "empty_loop_s": round(empty_s, 4),
+    }
+
+
 def probe_tpu(timeout_s: int = 120) -> dict:
     """Cheaply answer "is the TPU reachable?" without risking a wedge.
 
@@ -596,6 +637,12 @@ def main() -> None:
         "relay lock)",
     )
     parser.add_argument(
+        "--fault-overhead", action="store_true",
+        help="measure the DISARMED faultinject.fire() cost on the hot "
+        "paths (ns/call vs an empty loop); host-only, guards the "
+        "zero-overhead-when-disarmed contract",
+    )
+    parser.add_argument(
         "--lm", action="store_true",
         help="LM training headline instead of ResNet-50: ~180M-param "
         "TransformerLM (d_head 128, flash attention, chunked LM-head "
@@ -614,6 +661,13 @@ def main() -> None:
     import os
 
     from hops_tpu.runtime.relaylock import ENV_TOKEN, RelayBusy, current_owner, relay_lock
+
+    if args.fault_overhead:
+        result = run_fault_overhead_bench()
+        print(json.dumps({"metric": "faultinject_disarmed_ns_per_call",
+                          "value": result["ns_per_disarmed_fire"],
+                          "unit": "ns", **result}))
+        return
 
     if args.input_pipeline:
         # Entirely host-side: no accelerator touch, so no relay lock

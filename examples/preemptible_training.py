@@ -8,7 +8,11 @@ in one process:
 1. first incarnation trains, is "preempted" (a real SIGTERM) mid-run,
    checkpoints at the step boundary, and exits cleanly;
 2. second incarnation calls the SAME code and transparently resumes
-   from the checkpoint, finishing the remaining steps.
+   from the checkpoint, finishing the remaining steps — in supervisor
+   mode (``max_recoveries``), so a transient feed or step failure in
+   between would re-restore from the newest *valid* checkpoint (a
+   corrupt step is quarantined, see docs/operations.md "Failure
+   handling & fault injection") instead of killing the run.
 
 Run: python examples/preemptible_training.py
 """
@@ -43,7 +47,8 @@ def train(ckpt_dir: str, batches, preempt_at: int | None = None) -> dict:
         CNN(dtype=jnp.float32), jax.random.PRNGKey(0), (8, 28, 28, 1)
     )
     state, metrics, done = run_preemptible(
-        step, state, batches, directory=ckpt_dir, save_every=50, guard=guard
+        step, state, batches, directory=ckpt_dir, save_every=50, guard=guard,
+        max_recoveries=2,  # supervisor: transient failures re-restore + resume
     )
     return {
         "steps_completed": done,

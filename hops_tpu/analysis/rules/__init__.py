@@ -8,6 +8,7 @@ Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 - :mod:`.lock_discipline` — ``lock-discipline``
 - :mod:`.metric_consistency` — ``metric-name-consistency``
 - :mod:`.swallowed_exception` — ``swallowed-exception``
+- :mod:`.naked_retry` — ``naked-retry-loop``
 """
 
 from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effects
@@ -16,5 +17,6 @@ from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effect
     jit_purity,
     lock_discipline,
     metric_consistency,
+    naked_retry,
     swallowed_exception,
 )

@@ -51,6 +51,7 @@ from typing import Any, Callable, Iterator, Sequence
 
 import numpy as np
 
+from hops_tpu.runtime import faultinject
 from hops_tpu.telemetry.metrics import REGISTRY
 
 _STATE_VERSION = 1
@@ -576,6 +577,7 @@ class LoaderIterator:
     # -- production ----------------------------------------------------------
 
     def _produce(self, epoch: int, step: int, idx: np.ndarray) -> Any:
+        faultinject.fire("loader.read")  # chaos: transient read failure
         ld = self.loader
         t0 = time.monotonic()
         out = None

@@ -20,6 +20,9 @@ from pathlib import Path
 from typing import Any, Iterator
 
 from hops_tpu.runtime import fs
+from hops_tpu.runtime.logging import get_logger
+
+log = get_logger(__name__)
 
 _lock = threading.Lock()
 
@@ -74,10 +77,16 @@ class Producer:
         self._path = _topic_dir(topic) / "log.jsonl"
 
     def send(self, value: Any, key: str | None = None) -> None:
+        from hops_tpu.runtime import faultinject
+
         rec = {"ts": time.time(), "key": key, "value": value}
+        line = (json.dumps(rec, default=str) + "\n").encode()
+        # Chaos point: raise/delay a publish, or corrupt the encoded
+        # record (consumers must survive an unparsable line).
+        line = faultinject.fire_data("pubsub.publish", line)
         with _lock:
-            with self._path.open("a") as f:
-                f.write(json.dumps(rec, default=str) + "\n")
+            with self._path.open("ab") as f:
+                f.write(line)
 
     def flush(self) -> None:
         pass  # every send is durable
@@ -116,8 +125,16 @@ class Consumer:
             for line in f:
                 if not line.endswith(b"\n"):
                     break  # partial write in flight; retry next poll
-                out.append(json.loads(line))
                 self._offset += len(line)
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    # A corrupt record must not wedge the consumer at
+                    # this offset forever: skip it, keep tailing.
+                    log.warning("topic %s: skipping unparsable record at "
+                                "offset %d", self._log.parent.name,
+                                self._offset - len(line))
+                    continue
                 if max_records is not None and len(out) >= max_records:
                     break
         return out

@@ -35,8 +35,13 @@ import numpy as np
 
 from hops_tpu.messaging import pubsub
 from hops_tpu.modelrepo import registry
-from hops_tpu.runtime import fs
+from hops_tpu.runtime import faultinject, fs
 from hops_tpu.runtime.logging import get_logger
+from hops_tpu.runtime.resilience import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    with_deadline,
+)
 from hops_tpu.telemetry import export as telemetry_export
 from hops_tpu.telemetry.metrics import RATIO_BUCKETS, REGISTRY
 from hops_tpu.telemetry.spans import span
@@ -428,12 +433,61 @@ class DynamicBatcher:
 # -- the HTTP server ----------------------------------------------------------
 
 
+class _InflightSlot:
+    """One admitted unit of the ``max_inflight`` budget.
+
+    The cap bounds concurrent PREDICTOR executions, not handler
+    threads: when a deadline abandons a predict still running on its
+    worker thread, the slot must stay held until that work actually
+    finishes — releasing it at handler exit would admit new requests
+    on top of zombie computations, the exact overload the shedder
+    exists to prevent. Ownership: the handler releases by default
+    (:meth:`release`); once :meth:`transfer` hands the slot to the
+    predict worker, only the worker's ``release(from_worker=True)``
+    frees it. Idempotent either way."""
+
+    __slots__ = ("_running", "_lock", "_released", "_transferred")
+
+    def __init__(self, running: "_RunningServing"):
+        self._running = running
+        self._lock = threading.Lock()
+        self._released = False  # guarded by: self._lock
+        self._transferred = False  # guarded by: self._lock
+
+    def transfer(self) -> None:
+        with self._lock:
+            self._transferred = True
+
+    def release(self, from_worker: bool = False) -> None:
+        with self._lock:
+            if self._released or (self._transferred and not from_worker):
+                return
+            self._released = True
+        self._running._exit()
+
+
 class _RunningServing:
     def __init__(self, cfg: dict[str, Any]):
         self.cfg = cfg
         self.predictor = _build_predictor(cfg)
         self.producer = pubsub.Producer(cfg["topic"])
         name = cfg["name"]
+        # Overload protection + failure gating (docs/operations.md
+        # "Failure handling"): a queue-depth shedder (in-flight handler
+        # threads over `max_inflight` get 503 + Retry-After instead of
+        # queueing into a latency collapse), a per-request deadline,
+        # and a circuit breaker that fails fast — and flips /healthz
+        # unready — while the predictor is down rather than flaky.
+        rcfg = cfg.get("resilience_config") or {}
+        self.max_inflight = rcfg.get("max_inflight")
+        self.deadline_s = rcfg.get("deadline_s")
+        self.breaker = CircuitBreaker(
+            name=f"serving-{name}",
+            failure_threshold=int(rcfg.get("breaker_failures", 5)),
+            reset_timeout_s=float(rcfg.get("breaker_reset_s", 30.0)),
+        )
+        self._inflight_lock = threading.Lock()
+        self._inflight = 0  # guarded by: self._inflight_lock
         self.batcher = None
         if cfg.get("batching_enabled"):
             bc = cfg.get("batching_config") or {}
@@ -464,6 +518,14 @@ class _RunningServing:
             "Request/response pairs tee'd onto the serving's pubsub topic",
             labels=("model",),
         ).labels(model=name)
+        m_shed = REGISTRY.counter(
+            "hops_tpu_serving_shed_total",
+            "Requests shed with 503, per serving endpoint and reason "
+            "(overload | breaker)",
+            labels=("model", "reason"),
+        )
+        running = self
+        breaker = self.breaker
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args: Any) -> None:  # silence stderr spam
@@ -480,6 +542,21 @@ class _RunningServing:
                     # (GET /metrics, GET /metrics.json) — the whole
                     # process's registry, not just this endpoint.
                     if telemetry_export.handle_metrics_path(self):
+                        return
+                    # Readiness: load balancers and supervisors poll
+                    # this; an open breaker = the predictor is down,
+                    # stop routing here until the half-open probe heals.
+                    if self.path.rstrip("/") == "/healthz":
+                        bstate = breaker.state
+                        if bstate == "open":
+                            retry = max(1.0, breaker.retry_after_s())
+                            self._reply(
+                                503,
+                                {"status": "unready", "breaker": bstate},
+                                headers={"Retry-After": f"{retry:.0f}"},
+                            )
+                        else:
+                            self._reply(200, {"status": "ok", "breaker": bstate})
                         return
                     # Exact TF-Serving routes only: /v1/models/<name>
                     # and the versioned /v1/models/<name>/versions/<N>
@@ -522,32 +599,115 @@ class _RunningServing:
                         self._reply(400, {"error": "payload must carry 'instances'"})
                         return
                     m_requests.inc()
-                    # span() records into the request-latency histogram
-                    # even when predict raises — error latency is
-                    # latency; the error counter increments below.
-                    with span("hops_tpu_serving_request", model=name):
-                        preds = predictor.predict(instances)
-                    response = {"predictions": preds}
-                    producer.send(
-                        {"request": payload, "response": response}, key=name
-                    )
-                    m_logged.inc()
-                    self._reply(200, response)
+                    # Load shedding BEFORE any model work: under a
+                    # burst past max_inflight the cheapest correct
+                    # answer is an immediate 503 + Retry-After — the
+                    # alternative (queueing) collapses every request's
+                    # latency, not just the excess.
+                    slot = running._enter()
+                    if slot is None:
+                        m_shed.inc(model=name, reason="overload")
+                        self._reply(
+                            503,
+                            {"error": "overloaded; retry later"},
+                            headers={"Retry-After": "1"},
+                        )
+                        return
+                    try:
+                        self._predict_and_reply(payload, instances, slot)
+                    finally:
+                        slot.release()  # no-op once transferred to a worker
                 except Exception as e:  # noqa: BLE001 — server must stay up
                     m_errors.inc()
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
-            def _reply(self, code: int, body: dict[str, Any]) -> None:
+            def _predict_and_reply(
+                self, payload: dict[str, Any], instances: list[Any],
+                slot: _InflightSlot,
+            ) -> None:
+                # Breaker check after shedding: an open breaker means
+                # the predictor itself is failing — don't waste a
+                # half-open probe on a request we'd shed anyway.
+                if not breaker.allow():
+                    m_shed.inc(model=name, reason="breaker")
+                    retry = max(1.0, breaker.retry_after_s())
+                    self._reply(
+                        503,
+                        {"error": "circuit open; predictor failing"},
+                        headers={"Retry-After": f"{retry:.0f}"},
+                    )
+                    return
+                try:
+                    # span() records into the request-latency histogram
+                    # even when predict raises — error latency is
+                    # latency; the error counter increments below.
+                    with span("hops_tpu_serving_request", model=name):
+                        faultinject.fire("serving.handle")  # chaos point
+                        if running.deadline_s:
+                            # The worker owns the slot from here: a
+                            # deadline overrun abandons the predict but
+                            # its computation still occupies predictor
+                            # capacity until it actually finishes.
+                            slot.transfer()
+
+                            def predict_holding_slot(rows):
+                                try:
+                                    return predictor.predict(rows)
+                                finally:
+                                    slot.release(from_worker=True)
+
+                            preds = with_deadline(
+                                predict_holding_slot, running.deadline_s,
+                                instances, op="serving.handle")
+                        else:
+                            preds = predictor.predict(instances)
+                except DeadlineExceeded as e:
+                    breaker.record_failure()
+                    m_errors.inc()
+                    self._reply(504, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                except Exception as e:  # noqa: BLE001 — fail THIS request
+                    breaker.record_failure()
+                    m_errors.inc()
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                breaker.record_success()
+                response = {"predictions": preds}
+                producer.send(
+                    {"request": payload, "response": response}, key=name
+                )
+                m_logged.inc()
+                self._reply(200, response)
+
+            def _reply(self, code: int, body: dict[str, Any],
+                       headers: dict[str, str] | None = None) -> None:
                 data = json.dumps(body).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
         self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
         self.thread.start()
+
+    def _enter(self) -> "_InflightSlot | None":
+        """Admit a request unless ``max_inflight`` concurrent predictor
+        executions are already in flight (None = no cap). Returns a
+        one-shot slot the caller must release."""
+        with self._inflight_lock:
+            if (self.max_inflight is not None
+                    and self._inflight >= self.max_inflight):
+                return None
+            self._inflight += 1
+        return _InflightSlot(self)
+
+    def _exit(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
 
     @property
     def port(self) -> int:
@@ -576,6 +736,7 @@ def create_or_update(
     batching_enabled: bool = False,
     batching_config: dict[str, Any] | None = None,
     lm_config: dict[str, Any] | None = None,
+    resilience_config: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """Create/update a serving endpoint definition (reference:
     ``serving.create_or_update``; ``batching_enabled`` mirrors the
@@ -591,7 +752,16 @@ def create_or_update(
     ``draft_model``/``draft_version``/``spec_k`` — a second registry
     model proposing tokens for greedy speculative serving); it does
     its own cross-request scheduling, so it composes with
-    ``batching_enabled=False`` only."""
+    ``batching_enabled=False`` only.
+
+    ``resilience_config`` knobs (docs/operations.md "Failure
+    handling"): ``max_inflight`` — concurrent-request cap beyond which
+    the endpoint sheds with 503 + ``Retry-After`` (default: uncapped);
+    ``deadline_s`` — per-request budget, overruns answer 504;
+    ``breaker_failures`` / ``breaker_reset_s`` — consecutive predictor
+    failures that open the circuit, and how long it stays open before
+    a half-open probe (defaults 5 / 30 s). ``GET /healthz`` reports
+    readiness and flips 503 while the breaker is open."""
     if model_server.upper() == LM and batching_enabled:
         raise ValueError(
             "model_server='LM' schedules requests itself (continuous "
@@ -648,6 +818,7 @@ def create_or_update(
         "batching_enabled": batching_enabled,
         "batching_config": batching_config or {},
         "lm_config": lm_config or {},
+        "resilience_config": resilience_config or {},
         "status": reg.get(name, {}).get("status", "Stopped"),
         "topic": f"serving-{name}-inference",
     }

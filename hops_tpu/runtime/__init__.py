@@ -6,4 +6,12 @@ filesystem and project scoping — re-imagined for a TPU slice instead of
 a Spark/YARN cluster.
 """
 
-from hops_tpu.runtime import config, devices, fs, logging, rundir  # noqa: F401
+from hops_tpu.runtime import (  # noqa: F401
+    config,
+    devices,
+    faultinject,
+    fs,
+    logging,
+    resilience,
+    rundir,
+)

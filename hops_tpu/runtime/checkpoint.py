@@ -12,7 +12,15 @@ auto-resume of a killed run**. This module closes that gap the TPU way:
   ``NamedSharding`` they were saved under (or any new mesh layout the
   caller requests via the template), so a run can resume on a
   differently-sized slice;
-- ``restore_or_init`` — the one-call auto-resume the reference lacked.
+- ``restore_or_init`` — the one-call auto-resume the reference lacked;
+- **integrity manifests** — every finalized step gets a
+  ``manifest_<step>.json`` sidecar with per-file sizes and SHA-256
+  checksums. Restore verifies the candidate step against its manifest
+  first; a corrupt or partial step (truncated write, bitrot, a
+  preemption mid-finalize) is **quarantined** — renamed to
+  ``corrupt_<step>.quarantined``, preserved for forensics, invisible
+  to orbax — and restore falls back to the newest *valid* step instead
+  of crashing the resume path.
 
 Default directory is the active run's ``checkpoints/`` subdir, so the
 reference's "durability = logdir synced to the Experiments dataset"
@@ -21,16 +29,42 @@ story carries over unchanged.
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 from pathlib import Path
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
 
-from hops_tpu.runtime import rundir
+from hops_tpu.runtime import faultinject, rundir
 from hops_tpu.runtime.logging import get_logger
+from hops_tpu.telemetry.metrics import REGISTRY
 
 log = get_logger(__name__)
+
+_m_quarantined = REGISTRY.counter(
+    "hops_tpu_checkpoint_quarantined_total",
+    "Checkpoint steps quarantined as corrupt/partial at restore time",
+)
+
+
+class CheckpointCorruptError(RuntimeError):
+    """An explicitly requested step failed integrity verification."""
+
+
+def _file_sha256(path: Path, chunk: int = 1 << 20) -> str:
+    """Streaming digest: checkpoint shards are multi-GB on real pods —
+    reading one whole into host memory per save/restore would spike
+    RSS by the largest shard."""
+    h = hashlib.sha256()
+    with path.open("rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return h.hexdigest()
+            h.update(block)
 
 
 def _default_dir() -> str:
@@ -62,9 +96,6 @@ def _data_state_path(directory: str | Path, step: int) -> Path:
 
 def save_data_state(directory: str | Path | None, step: int, state: dict) -> None:
     """Persist an input-pipeline snapshot next to checkpoint ``step``."""
-    import json
-    import os
-
     path = _data_state_path(directory or _default_dir(), step)
     path.parent.mkdir(parents=True, exist_ok=True)
     tmp = path.with_suffix(".json.tmp")
@@ -75,12 +106,18 @@ def save_data_state(directory: str | Path | None, step: int, state: dict) -> Non
 def load_data_state(directory: str | Path | None, step: int) -> dict | None:
     """The input-pipeline snapshot saved with checkpoint ``step``, or
     None if that step carries no data state (pre-loader checkpoints)."""
-    import json
-
     path = _data_state_path(directory or _default_dir(), step)
     try:
         return json.loads(path.read_text())
-    except (OSError, ValueError):
+    except FileNotFoundError:
+        return None  # the normal pre-loader / no-sidecar case
+    except (OSError, ValueError) as e:
+        # A sidecar that EXISTS but won't load means the resume will
+        # silently start from the wrong input position — at least make
+        # that diagnosable.
+        log.warning("data-state sidecar %s unreadable (%s: %s); resuming "
+                    "without input-pipeline position", path,
+                    type(e).__name__, e)
         return None
 
 
@@ -112,6 +149,9 @@ class CheckpointManager:
     ):
         self.directory = Path(directory or _default_dir()).resolve()
         self.directory.mkdir(parents=True, exist_ok=True)
+        self._async = async_save
+        self._pending_manifests: set[int] = set()
+        self._corrupt_steps: set[int] = set()  # faultinject.checkpoint.save
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -122,7 +162,147 @@ class CheckpointManager:
         )
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
-        return self._mgr.save(int(step), args=ocp.args.StandardSave(state), force=force)
+        saved = self._mgr.save(int(step), args=ocp.args.StandardSave(state), force=force)
+        if saved:
+            # Fault point fires on ACTUAL saves (orbax declines
+            # off-interval steps), so a plan's passage schedule counts
+            # checkpoints, not loop iterations. Corrupt mode damages
+            # THIS step's files once its manifest is written
+            # (post-finalize bitrot — the manifest records healthy
+            # checksums, so restore must catch the mismatch).
+            if faultinject.fire("checkpoint.save"):
+                self._corrupt_steps.add(int(step))
+            self._pending_manifests.add(int(step))
+        # Orbax serializes saves: by the time save() returns, every
+        # EARLIER step is finalized on disk and safe to checksum. The
+        # current step joins them once it finalizes (next save / wait).
+        # Declined off-interval saves with nothing pending skip the
+        # flush entirely — run_preemptible calls save() every training
+        # step, and the flush's step scan + manifest glob is remote
+        # LIST traffic on GCS/NFS checkpoint dirs.
+        if saved or self._pending_manifests:
+            self._flush_manifests(exclude=int(step) if self._async else None)
+        return saved
+
+    # -- integrity manifests --------------------------------------------------
+
+    def _manifest_path(self, step: int) -> Path:
+        return self.directory / f"manifest_{int(step)}.json"
+
+    def _step_dir(self, step: int) -> Path:
+        return self.directory / str(int(step))
+
+    def _flush_manifests(self, exclude: int | None = None) -> None:
+        for step in sorted(self._pending_manifests):
+            if step == exclude:
+                continue
+            if self._write_manifest(step):
+                self._pending_manifests.discard(step)
+        # GC manifests whose step orbax has pruned (same rationale as
+        # the data-state sidecar GC in save_data_state).
+        keep = set(self.all_steps()) | self._pending_manifests
+        if exclude is not None:
+            keep.add(exclude)
+        for p in self.directory.glob("manifest_*.json"):
+            try:
+                s = int(p.stem.rsplit("_", 1)[-1])
+            except ValueError:
+                continue
+            if s not in keep:
+                try:
+                    p.unlink()
+                except OSError as e:
+                    log.warning("manifest GC could not remove %s: %s", p, e)
+
+    def _write_manifest(self, step: int) -> bool:
+        """Checksum a finalized step into its manifest. Returns True
+        when the step no longer needs one (written, or pruned) — an
+        async step still writing to its orbax temp dir returns False
+        and stays pending until the next flush."""
+        step_dir = self._step_dir(step)
+        if not step_dir.is_dir():
+            # Either pruned (max_to_keep — done with it) or an async
+            # save not yet finalized into place (keep waiting).
+            return step not in self.all_steps()
+        files = {}
+        for p in sorted(step_dir.rglob("*")):
+            if not p.is_file():
+                continue
+            files[p.relative_to(step_dir).as_posix()] = {
+                "size": p.stat().st_size,
+                "sha256": _file_sha256(p),
+            }
+        tmp = self._manifest_path(step).with_suffix(".json.tmp")
+        tmp.write_text(json.dumps({"step": int(step), "files": files}))
+        os.replace(tmp, self._manifest_path(step))
+        if step in self._corrupt_steps:  # armed fault: post-manifest bitrot
+            self._corrupt_steps.discard(step)
+            faultinject.corrupt_directory(step_dir)
+        return True
+
+    def verify_step(self, step: int) -> str | None:
+        """Integrity-check ``step`` against its manifest. Returns None
+        when it passes (or predates manifests — nothing to check
+        against), else a human-readable description of the damage."""
+        manifest_path = self._manifest_path(step)
+        try:
+            manifest = json.loads(manifest_path.read_text())
+        except FileNotFoundError:
+            return None  # legacy step: no manifest to verify against
+        except (OSError, ValueError) as e:
+            return f"manifest unreadable ({type(e).__name__}: {e})"
+        step_dir = self._step_dir(step)
+        for rel, meta in manifest.get("files", {}).items():
+            p = step_dir / rel
+            try:
+                size = p.stat().st_size
+                if size != meta["size"]:
+                    return f"{rel}: size {size} != manifest {meta['size']}"
+                if _file_sha256(p) != meta["sha256"]:
+                    return f"{rel}: checksum mismatch"
+            except OSError as e:
+                return f"{rel}: unreadable ({type(e).__name__}: {e})"
+        return None
+
+    def _step_looks_damaged(self, step: int) -> str | None:
+        """Cheap structural triage for manifest-less steps: orbax's own
+        metadata files must exist and parse. Returns a description of
+        the damage, or None when the structure is intact (in which case
+        a restore failure is more plausibly a template/code bug)."""
+        step_dir = self._step_dir(step)
+        if not (step_dir / "_CHECKPOINT_METADATA").is_file():
+            return "missing _CHECKPOINT_METADATA"
+        for p in step_dir.rglob("_METADATA"):
+            try:
+                json.loads(p.read_text())
+            except (OSError, ValueError) as e:
+                return (f"{p.relative_to(step_dir).as_posix()} unparsable "
+                        f"({type(e).__name__})")
+        return None
+
+    def quarantine(self, step: int, reason: str) -> Path:
+        """Move a damaged step out of orbax's sight (rename to
+        ``corrupt_<step>.quarantined`` — preserved for forensics; the
+        ``.quarantined`` suffix keeps orbax's step scanner from parsing
+        it as a step) and drop its manifest."""
+        step = int(step)
+        step_dir = self._step_dir(step)
+        target = self.directory / f"corrupt_{step}.quarantined"
+        if target.exists():  # re-quarantine of the same step number
+            suffix = 1
+            while (self.directory / f"corrupt_{step}.{suffix}.quarantined").exists():
+                suffix += 1
+            target = self.directory / f"corrupt_{step}.{suffix}.quarantined"
+        os.replace(step_dir, target)
+        try:
+            self._manifest_path(step).unlink()
+        except OSError:
+            pass  # no manifest (legacy step) — nothing else to drop
+        _m_quarantined.inc()
+        log.error("checkpoint step %d is corrupt (%s): quarantined to %s",
+                  step, reason, target)
+        self._mgr.reload()  # orbax must forget the renamed step
+        return target
 
     def save_data_state(self, step: int, state: dict) -> None:
         """Sidecar snapshot of input-pipeline state for ``step`` (see
@@ -141,8 +321,11 @@ class CheckpointManager:
             if s not in keep:
                 try:
                     p.unlink()
-                except FileNotFoundError:
-                    pass
+                except OSError as e:
+                    # A permission error mid-GC must not fail the SAVE
+                    # that triggered it — the sidecar is merely stale.
+                    if not isinstance(e, FileNotFoundError):
+                        log.warning("sidecar GC could not remove %s: %s", p, e)
 
     def load_data_state(self, step: int) -> dict | None:
         return load_data_state(self.directory, step)
@@ -152,13 +335,57 @@ class CheckpointManager:
 
         ``state_template`` may be a concrete pytree (its arrays are used
         as placement spec) or the result of :func:`abstract_state`.
+
+        ``step=None`` restores the newest **valid** step: candidates
+        failing manifest verification — and manifest-less legacy steps
+        whose actual restore raises — are quarantined
+        (:meth:`quarantine`) and the next-newest step is tried, so one
+        truncated write cannot brick the resume path. An explicit
+        ``step`` is restored as asked: verification failure raises
+        :class:`CheckpointCorruptError` and nothing is renamed.
         """
-        if step is None:
-            step = self.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {self.directory}")
         template = abstract_state(state_template)
-        return self._mgr.restore(int(step), args=ocp.args.StandardRestore(template))
+        if step is not None:
+            reason = self.verify_step(int(step))
+            if reason is not None:
+                raise CheckpointCorruptError(
+                    f"checkpoint step {step} under {self.directory} failed "
+                    f"verification: {reason}")
+            return self._mgr.restore(int(step), args=ocp.args.StandardRestore(template))
+        # The fault point counts passages of AUTO restores only: an
+        # explicit-step restore has no "latest" to damage and must not
+        # silently consume a chaos plan's scheduled corruption.
+        corrupt_latest = faultinject.fire("checkpoint.restore")
+        while True:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint under {self.directory}")
+            if corrupt_latest:  # armed fault: at-rest damage, found now
+                corrupt_latest = False
+                faultinject.corrupt_directory(self._step_dir(step))
+            reason = self.verify_step(step)
+            if reason is None:
+                try:
+                    return self._mgr.restore(
+                        step, args=ocp.args.StandardRestore(template))
+                except Exception as e:  # noqa: BLE001 — filtered just below
+                    if self._manifest_path(step).exists():
+                        # Checksums passed, restore still failed: the
+                        # files are intact, so this is a template/code
+                        # error, not corruption — quarantining would
+                        # destroy a good checkpoint.
+                        raise
+                    damage = self._step_looks_damaged(step)
+                    if damage is None:
+                        # Manifest-less (legacy) step whose structure
+                        # is intact: a caller-side template bug raises
+                        # here too, and quarantining on it would eat
+                        # EVERY pre-manifest checkpoint one loop
+                        # iteration at a time. Only demonstrable
+                        # damage gets a legacy step quarantined.
+                        raise
+                    reason = f"restore failed ({type(e).__name__}: {e}); {damage}"
+            self.quarantine(step, reason)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -168,9 +395,11 @@ class CheckpointManager:
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()
+        self._flush_manifests()
 
     def close(self) -> None:
-        self._mgr.close()
+        self._mgr.close()  # waits for in-flight saves first
+        self._flush_manifests()
 
     def __enter__(self):
         return self
@@ -190,9 +419,19 @@ def restore_or_init(state: Any, directory: str | Path | None = None) -> tuple[An
         for step in range(start, num_steps): ...
     """
     with CheckpointManager(directory, async_save=False) as mgr:
-        step = mgr.latest_step()
-        if step is None:
+        if mgr.latest_step() is None:
             return state, 0
-        restored = mgr.restore(state, step)
+        # Auto-restore: a corrupt/partial newest step is quarantined and
+        # the newest VALID one restores instead (see CheckpointManager
+        # .restore) — after which latest_step() IS the restored step.
+        try:
+            restored = mgr.restore(state)
+        except FileNotFoundError:
+            # Every candidate step was quarantined: a fresh start is
+            # the correct (and loudly logged) outcome.
+            log.error("all checkpoint steps under %s were corrupt; "
+                      "starting from step 0", mgr.directory)
+            return state, 0
+        step = mgr.latest_step()
         log.info("resumed from checkpoint step=%d dir=%s", step, mgr.directory)
         return restored, step + 1

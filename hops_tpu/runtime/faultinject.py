@@ -1,0 +1,316 @@
+"""Deterministic fault injection: break the platform on purpose.
+
+Every resilience behavior in this tree — checkpoint quarantine, the
+``run_preemptible`` supervisor, serving load-shedding, trial retries —
+is proven by *injecting the fault it defends against*, not by hoping a
+flaky CI run exercises it. This module is the injection registry:
+named **fault points** compiled into the hot paths, disarmed by
+default (one ``is None`` check — see the ``bench.py --fault-overhead``
+smoke), armed either in code::
+
+    from hops_tpu.runtime import faultinject
+    faultinject.arm(faultinject.FaultPlan.parse(
+        "loader.read=error:OSError@times=1,after=5"))
+
+or from the environment for end-to-end chaos tests::
+
+    HOPS_TPU_FAULTS="checkpoint.save=corrupt@times=1;serving.handle=error:RuntimeError@p=0.5"
+
+Grammar: ``point=mode[:arg][@key=val,...]`` joined by ``;``.
+Modes: ``error[:ExcName]`` raises (builtin exception, default
+``RuntimeError``), ``latency:seconds`` sleeps, ``corrupt`` asks the
+fault point to damage its payload (bytes) or artifact (files) — points
+that have nothing to damage ignore it. Keys: ``p`` (probability,
+default 1), ``times`` (max firings, default unlimited), ``after``
+(passages to skip first, default 0), ``seed``.
+
+Determinism: each spec keeps a passage counter; probabilistic firing
+draws from ``random.Random((seed, point, passage))`` — a plan replays
+identically across runs and regardless of thread interleaving *per
+point* (passages are counted under a lock).
+
+Fault points wired through the stack (keep in sync with
+docs/operations.md "Failure handling & fault injection"):
+
+==================  ========================================================
+``checkpoint.save``     ``CheckpointManager.save`` (corrupt: damages the
+                        step's files after its manifest is written)
+``checkpoint.restore``  ``CheckpointManager.restore`` (corrupt: damages the
+                        newest step before verification)
+``loader.read``         ``LoaderIterator`` batch production
+``serving.handle``      the serving POST handler, before predict
+``search.trial``        ``TrialDriver._run_trial``, around the train fn
+``pubsub.publish``      ``pubsub.Producer.send`` (corrupt: mangles the
+                        encoded record)
+==================  ========================================================
+"""
+
+from __future__ import annotations
+
+import builtins
+import dataclasses
+import hashlib
+import os
+import random
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+from hops_tpu.runtime.logging import get_logger
+from hops_tpu.telemetry.metrics import REGISTRY
+
+log = get_logger(__name__)
+
+ENV_VAR = "HOPS_TPU_FAULTS"
+
+#: The named injection points compiled into the stack.
+POINTS = (
+    "checkpoint.save",
+    "checkpoint.restore",
+    "loader.read",
+    "serving.handle",
+    "search.trial",
+    "pubsub.publish",
+)
+
+_MODES = ("error", "latency", "corrupt")
+
+_m_injected = REGISTRY.counter(
+    "hops_tpu_faults_injected_total",
+    "Faults actually injected, per fault point and mode",
+    labels=("point", "mode"),
+)
+
+
+class FaultPlanError(ValueError):
+    """A ``HOPS_TPU_FAULTS`` string / FaultSpec that doesn't parse."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed fault: what to do at a point, and on which passages."""
+
+    point: str
+    mode: str
+    arg: Any = None  # exception class (error) / seconds (latency)
+    probability: float = 1.0
+    times: int | None = None
+    after: int = 0
+    seed: int = 0
+    # runtime counters — guarded by: FaultPlan._lock
+    passages: int = 0
+    fired: int = 0
+
+    def __post_init__(self) -> None:
+        if self.point not in POINTS:
+            raise FaultPlanError(
+                f"unknown fault point {self.point!r}; known: {', '.join(POINTS)}")
+        if self.mode not in _MODES:
+            raise FaultPlanError(
+                f"unknown fault mode {self.mode!r}; known: {', '.join(_MODES)}")
+        if self.mode == "error":
+            if self.arg is None:
+                self.arg = RuntimeError
+            elif isinstance(self.arg, str):
+                exc = getattr(builtins, self.arg, None)
+                if not (isinstance(exc, type) and issubclass(exc, BaseException)):
+                    raise FaultPlanError(
+                        f"{self.arg!r} is not a builtin exception type")
+                self.arg = exc
+        elif self.mode == "latency":
+            try:
+                self.arg = float(self.arg)
+            except (TypeError, ValueError):
+                raise FaultPlanError(
+                    f"latency mode needs seconds, got {self.arg!r}") from None
+        if not 0.0 <= self.probability <= 1.0:
+            raise FaultPlanError(f"probability must be in [0,1], got "
+                                 f"{self.probability}")
+
+    def _should_fire(self) -> bool:  # guarded by: FaultPlan._lock
+        passage = self.passages
+        self.passages += 1
+        if passage < self.after:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.probability < 1.0:
+            # Stable digest seed: random.seed rejects tuples on 3.11+
+            # and would hash the point name under PYTHONHASHSEED on
+            # 3.10 — either way breaking cross-run replayability.
+            digest = hashlib.sha256(
+                f"{self.seed}:{self.point}:{passage}".encode()).digest()
+            rng = random.Random(int.from_bytes(digest[:8], "big"))
+            if rng.random() >= self.probability:
+                return False
+        self.fired += 1
+        return True
+
+
+class FaultPlan:
+    """An armed set of :class:`FaultSpec`, indexed by point."""
+
+    def __init__(self, specs: list[FaultSpec]):
+        self._lock = threading.Lock()
+        self._by_point: dict[str, list[FaultSpec]] = {}
+        for spec in specs:
+            self._by_point.setdefault(spec.point, []).append(spec)
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultPlan":
+        """Parse the ``HOPS_TPU_FAULTS`` grammar (see module docstring)."""
+        specs: list[FaultSpec] = []
+        for clause in text.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            if "=" not in clause:
+                raise FaultPlanError(f"expected point=mode[...], got {clause!r}")
+            point, rest = clause.split("=", 1)
+            opts = ""
+            if "@" in rest:
+                rest, opts = rest.split("@", 1)
+            mode, _, arg = rest.partition(":")
+            kwargs: dict[str, Any] = {}
+            for kv in opts.split(","):
+                kv = kv.strip()
+                if not kv:
+                    continue
+                if "=" not in kv:
+                    raise FaultPlanError(f"expected key=val in options, got {kv!r}")
+                k, v = kv.split("=", 1)
+                k = k.strip()
+                if k == "p":
+                    kwargs["probability"] = float(v)
+                elif k in ("times", "after", "seed"):
+                    kwargs[k] = int(v)
+                else:
+                    raise FaultPlanError(f"unknown fault option {k!r}")
+            specs.append(FaultSpec(point=point.strip(), mode=mode.strip(),
+                                   arg=arg or None, **kwargs))
+        if not specs:
+            raise FaultPlanError(f"no fault specs in {text!r}")
+        return cls(specs)
+
+    def evaluate(self, point: str) -> list[FaultSpec]:
+        """The specs that fire on this passage of ``point``."""
+        specs = self._by_point.get(point)
+        if not specs:
+            return []
+        with self._lock:
+            return [s for s in specs if s._should_fire()]
+
+    def describe(self) -> str:
+        return "; ".join(
+            f"{s.point}={s.mode}"
+            + (f":{getattr(s.arg, '__name__', s.arg)}" if s.arg is not None else "")
+            for specs in self._by_point.values() for s in specs
+        )
+
+
+#: The armed plan. ``None`` = disarmed: :func:`fire` is a single
+#: attribute load + ``is None`` test, nothing else (bench-guarded).
+_PLAN: FaultPlan | None = None
+
+
+def arm(plan: FaultPlan | str) -> FaultPlan:
+    """Arm a plan (or a plan string) process-wide; returns it."""
+    global _PLAN
+    if isinstance(plan, str):
+        plan = FaultPlan.parse(plan)
+    _PLAN = plan
+    log.warning("fault injection ARMED: %s", plan.describe())
+    return plan
+
+
+def disarm() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def armed() -> bool:
+    return _PLAN is not None
+
+
+def arm_from_env(environ: dict | None = None) -> FaultPlan | None:
+    """Arm from ``HOPS_TPU_FAULTS`` if set (e2e chaos tests); returns
+    the plan or None. Malformed plans raise — a chaos test that thinks
+    it is injecting faults but isn't must not pass silently."""
+    text = (environ if environ is not None else os.environ).get(ENV_VAR)
+    if not text:
+        return None
+    return arm(text)
+
+
+def _apply(spec: FaultSpec, point: str) -> bool:
+    """Execute one fired spec; returns True when it was ``corrupt``."""
+    _m_injected.inc(point=point, mode=spec.mode)
+    if spec.mode == "latency":
+        log.warning("faultinject: %s sleeping %.3fs", point, spec.arg)
+        time.sleep(spec.arg)
+        return False
+    if spec.mode == "error":
+        log.warning("faultinject: %s raising %s", point, spec.arg.__name__)
+        raise spec.arg(f"faultinject: injected {spec.arg.__name__} at {point}")
+    log.warning("faultinject: %s corrupt trigger", point)
+    return True
+
+
+def fire(point: str) -> bool:
+    """Evaluate ``point``. Raises / sleeps per the armed plan; returns
+    True when a ``corrupt`` spec fired (the site decides what that
+    means for its artifact). Disarmed: returns False immediately."""
+    if _PLAN is None:
+        return False
+    corrupt = False
+    for spec in _PLAN.evaluate(point):
+        corrupt |= _apply(spec, point)
+    return corrupt
+
+
+def fire_data(point: str, data: bytes) -> bytes:
+    """Like :func:`fire` for byte-payload points: a ``corrupt`` spec
+    returns a damaged copy of ``data`` instead of a flag."""
+    if _PLAN is None:
+        return data
+    if fire(point):
+        return _corrupt_bytes(data)
+    return data
+
+
+def _corrupt_bytes(data: bytes) -> bytes:
+    """Deterministic damage: truncate the body to half and flip its
+    first byte — enough to defeat checksums and parsers. A trailing
+    newline is PRESERVED: line-framed payloads (pubsub records) must
+    stay one damaged record, not bleed into the next line — a missing
+    terminator would wedge tailing consumers on a partial-write check
+    forever, which is a different fault than corruption."""
+    tail = b"\n" if data.endswith(b"\n") else b""
+    body = data[: len(data) - len(tail)]
+    half = body[: max(1, len(body) // 2)]
+    return bytes([half[0] ^ 0xFF]) + half[1:] + tail if half else tail
+
+
+def corrupt_directory(directory: str | Path) -> Path | None:
+    """Damage the largest file under ``directory`` in place (truncate
+    to half) — the checkpoint fault points' artifact corruption.
+    Returns the damaged path (None when the dir holds no files)."""
+    directory = Path(directory)
+    files = sorted(
+        (p for p in directory.rglob("*") if p.is_file()),
+        key=lambda p: p.stat().st_size,
+    )
+    if not files:
+        return None
+    victim = files[-1]
+    data = victim.read_bytes()
+    victim.write_bytes(_corrupt_bytes(data) if data else b"")
+    log.warning("faultinject: corrupted %s (%d -> %d bytes)",
+                victim, len(data), victim.stat().st_size)
+    return victim
+
+
+# E2E chaos tests arm via the environment before the process starts.
+if os.environ.get(ENV_VAR):
+    arm_from_env()

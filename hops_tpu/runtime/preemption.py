@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import signal
 import threading
+import time
 from typing import Any
 
 from hops_tpu.runtime.logging import get_logger
@@ -63,6 +64,7 @@ class PreemptionGuard:
         self._flag = threading.Event()
         self._signals = tuple(signals)
         self._previous: dict[Any, Any] = {}
+        self._sync_polls = 0  # should_stop(sync=True) decimation counter
         if install:
             self.install()
 
@@ -103,19 +105,30 @@ class PreemptionGuard:
         """Programmatic preemption (tests, external watchers)."""
         self._flag.set()
 
-    def should_stop(self, sync: bool = False) -> bool:
+    def should_stop(self, sync: bool = False, sync_every: int = 1) -> bool:
         """True once a preemption notice arrived.
 
         ``sync=True``: agree across ALL processes (any-host max) so a
         multihost loop exits at one coherent step boundary. Costs one
-        tiny all-reduce — poll every step (it rides the step's existing
-        dispatch cadence) or every k steps on latency-critical loops.
+        tiny all-reduce per poll. ``sync_every=k`` decimates that cost:
+        only every k-th poll performs the allgather (an internal poll
+        counter, shared across hosts because every host polls once per
+        step); the polls in between return False even when the LOCAL
+        flag is set, so an agreed stop still lands on a common
+        k-boundary — a host that answered its own flag early would
+        leave the stragglers deadlocked in their next collective.
         """
         import jax
 
+        if sync_every < 1:
+            raise ValueError(f"sync_every must be >= 1, got {sync_every}")
         local = self._flag.is_set()
         if not sync or jax.process_count() == 1:
             return local
+        poll = self._sync_polls
+        self._sync_polls += 1
+        if poll % sync_every:
+            return False  # off-boundary: defer so every host agrees
         from jax.experimental import multihost_utils
         import numpy as np
 
@@ -137,7 +150,10 @@ def run_preemptible(
     directory: str | None = None,
     save_every: int = 100,
     sync: bool | None = None,
+    sync_every: int = 1,
     guard: PreemptionGuard | None = None,
+    max_recoveries: int = 0,
+    recovery_policy: Any = None,
 ):
     """Checkpointed, preemption-safe training loop.
 
@@ -159,19 +175,88 @@ def run_preemptible(
     (``checkpoint.save_data_state``), and resume repositions the
     iterator from the restored step's sidecar, so the exact remaining
     batch stream replays deterministically.
+
+    ``sync_every=k`` decimates the multihost stop-agreement allgather
+    to every k-th step (see :meth:`PreemptionGuard.should_stop`).
+
+    **Supervisor mode** (``max_recoveries > 0``): a transient step or
+    feed failure no longer kills the run. The exception is caught, the
+    state is re-restored from the newest *valid* checkpoint (a corrupt
+    latest step is quarantined by ``CheckpointManager.restore``), the
+    batch stream is rebuilt at the restored position, and the loop
+    resumes — up to ``max_recoveries`` times, backing off between
+    attempts under ``recovery_policy`` (a ``resilience.RetryPolicy``;
+    default: 3 attempts irrelevant here, only its delay schedule is
+    used). Each recovery increments ``hops_tpu_run_recoveries_total``.
+    Requires ``batches`` to be re-derivable: a callable, a resumable
+    iterator, or a re-iterable sequence (a one-shot generator cannot
+    be replayed and exhausts recovery). Preemption notices and
+    ``KeyboardInterrupt``/``SystemExit`` are never treated as
+    recoverable.
     """
     import jax
 
+    from hops_tpu.runtime.resilience import RetryPolicy
+    from hops_tpu.telemetry.metrics import REGISTRY
+
+    own_guard = guard is None
+    guard = guard or PreemptionGuard()
+    if sync is None:
+        sync = jax.process_count() > 1
+    policy = recovery_policy or RetryPolicy(base_delay_s=0.05, max_delay_s=5.0)
+    import random
+
+    backoff_rng = random.Random(policy.seed) if policy.seed is not None else None
+    m_recoveries = REGISTRY.counter(
+        "hops_tpu_run_recoveries_total",
+        "Supervisor recoveries (re-restore + resume after a transient "
+        "step/feed failure), per loop",
+        labels=("loop",),
+    )
+    recoveries = 0
+    try:
+        while True:
+            try:
+                return _run_attempt(
+                    train_step, state, batches, directory=directory,
+                    save_every=save_every, sync=sync, sync_every=sync_every,
+                    guard=guard)
+            except Exception as e:  # noqa: BLE001 — bounded supervisor retry
+                if recoveries >= max_recoveries:
+                    raise
+                recoveries += 1
+                m_recoveries.inc(loop="preemptible")
+                pause = policy.delay(recoveries - 1, backoff_rng)
+                log.warning(
+                    "run_preemptible: transient failure (%s: %s); recovery "
+                    "%d/%d — re-restoring from checkpoint in %.2fs",
+                    type(e).__name__, e, recoveries, max_recoveries, pause)
+                time.sleep(pause)
+    finally:
+        if own_guard:
+            guard.uninstall()
+
+
+def _run_attempt(
+    train_step,
+    state: Any,
+    batches,
+    *,
+    directory: str | None,
+    save_every: int,
+    sync: bool,
+    sync_every: int,
+    guard: PreemptionGuard,
+):
+    """One incarnation of the train loop: restore, step, checkpoint.
+    Raises on step/feed failure — the supervisor in
+    :func:`run_preemptible` decides whether that is fatal."""
     from hops_tpu.runtime.checkpoint import (
         CheckpointManager,
         load_data_state,
         restore_or_init,
     )
 
-    own_guard = guard is None
-    guard = guard or PreemptionGuard()
-    if sync is None:
-        sync = jax.process_count() > 1
     state, start = restore_or_init(state, directory)
     metrics = None
     step = start - 1
@@ -194,39 +279,35 @@ def run_preemptible(
     # explicit heartbeat() call wired into the loop.
     timer = StepTimer(loop="preemptible")
     timer.arm()
-    try:
-        with CheckpointManager(directory, save_interval_steps=save_every) as ckpt:
-            saved = ran = False
-            for step, batch in stream:
-                if step < start:
-                    continue  # consumed by a previous incarnation
-                ran = True
-                state, metrics = train_step(state, batch)
-                timer.tick(examples=_batch_examples(batch))
-                saved = ckpt.save(step, state)  # interval save
-                if saved and resumable:
-                    ckpt.save_data_state(step, src.state_dict())
-                if guard.should_stop(sync=sync):
-                    if not saved:
-                        # orbax refuses to overwrite an existing step
-                        # even with force=True — only save if the
-                        # interval save didn't just write this step.
-                        ckpt.save(step, state, force=True)
-                        if resumable:
-                            ckpt.save_data_state(step, src.state_dict())
-                    log.warning("preempted: checkpointed step %d, exiting "
-                                "cleanly", step)
-                    break
-            else:
-                # Normal completion: make the final state durable too —
-                # otherwise up to save_every-1 finished steps would be
-                # redone by the next incarnation after a hard kill.
-                if ran and not saved:
+    with CheckpointManager(directory, save_interval_steps=save_every) as ckpt:
+        saved = ran = False
+        for step, batch in stream:
+            if step < start:
+                continue  # consumed by a previous incarnation
+            ran = True
+            state, metrics = train_step(state, batch)
+            timer.tick(examples=_batch_examples(batch))
+            saved = ckpt.save(step, state)  # interval save
+            if saved and resumable:
+                ckpt.save_data_state(step, src.state_dict())
+            if guard.should_stop(sync=sync, sync_every=sync_every):
+                if not saved:
+                    # orbax refuses to overwrite an existing step
+                    # even with force=True — only save if the
+                    # interval save didn't just write this step.
                     ckpt.save(step, state, force=True)
                     if resumable:
                         ckpt.save_data_state(step, src.state_dict())
-            ckpt.wait()
-    finally:
-        if own_guard:
-            guard.uninstall()
+                log.warning("preempted: checkpointed step %d, exiting "
+                            "cleanly", step)
+                break
+        else:
+            # Normal completion: make the final state durable too —
+            # otherwise up to save_every-1 finished steps would be
+            # redone by the next incarnation after a hard kill.
+            if ran and not saved:
+                ckpt.save(step, state, force=True)
+                if resumable:
+                    ckpt.save_data_state(step, src.state_dict())
+        ckpt.wait()
     return state, metrics, step + 1

@@ -20,6 +20,7 @@ and :func:`differential_evolution` (``hops.experiment``, SURVEY.md §2.3).
 from __future__ import annotations
 
 import concurrent.futures as cf
+import dataclasses
 import inspect
 import json
 import threading
@@ -73,9 +74,20 @@ class TrialDriver:
         max_parallel: int | None = None,
         devices_per_trial: int = 1,
         use_rpc: bool = True,
+        retry_policy: Any = None,
     ):
         self.train_fn = train_fn
         self.optimizer = optimizer
+        # Transient trial failures (device hiccup, flaky I/O) retry
+        # under the policy before the trial is marked failed; an
+        # early-stop signal is never a failure, so never retried.
+        self.retry_policy = (
+            None if retry_policy is None
+            else dataclasses.replace(
+                retry_policy,
+                no_retry_on=tuple(retry_policy.no_retry_on) + (TrialStopped,),
+            )
+        )
         self.name = name
         self.kind = kind
         self.direction = direction.lower()
@@ -145,13 +157,21 @@ class TrialDriver:
         metric: float | None = None
         try:
             from hops_tpu.parallel import mesh as mesh_lib
+            from hops_tpu.runtime import faultinject
 
-            with (
-                jax.default_device(group[0]),
-                mesh_lib.device_scope(group),
-                rundir.activate(trial_dir),
-            ):
-                result = self.train_fn(**kwargs)
+            def _attempt():
+                faultinject.fire("search.trial")  # chaos: flaky trial
+                with (
+                    jax.default_device(group[0]),
+                    mesh_lib.device_scope(group),
+                    rundir.activate(trial_dir),
+                ):
+                    return self.train_fn(**kwargs)
+
+            if self.retry_policy is None:
+                result = _attempt()
+            else:
+                result = self.retry_policy.call(_attempt, op="search.trial")
             metric = self._extract_metric(result)
         except TrialStopped:
             stopped = True
@@ -339,13 +359,16 @@ def lagom(
     optimization_key: str | None = None,
     max_parallel: int | None = None,
     devices_per_trial: int = 1,
+    retry_policy: Any = None,
 ) -> dict[str, Any]:
     """Async parallel trials (reference: ``maggy.experiment.lagom``,
     maggy-fashion-mnist-example.ipynb:318-327).
 
     ``devices_per_trial`` places each trial on its own disjoint
     sub-slice of that many chips; inside the trial,
-    ``parallel.mesh.make_mesh()`` builds over just that group."""
+    ``parallel.mesh.make_mesh()`` builds over just that group.
+    ``retry_policy`` (a ``runtime.resilience.RetryPolicy``) retries a
+    trial that raised before marking it failed."""
     if experiment_type == "ablation":
         if ablation_study is None:
             raise ValueError("experiment_type='ablation' requires ablation_study=")
@@ -368,6 +391,7 @@ def lagom(
         early_stopper=MedianEarlyStopper(direction, es_min),
         max_parallel=max_parallel,
         devices_per_trial=devices_per_trial,
+        retry_policy=retry_policy,
     )
     path, summary = driver.run()
     summary["path"] = path
@@ -382,6 +406,7 @@ def grid_search(
     name: str = "grid_search",
     max_parallel: int | None = None,
     devices_per_trial: int = 1,
+    retry_policy: Any = None,
 ) -> tuple[str, dict[str, Any]]:
     """Exhaustive sweep (reference: ``experiment.grid_search``,
     grid_search_fashion_mnist.ipynb:311 — args_dict keys are wrapper
@@ -395,6 +420,7 @@ def grid_search(
         optimization_key=optimization_key,
         max_parallel=max_parallel,
         devices_per_trial=devices_per_trial,
+        retry_policy=retry_policy,
     )
     return driver.run()
 
@@ -410,6 +436,7 @@ def differential_evolution(
     name: str = "differential_evolution",
     max_parallel: int | None = None,
     devices_per_trial: int = 1,
+    retry_policy: Any = None,
 ) -> tuple[str, dict[str, Any]]:
     """Genetic search (reference: ``experiment.differential_evolution``,
     evolutionary_search_mnist.ipynb:267, generations/population semantics
@@ -436,5 +463,6 @@ def differential_evolution(
         optimization_key=optimization_key,
         max_parallel=max_parallel,
         devices_per_trial=devices_per_trial,
+        retry_policy=retry_policy,
     )
     return driver.run()

@@ -453,6 +453,118 @@ def test_swallowed_exception_must_not_flag_handled_or_narrow(tmp_path):
     assert findings == []
 
 
+# -- naked-retry-loop ---------------------------------------------------------
+
+
+def test_naked_retry_loop_flags_constant_sleep_retry(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import time
+
+        def fetch(url):
+            for attempt in range(5):
+                try:
+                    return read(url)
+                except OSError:
+                    time.sleep(1.0)
+
+        def drain(q):
+            while True:
+                try:
+                    q.pop()
+                except IndexError:
+                    time.sleep(0.5)
+        """,
+        rule="naked-retry-loop",
+    )
+    assert len(findings) == 2
+    assert all(f.rule == "naked-retry-loop" for f in findings)
+    assert "lockstep" in findings[0].message
+
+
+def test_naked_retry_loop_reports_innermost_loop_once(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import time
+
+        def nested():
+            while True:
+                for attempt in range(3):
+                    try:
+                        return go()
+                    except OSError:
+                        time.sleep(2)
+        """,
+        rule="naked-retry-loop",
+    )
+    assert len(findings) == 1  # the inner loop only
+
+
+def test_naked_retry_loop_must_not_flag_polls_or_computed_backoff(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import time
+
+        def poll_until_done(job):
+            # No exception handling: a watch loop, not a retry loop.
+            while not job.done():
+                time.sleep(0.1)
+
+        def retry_with_backoff(fn, policy):
+            for attempt in range(5):
+                try:
+                    return fn()
+                except OSError:
+                    time.sleep(policy.delay(attempt))  # computed: fine
+
+        def one_shot_retry(fn):
+            # try/except + sleep but NOT in a loop.
+            try:
+                return fn()
+            except OSError:
+                time.sleep(1)
+                return fn()
+
+        def spawner(pool, jobs):
+            # The loop only DEFINES a helper that retries; the sleep
+            # runs per helper call, not per loop iteration.
+            while jobs:
+                def worker(job=jobs.pop()):
+                    try:
+                        return job()
+                    except OSError:
+                        time.sleep(1)
+                pool.submit(worker)
+        """,
+        rule="naked-retry-loop",
+    )
+    assert findings == []
+
+
+def test_naked_retry_loop_sanctions_resilience_module(tmp_path):
+    code = """
+    import time
+
+    def call(fn):
+        for attempt in range(3):
+            try:
+                return fn()
+            except OSError:
+                time.sleep(0.5)
+    """
+    (tmp_path / "hops_tpu" / "runtime").mkdir(parents=True)
+    flagged = lint_code(tmp_path, code, rule="naked-retry-loop",
+                        filename="other.py")
+    assert len(flagged) == 1
+    sanctioned = lint_code(
+        tmp_path, code, rule="naked-retry-loop",
+        filename="hops_tpu/runtime/resilience.py")
+    assert sanctioned == []
+
+
 # -- suppression --------------------------------------------------------------
 
 

@@ -61,6 +61,7 @@ def test_analysis_registered_in_drift_guard():
         "hops_tpu.analysis.rules.host_sync",
         "hops_tpu.analysis.rules.lock_discipline",
         "hops_tpu.analysis.rules.metric_consistency",
+        "hops_tpu.analysis.rules.naked_retry",
         "hops_tpu.analysis.rules.swallowed_exception",
     ):
         assert mod in names
@@ -73,6 +74,16 @@ def test_loader_registered_in_drift_guard():
     move or rename surfaces as one named failure instead of a silent
     drop from the parametrized sweep."""
     assert "hops_tpu.featurestore.loader" in _module_names()
+
+
+def test_resilience_registered_in_drift_guard():
+    """The resilience layer and fault-injection registry are compiled
+    into every hot path (checkpoint save/restore, loader production,
+    serving handlers, trial execution): if either stops importing, the
+    whole chaos-test surface silently disappears — pin them by name."""
+    names = _module_names()
+    assert "hops_tpu.runtime.resilience" in names
+    assert "hops_tpu.runtime.faultinject" in names
 
 
 @pytest.mark.parametrize("name", _module_names())

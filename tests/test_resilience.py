@@ -1,0 +1,536 @@
+"""Resilience layer: retry policies, breakers, fault injection,
+checkpoint integrity, and the preemption-guard satellites.
+
+Every behavior here is proven by injecting the fault it defends
+against — the e2e chaos scenarios live in tests/test_chaos.py; this
+file pins the unit contracts."""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from hops_tpu.runtime import faultinject
+from hops_tpu.runtime.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    DeadlineExceeded,
+    RetryPolicy,
+    with_deadline,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends with fault injection disarmed."""
+    faultinject.disarm()
+    yield
+    faultinject.disarm()
+
+
+import contextlib
+import logging as _logging
+
+
+@contextlib.contextmanager
+def _capture_logs(logger_name: str):
+    """Collect messages from a hops_tpu logger (they don't propagate to
+    the root logger, so pytest's caplog never sees them)."""
+    records: list[str] = []
+    handler = _logging.Handler()
+    handler.emit = lambda r: records.append(r.getMessage())
+    logger = _logging.getLogger(logger_name)
+    logger.addHandler(handler)
+    try:
+        yield records
+    finally:
+        logger.removeHandler(handler)
+
+
+# -- RetryPolicy --------------------------------------------------------------
+
+
+class TestRetryPolicy:
+    def test_retries_transient_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=5, base_delay_s=0.001, seed=0)
+        assert policy.call(flaky, op="t") == "ok"
+        assert len(calls) == 3
+
+    def test_exhausted_budget_reraises_last_error(self):
+        policy = RetryPolicy(max_attempts=3, base_delay_s=0.001, seed=0)
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise ValueError("permanent")
+
+        with pytest.raises(ValueError, match="permanent"):
+            policy.call(always, op="t")
+        assert len(calls) == 3
+
+    def test_non_retryable_raises_immediately(self):
+        policy = RetryPolicy(max_attempts=5, base_delay_s=0.001,
+                             retry_on=(OSError,))
+        calls = []
+
+        def wrong_type():
+            calls.append(1)
+            raise KeyError("not retryable")
+
+        with pytest.raises(KeyError):
+            policy.call(wrong_type, op="t")
+        assert len(calls) == 1
+
+    def test_no_retry_on_carveout_beats_retry_on(self):
+        from hops_tpu.telemetry.metrics import REGISTRY
+
+        class Stop(RuntimeError):
+            pass
+
+        policy = RetryPolicy(max_attempts=5, base_delay_s=0.001,
+                             retry_on=(Exception,), no_retry_on=(Stop,))
+        calls = []
+
+        def stopper():
+            calls.append(1)
+            raise Stop()
+
+        giveups = REGISTRY.counter(
+            "hops_tpu_resilience_giveups_total", labels=("op",))
+        before = giveups.value(op="carveout")
+        with pytest.raises(Stop):
+            policy.call(stopper, op="carveout")
+        assert len(calls) == 1
+        # A non-retryable exception is control flow, not a retry
+        # giveup: the alerting counter must not move.
+        assert giveups.value(op="carveout") == before
+
+    def test_backoff_grows_and_caps(self):
+        policy = RetryPolicy(base_delay_s=0.1, multiplier=2.0,
+                             max_delay_s=0.3, jitter=False)
+        assert policy.delay(0) == pytest.approx(0.1)
+        assert policy.delay(1) == pytest.approx(0.2)
+        assert policy.delay(5) == pytest.approx(0.3)  # capped
+
+    def test_full_jitter_draws_within_cap_and_is_seeded(self):
+        import random
+
+        policy = RetryPolicy(base_delay_s=0.1, multiplier=2.0, seed=7)
+        rng1, rng2 = random.Random(7), random.Random(7)
+        draws1 = [policy.delay(k, rng1) for k in range(4)]
+        draws2 = [policy.delay(k, rng2) for k in range(4)]
+        assert draws1 == draws2  # deterministic under one seed
+        for k, d in enumerate(draws1):
+            assert 0.0 <= d <= 0.1 * 2.0 ** k
+
+    def test_attempt_timeout_retries_a_hung_call(self):
+        calls = []
+
+        def hangs_once():
+            calls.append(1)
+            if len(calls) == 1:
+                time.sleep(5.0)
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=2, base_delay_s=0.001,
+                             attempt_timeout_s=0.1)
+        assert policy.call(hangs_once, op="t") == "ok"
+        assert len(calls) == 2
+
+    def test_total_timeout_stops_retrying(self):
+        policy = RetryPolicy(max_attempts=100, base_delay_s=0.2,
+                             jitter=False, total_timeout_s=0.1)
+        calls = []
+
+        def always():
+            calls.append(1)
+            raise OSError("x")
+
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            policy.call(always, op="t")
+        assert time.monotonic() - t0 < 1.0
+        assert len(calls) < 5  # nowhere near the 100-attempt budget
+
+
+class TestDeadline:
+    def test_passthrough_and_overrun(self):
+        assert with_deadline(lambda: 41 + 1, 1.0, op="t") == 42
+        with pytest.raises(DeadlineExceeded):
+            with_deadline(time.sleep, 0.05, 1.0, op="t")
+
+    def test_inner_exception_propagates(self):
+        def boom():
+            raise KeyError("inner")
+
+        with pytest.raises(KeyError):
+            with_deadline(boom, 1.0, op="t")
+
+
+# -- CircuitBreaker -----------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_opens_after_consecutive_failures_only(self):
+        b = CircuitBreaker("t1", failure_threshold=3, reset_timeout_s=60)
+        b.record_failure()
+        b.record_failure()
+        b.record_success()  # resets the consecutive count
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"
+        b.record_failure()
+        assert b.state == "open"
+        assert not b.allow()
+        assert b.retry_after_s() > 0
+
+    def test_half_open_probe_heals_or_reopens(self):
+        clock = [0.0]
+        b = CircuitBreaker("t2", failure_threshold=1, reset_timeout_s=10,
+                           clock=lambda: clock[0])
+        b.record_failure()
+        assert b.state == "open" and not b.allow()
+        clock[0] = 11.0
+        assert b.state == "half_open"
+        assert b.allow()          # the single probe
+        assert not b.allow()      # half_open_max=1: no second probe
+        b.record_failure()        # probe failed
+        assert b.state == "open"
+        clock[0] = 22.0
+        assert b.allow()
+        b.record_success()
+        assert b.state == "closed" and b.allow()
+
+    def test_guard_context_manager(self):
+        b = CircuitBreaker("t3", failure_threshold=1, reset_timeout_s=60)
+        with pytest.raises(ValueError):
+            with b.guard():
+                raise ValueError("boom")
+        assert b.state == "open"
+        with pytest.raises(CircuitOpenError) as e:
+            with b.guard():
+                pass
+        assert e.value.retry_after_s > 0
+
+
+# -- faultinject --------------------------------------------------------------
+
+
+class TestFaultInject:
+    def test_parse_full_grammar(self):
+        plan = faultinject.FaultPlan.parse(
+            "loader.read=error:OSError@times=2,after=1;"
+            "serving.handle=latency:0.01@p=0.5,seed=3;"
+            "checkpoint.save=corrupt"
+        )
+        spec = plan._by_point["loader.read"][0]
+        assert spec.arg is OSError and spec.times == 2 and spec.after == 1
+        assert plan._by_point["serving.handle"][0].probability == 0.5
+        assert plan._by_point["checkpoint.save"][0].mode == "corrupt"
+
+    @pytest.mark.parametrize("bad", [
+        "nonsense",                      # no '='
+        "not.a.point=error",             # unknown point
+        "loader.read=explode",           # unknown mode
+        "loader.read=error:NotAnExc",    # not a builtin exception
+        "loader.read=error@zap=1",       # unknown option
+        "loader.read=latency:abc",       # non-numeric latency
+        "",                              # empty plan
+    ])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(faultinject.FaultPlanError):
+            faultinject.FaultPlan.parse(bad)
+
+    def test_schedule_after_times(self):
+        faultinject.arm("loader.read=error:OSError@times=2,after=1")
+        faultinject.fire("loader.read")  # passage 0: skipped (after=1)
+        for _ in range(2):               # passages 1, 2: fire
+            with pytest.raises(OSError):
+                faultinject.fire("loader.read")
+        faultinject.fire("loader.read")  # times=2 exhausted
+        faultinject.disarm()
+        faultinject.fire("loader.read")  # disarmed: silent
+
+    def test_probability_is_deterministic_per_seed(self):
+        def firings(seed: int) -> list[bool]:
+            plan = faultinject.FaultPlan.parse(
+                f"pubsub.publish=corrupt@p=0.5,seed={seed}")
+            faultinject.arm(plan)
+            out = [faultinject.fire("pubsub.publish") for _ in range(32)]
+            faultinject.disarm()
+            return out
+
+        a, b = firings(1), firings(1)
+        assert a == b              # replayable
+        assert any(a) and not all(a)  # actually probabilistic
+        assert firings(2) != a     # seed-driven
+
+    def test_latency_mode_sleeps(self):
+        faultinject.arm("serving.handle=latency:0.05@times=1")
+        t0 = time.monotonic()
+        assert faultinject.fire("serving.handle") is False
+        assert time.monotonic() - t0 >= 0.05
+
+    def test_fire_data_corrupts_payload(self):
+        faultinject.arm("pubsub.publish=corrupt@times=1")
+        out = faultinject.fire_data("pubsub.publish", b"hello world")
+        assert out != b"hello world" and len(out) < len(b"hello world")
+        assert faultinject.fire_data("pubsub.publish", b"x") == b"x"
+
+    def test_arm_from_env(self, monkeypatch):
+        monkeypatch.setenv(faultinject.ENV_VAR,
+                           "search.trial=error:RuntimeError@times=1")
+        plan = faultinject.arm_from_env()
+        assert plan is not None and faultinject.armed()
+        with pytest.raises(RuntimeError, match="faultinject"):
+            faultinject.fire("search.trial")
+        monkeypatch.delenv(faultinject.ENV_VAR)
+        faultinject.disarm()
+        assert faultinject.arm_from_env() is None
+
+    def test_disarmed_fire_is_cheap(self):
+        """The zero-overhead contract (bench.py --fault-overhead is the
+        measured version): a disarmed fire must stay within an order of
+        magnitude of a no-op call — catches anyone adding work before
+        the `is None` arm check."""
+        from bench import run_fault_overhead_bench
+
+        result = run_fault_overhead_bench(calls=200_000)
+        # Generous bound (CI boxes run loaded): the real figure is
+        # ~100 ns; the hot paths it sits on are 10^4-10^6x that.
+        assert result["ns_per_disarmed_fire"] < 5000
+
+    def test_corrupt_directory_damages_largest_file(self, tmp_path):
+        (tmp_path / "small.txt").write_bytes(b"ab")
+        (tmp_path / "big.bin").write_bytes(b"x" * 1000)
+        victim = faultinject.corrupt_directory(tmp_path)
+        assert victim == tmp_path / "big.bin"
+        assert (tmp_path / "big.bin").stat().st_size == 500
+        assert (tmp_path / "small.txt").read_bytes() == b"ab"
+
+
+# -- checkpoint integrity -----------------------------------------------------
+
+
+def _np_state(n: int = 0):
+    return {"w": np.arange(8.0) + n, "n": np.asarray(n)}
+
+
+class TestCheckpointIntegrity:
+    def test_manifest_written_per_step_sync_and_async(self, tmp_path):
+        from hops_tpu.runtime.checkpoint import CheckpointManager
+
+        with CheckpointManager(tmp_path / "s", async_save=False) as m:
+            m.save(0, _np_state())
+            assert (tmp_path / "s" / "manifest_0.json").exists()
+        with CheckpointManager(tmp_path / "a", async_save=True) as m:
+            m.save(0, _np_state())
+            m.save(1, _np_state(1))
+            m.wait()
+        for s in (0, 1):
+            manifest = json.loads(
+                (tmp_path / "a" / f"manifest_{s}.json").read_text())
+            assert manifest["step"] == s and manifest["files"]
+
+    def test_corrupt_latest_quarantined_and_fallback(self, tmp_path):
+        from hops_tpu.runtime.checkpoint import CheckpointManager
+
+        d = tmp_path / "ck"
+        with CheckpointManager(d, async_save=False) as m:
+            for s in range(3):
+                m.save(s, _np_state(s))
+        faultinject.corrupt_directory(d / "2")
+        with CheckpointManager(d, async_save=False) as m:
+            restored = m.restore(_np_state())
+            assert int(restored["n"]) == 1  # newest VALID step
+            assert m.latest_step() == 1
+        assert (d / "corrupt_2.quarantined").is_dir()
+        assert not (d / "2").exists()
+        from hops_tpu.telemetry.metrics import REGISTRY
+
+        assert REGISTRY.counter(
+            "hops_tpu_checkpoint_quarantined_total").value() >= 1
+
+    def test_explicit_corrupt_step_raises_without_rename(self, tmp_path):
+        from hops_tpu.runtime.checkpoint import (
+            CheckpointCorruptError,
+            CheckpointManager,
+        )
+
+        d = tmp_path / "ck"
+        with CheckpointManager(d, async_save=False) as m:
+            m.save(0, _np_state())
+        faultinject.corrupt_directory(d / "0")
+        with CheckpointManager(d, async_save=False) as m:
+            with pytest.raises(CheckpointCorruptError):
+                m.restore(_np_state(), step=0)
+        assert (d / "0").is_dir()  # explicit ask: preserved in place
+
+    def test_restore_or_init_survives_corrupt_latest(self, tmp_path):
+        from hops_tpu.runtime.checkpoint import (
+            CheckpointManager,
+            restore_or_init,
+        )
+
+        d = tmp_path / "ck"
+        with CheckpointManager(d, async_save=False) as m:
+            m.save(0, _np_state(0))
+            m.save(1, _np_state(1))
+        faultinject.corrupt_directory(d / "1")
+        state, start = restore_or_init(_np_state(), d)
+        assert int(state["n"]) == 0 and start == 1
+
+    def test_restore_or_init_all_corrupt_is_fresh_start(self, tmp_path):
+        from hops_tpu.runtime.checkpoint import (
+            CheckpointManager,
+            restore_or_init,
+        )
+
+        d = tmp_path / "ck"
+        with CheckpointManager(d, async_save=False) as m:
+            m.save(0, _np_state(5))
+        faultinject.corrupt_directory(d / "0")
+        state, start = restore_or_init(_np_state(), d)
+        assert start == 0 and int(state["n"]) == 0
+
+    def test_manifests_gced_with_pruned_steps(self, tmp_path):
+        from hops_tpu.runtime.checkpoint import CheckpointManager
+
+        d = tmp_path / "ck"
+        with CheckpointManager(d, max_to_keep=2, async_save=False) as m:
+            for s in range(4):
+                m.save(s, _np_state(s))
+        names = {p.name for p in d.glob("manifest_*.json")}
+        assert names == {"manifest_2.json", "manifest_3.json"}
+
+    def test_legacy_step_without_manifest_still_restores(self, tmp_path):
+        from hops_tpu.runtime.checkpoint import CheckpointManager
+
+        d = tmp_path / "ck"
+        with CheckpointManager(d, async_save=False) as m:
+            m.save(0, _np_state(3))
+        (d / "manifest_0.json").unlink()  # pre-manifest checkpoint
+        with CheckpointManager(d, async_save=False) as m:
+            assert int(m.restore(_np_state())["n"]) == 3
+
+    def test_corrupt_data_state_sidecar_warns_not_crashes(self, tmp_path):
+        from hops_tpu.runtime import checkpoint
+
+        d = tmp_path / "ck"
+        d.mkdir()
+        (d / "data_state_5.json").write_text("{not json")
+        with _capture_logs("hops_tpu.runtime.checkpoint") as records:
+            assert checkpoint.load_data_state(d, 5) is None
+        assert any("data_state_5.json" in r for r in records)
+        # Missing sidecar: silent None (the normal pre-loader case).
+        with _capture_logs("hops_tpu.runtime.checkpoint") as records:
+            assert checkpoint.load_data_state(d, 6) is None
+        assert not records
+
+    def test_sidecar_gc_survives_unremovable_file(self, tmp_path, monkeypatch):
+        """Satellite: a permission error mid-GC must not raise out of
+        the save path."""
+        from hops_tpu.runtime.checkpoint import CheckpointManager
+
+        d = tmp_path / "ck"
+        with CheckpointManager(d, max_to_keep=1, async_save=False) as m:
+            m.save(0, _np_state())
+            m.save_data_state(0, {"pos": 0})
+            m.save(1, _np_state(1))
+            # Step 0's sidecar is now stale; make it unremovable.
+            import pathlib
+
+            real_unlink = pathlib.Path.unlink
+
+            def deny(self, *a, **k):
+                if self.name.startswith("data_state_"):
+                    raise PermissionError(f"denied: {self}")
+                return real_unlink(self, *a, **k)
+
+            monkeypatch.setattr(pathlib.Path, "unlink", deny)
+            with _capture_logs("hops_tpu.runtime.checkpoint") as records:
+                m.save_data_state(1, {"pos": 1})  # must not raise
+            assert any("sidecar GC" in r for r in records)
+
+
+# -- PreemptionGuard satellites -----------------------------------------------
+
+
+class TestPreemptionGuardSatellites:
+    def test_multiple_signals_installed_and_chained(self):
+        from hops_tpu.runtime.preemption import PreemptionGuard
+
+        seen = []
+        prev_term = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+        prev_int = signal.signal(signal.SIGINT, lambda s, f: seen.append(s))
+        try:
+            with PreemptionGuard(
+                signals=(signal.SIGTERM, signal.SIGINT)
+            ) as guard:
+                os.kill(os.getpid(), signal.SIGINT)
+                time.sleep(0.05)
+                assert guard.should_stop()
+                os.kill(os.getpid(), signal.SIGTERM)
+                time.sleep(0.05)
+                # BOTH prior handlers were chained to, in order.
+                assert seen == [signal.SIGINT, signal.SIGTERM]
+            # Uninstall restored both previous handlers.
+            os.kill(os.getpid(), signal.SIGINT)
+            time.sleep(0.05)
+            os.kill(os.getpid(), signal.SIGTERM)
+            time.sleep(0.05)
+            assert seen == [signal.SIGINT, signal.SIGTERM] * 2
+        finally:
+            signal.signal(signal.SIGTERM, prev_term)
+            signal.signal(signal.SIGINT, prev_int)
+
+    def test_sync_every_defers_to_common_boundary(self, monkeypatch):
+        """Single-process stand-in for the decimation contract: with
+        sync_every=k only every k-th poll consults the collective; the
+        polls in between return False even with the local flag set."""
+        from hops_tpu.runtime import preemption
+        from hops_tpu.runtime.preemption import PreemptionGuard
+
+        guard = PreemptionGuard(install=False)
+        guard.notice()
+        # Pretend to be multihost so the sync path actually runs, and
+        # replace the allgather with a local echo.
+        import jax
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+        class _FakeMHU:
+            @staticmethod
+            def process_allgather(x):
+                return np.asarray(x)
+
+        monkeypatch.setattr(
+            "jax.experimental.multihost_utils.process_allgather",
+            _FakeMHU.process_allgather,
+        )
+        polls = [guard.should_stop(sync=True, sync_every=4)
+                 for _ in range(8)]
+        # Polls 0 and 4 hit the collective (poll counter boundaries);
+        # 1-3 and 5-7 defer regardless of the pending local flag.
+        assert polls == [True, False, False, False, True, False, False, False]
+
+    def test_sync_every_validates(self):
+        from hops_tpu.runtime.preemption import PreemptionGuard
+
+        guard = PreemptionGuard(install=False)
+        with pytest.raises(ValueError):
+            guard.should_stop(sync=True, sync_every=0)
