@@ -30,7 +30,7 @@ BASELINE_FILE = Path(__file__).parent / "BASELINE_SELF.json"
 HW_LOG = Path(__file__).parent / "HW_MEASURE.jsonl"
 
 
-def emit_stale_or_fail(metric: str, reason: str) -> "None":
+def emit_stale_or_fail(metric: str, reason: str, kind: str = "relay_error") -> "None":
     """Round-artifact fallback: re-emit the last green logged result.
 
     Two consecutive round artifacts went red (rc=1) because the relay
@@ -40,10 +40,17 @@ def emit_stale_or_fail(metric: str, reason: str) -> "None":
     that last green result flagged ``"stale": true`` with its artifact
     coordinates, so the artifact carries information instead of only
     rc=1. Exits 0 on success, 1 only if no green result exists at all.
+
+    ``kind`` labels WHY the reading is stale — ``probe_timeout`` (the
+    health probe hung; BENCH_r04/r05's failure mode), ``relay_error``
+    (the probe answered with an error), or ``relay_busy`` (lock held by
+    a sweep) — so consumers can tell a wedged relay from a contended
+    one instead of multichip readings silently going stale.
     """
     step_for = {
         "resnet50_samples_per_sec_per_chip": ("resnet50_bench",),
         "lm_tokens_per_sec_per_chip": ("lm_bench",),
+        "lm_serving_tokens_per_sec_per_chip": ("lm_serving_bench",),
     }
     wanted = step_for.get(metric, (metric,))
     best = None
@@ -73,6 +80,7 @@ def emit_stale_or_fail(metric: str, reason: str) -> "None":
     parsed.update(
         stale=True,
         stale_reason=reason,
+        stale_kind=kind,
         stale_artifact=f"HW_MEASURE.jsonl step={entry['step']} ts={entry['ts']}",
     )
     print(json.dumps(parsed))
@@ -540,6 +548,245 @@ def run_fault_overhead_bench(calls: int = 1_000_000) -> dict:
     }
 
 
+def _lm_serving_workload(requests: int, seed: int, rate_rps: float, *,
+                         short, long, long_frac, budget):
+    """Seeded Poisson arrival process with a mixed prompt-length
+    distribution: the open-loop load model serving actually sees
+    (bursts + a heavy tail of long prompts), not a closed batch."""
+    rs = np.random.RandomState(seed)
+    arrivals = np.cumsum(rs.exponential(1.0 / rate_rps, requests))
+    prompts, budgets = [], []
+    for _ in range(requests):
+        lo, hi = long if rs.rand() < long_frac else short
+        prompts.append(rs.randint(0, 256, rs.randint(lo, hi + 1)).astype(np.int32))
+        budgets.append(int(rs.randint(budget[0], budget[1] + 1)))
+    return arrivals, prompts, budgets
+
+
+def _drive_lm_serving(engine, arrivals, prompts, budgets) -> dict:
+    """Open-loop driver: submit each request at its arrival time (wall
+    clock), step the engine whenever it has work, and collect per-ticket
+    TTFT + tokens. Late arrivals queue — exactly the backpressure the
+    paged/chunked scheduler is supposed to absorb."""
+    n = len(prompts)
+    stats0 = engine.stats()
+    t0 = time.perf_counter()
+    done: dict[int, list[int]] = {}
+    order: list[int] = []
+    i = 0
+    while len(done) < n:
+        now = time.perf_counter() - t0
+        while i < n and arrivals[i] <= now:
+            order.append(engine.submit(prompts[i], max_new_tokens=budgets[i]))
+            i += 1
+        if engine.has_work:
+            for t in engine.step():
+                done[t] = engine.result(t)
+        elif i < n:
+            time.sleep(min(0.002, max(0.0, arrivals[i] - now)))
+    wall = time.perf_counter() - t0
+    stats1 = engine.stats()
+    ttfts = np.asarray([engine.ttft_s[t] for t in order])
+    tokens = sum(len(v) for v in done.values())
+    d_disp = stats1["dispatches"] - stats0["dispatches"]
+    occ = (
+        stats1["mean_occupancy"] * stats1["dispatches"]
+        - stats0["mean_occupancy"] * stats0["dispatches"]
+    ) / max(d_disp, 1)
+    out = {
+        "wall_s": wall,
+        "tokens": tokens,
+        "tokens_per_sec": tokens / wall,
+        "ttft_p50_ms": float(np.percentile(ttfts, 50) * 1e3),
+        "ttft_p99_ms": float(np.percentile(ttfts, 99) * 1e3),
+        "slot_occupancy": round(occ, 4),
+    }
+    if stats1.get("cache_layout") == "paged":
+        out.update(
+            block_pool_peak_util=round(
+                stats1["blocks_peak_used"] / stats1["blocks_total"], 4
+            ),
+            prefill_chunks=stats1["prefill_chunks"] - stats0["prefill_chunks"],
+            preempted_prefills=stats1["preemptions"] - stats0["preemptions"],
+        )
+    return out
+
+
+def run_lm_serving_bench(
+    requests: int = 40,
+    seed: int = 0,
+    rate_rps: float | None = None,
+    smoke: bool = False,
+    tp: bool = False,
+) -> dict:
+    """The ``--lm-serving`` tier: the continuous-batching LM engine
+    under seeded Poisson load — paged KV cache + chunked prefill vs the
+    dense full-prefill baseline AT EQUAL CACHE MEMORY.
+
+    Both engines get the same token budget of persistent KV memory;
+    the dense layout spends it on ``budget / max_decode_len`` max-length
+    slot reservations, while the paged layout spends it on a block pool
+    shared by 2x the slots (slot count bounded by LIVE tokens). Under
+    the same arrival process the paged engine keeps more requests
+    decoding concurrently and never freezes the batch behind a long
+    prompt's prefill — which is what tokens/s and TTFT p99 measure.
+    Token streams are bit-identical between the two (the equivalence
+    tests pin this), so the comparison is pure scheduling/memory.
+
+    ``tp=True`` runs both engines tensor-parallel over every visible
+    device (``parallel/tp_inference`` Megatron sharding, paged pools
+    head-sharded) — the multichip variant; tokens/s/chip divides by the
+    mesh size.
+    """
+    import jax  # noqa: F811 — resolved at call time under forced-cpu smoke
+    import jax.numpy as jnp
+
+    from hops_tpu.models.transformer import TransformerLM
+    from hops_tpu.modelrepo.lm_engine import LMEngine
+
+    if smoke:
+        cap, d_model, layers = 96, 32, 2
+        page, chunk = 8, 16
+        short, long_, long_frac, budget = (4, 12), (32, 64), 0.3, (4, 8)
+        requests = min(requests, 10)
+        dense_slots = 2
+        rate = rate_rps or 6.0
+    else:
+        cap, d_model, layers = 192, 64, 2
+        page, chunk = 16, 32
+        short, long_, long_frac, budget = (8, 24), (96, 160), 0.3, (8, 24)
+        dense_slots = 4
+        # CPU-tier tuned load point: deep enough queueing that the
+        # dense engine's 4 slots saturate and its multi-request
+        # admission waves pad to the 192 bucket (monolithic prefill
+        # stalling decode), while the paged engine's 2x slots + fused
+        # prefill chunks keep absorbing arrivals — measured 3-4x
+        # tokens/s and ~40x lower TTFT p99 across reps on the CPU
+        # tier. TPU runs should pass --lm-serving-rate sized to the
+        # chip.
+        rate = rate_rps or 40.0
+    mesh = None
+    n_chips = 1
+    if tp:
+        from jax.sharding import Mesh
+
+        devs = np.array(jax.devices())
+        if devs.size > 1:
+            mesh = Mesh(devs, ("model",))
+            n_chips = devs.size
+    budget_tokens = dense_slots * cap
+    paged_slots = dense_slots * 2
+    pool_blocks = 1 + budget_tokens // page
+
+    model = TransformerLM(
+        vocab_size=256, d_model=d_model, num_heads=4, num_layers=layers,
+        dtype=jnp.float32, attention_impl="reference", max_decode_len=cap,
+        ragged_decode=True,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )["params"]
+    _note(
+        f"lm-serving bench: budget {budget_tokens} KV tokens -> dense "
+        f"{dense_slots} slots vs paged {paged_slots} slots "
+        f"({pool_blocks} blocks of {page}), {requests} req @ {rate}/s"
+    )
+
+    results = {}
+    for layout in ("dense", "paged"):
+        if layout == "dense":
+            engine = LMEngine(
+                model, params, slots=dense_slots,
+                prefill_buckets=(max(32, chunk), cap), mesh=mesh,
+            )
+        else:
+            engine = LMEngine(
+                model, params, slots=paged_slots, kv_page_size=page,
+                kv_pool_blocks=pool_blocks, prefill_chunk=chunk, mesh=mesh,
+            )
+        # Warm the compiles OUTSIDE the timed window: one short and one
+        # long request touch every program shape the workload uses.
+        rs = np.random.RandomState(999)
+        engine.submit(rs.randint(0, 256, short[1]), max_new_tokens=2)
+        engine.submit(rs.randint(0, 256, long_[1]), max_new_tokens=2)
+        engine.run()
+        _note(f"{layout}: warm, driving Poisson load")
+        arrivals, prompts, budgets = _lm_serving_workload(
+            requests, seed, rate, short=short, long=long_,
+            long_frac=long_frac, budget=budget,
+        )
+        results[layout] = _drive_lm_serving(engine, arrivals, prompts, budgets)
+        _note(
+            f"{layout}: {results[layout]['tokens_per_sec']:.1f} tok/s, "
+            f"ttft p99 {results[layout]['ttft_p99_ms']:.0f} ms"
+        )
+    paged, dense = results["paged"], results["dense"]
+    return {
+        "tokens_per_sec_per_chip": paged["tokens_per_sec"] / n_chips,
+        "ttft_p50_ms": round(paged["ttft_p50_ms"], 1),
+        "ttft_p99_ms": round(paged["ttft_p99_ms"], 1),
+        "slot_occupancy": paged["slot_occupancy"],
+        "block_pool_peak_util": paged["block_pool_peak_util"],
+        "prefill_chunks": paged["prefill_chunks"],
+        "preempted_prefills": paged["preempted_prefills"],
+        "dense_tokens_per_sec_per_chip": round(
+            dense["tokens_per_sec"] / n_chips, 2
+        ),
+        "dense_ttft_p99_ms": round(dense["ttft_p99_ms"], 1),
+        "speedup_vs_dense": round(
+            paged["tokens_per_sec"] / dense["tokens_per_sec"], 3
+        ),
+        "requests": requests,
+        "rate_rps": rate,
+        "n_chips": n_chips,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+class _ProbeTimeout(RuntimeError):
+    """The health probe hung past its budget (relay likely wedged)."""
+
+
+class _ProbeError(RuntimeError):
+    """The health probe answered, but with an error."""
+
+
+def probe_with_retry() -> tuple[dict | None, str, str]:
+    """The BENCH_r04/r05 wedge fix: the pre-run health probe under a
+    bounded ``RetryPolicy`` with per-attempt ``with_deadline`` instead
+    of one open-ended 240 s wait. Returns ``(health, kind, error)`` —
+    ``health`` non-None means reachable; otherwise ``kind`` is
+    ``probe_timeout`` (hang — the wedge signature) or ``relay_error``
+    (probe answered with an error), which flows into the stale line's
+    ``stale_kind`` so consumers can tell the two apart."""
+    from hops_tpu.runtime.resilience import DeadlineExceeded, RetryPolicy, with_deadline
+
+    def attempt() -> dict:
+        # with_deadline backstops probe_tpu's own subprocess wait: even
+        # a hang in process spawning must not blow the attempt budget.
+        # (probe_tpu's timeout rides positionally — with_deadline's own
+        # second parameter is also named timeout_s.)
+        health = with_deadline(probe_tpu, 150.0, 120, op="bench.probe")
+        if health.get("ok"):
+            return health
+        err = str(health.get("error", "unknown"))
+        if "hung" in err:
+            raise _ProbeTimeout(err)
+        raise _ProbeError(err)
+
+    policy = RetryPolicy(
+        max_attempts=2, base_delay_s=15.0, jitter=False,
+        total_timeout_s=360.0,
+        retry_on=(_ProbeTimeout, _ProbeError, DeadlineExceeded),
+    )
+    try:
+        return policy.call(attempt, op="bench.probe"), "", ""
+    except (DeadlineExceeded, _ProbeTimeout) as e:
+        return None, "probe_timeout", str(e)
+    except Exception as e:  # noqa: BLE001 — classified for the stale line
+        return None, "relay_error", str(e)
+
+
 def probe_tpu(timeout_s: int = 120) -> dict:
     """Cheaply answer "is the TPU reachable?" without risking a wedge.
 
@@ -652,6 +899,29 @@ def main() -> None:
         "--seq-len", type=int, default=1024, help="--lm sequence length"
     )
     parser.add_argument(
+        "--lm-serving", action="store_true",
+        help="LM serving-engine tier: paged KV cache + chunked prefill "
+        "vs the dense full-prefill baseline at equal cache memory, "
+        "under a seeded Poisson arrival load; reports tokens/s/chip, "
+        "TTFT p50/p99, slot occupancy, block-pool utilization, and "
+        "preempted-prefill counts",
+    )
+    parser.add_argument(
+        "--lm-serving-requests", type=int, default=48,
+        help="--lm-serving: requests in the Poisson workload",
+    )
+    parser.add_argument(
+        "--lm-serving-rate", type=float, default=None,
+        help="--lm-serving: Poisson arrival rate (req/s; default "
+        "platform-tuned)",
+    )
+    parser.add_argument(
+        "--lm-serving-tp", action="store_true",
+        help="--lm-serving: run both engines tensor-parallel over all "
+        "visible devices (parallel/tp_inference; paged pools "
+        "head-sharded)",
+    )
+    parser.add_argument(
         "--lock-wait", type=float, default=900.0,
         help="seconds to wait for the relay lock before falling back to "
         "the last green logged result (stale-flagged)",
@@ -706,7 +976,27 @@ def main() -> None:
         print(json.dumps({"metric": "tpu_probe", **probe_tpu()}))
         return
 
-    if args.lm:
+    if args.lm_serving:
+        if args.multihost:
+            parser.error(
+                "--lm-serving --multihost is not supported: use "
+                "--lm-serving-tp for the tensor-parallel variant on one "
+                "host's devices"
+            )
+        metric, unit, value_key = (
+            "lm_serving_tokens_per_sec_per_chip", "tokens/s/chip",
+            "tokens_per_sec_per_chip",
+        )
+
+        def do_run(**overrides):
+            overrides.pop("multihost", None)
+            return run_lm_serving_bench(
+                requests=args.lm_serving_requests,
+                rate_rps=args.lm_serving_rate,
+                tp=args.lm_serving_tp,
+                **overrides,
+            )
+    elif args.lm:
         if args.multihost:
             parser.error(
                 "--lm --multihost is not supported yet: the multihost LM "
@@ -770,19 +1060,26 @@ def main() -> None:
                     # Fail over instead of hanging the driver: a wedged
                     # relay makes every backend call block forever, and
                     # killing the hung bench is what wedges the relay
-                    # further. A healthy relay answers in ~20 s; 240 s
-                    # means it is down — emit the last green result.
+                    # further. The probe runs under a bounded
+                    # RetryPolicy + per-attempt deadline (the BENCH_r04/
+                    # r05 fix: one open-ended 240 s wait wedged two
+                    # rounds), and its failure KIND travels on the
+                    # stale line.
                     _note("probing relay health before committing to the real run")
-                    health = probe_tpu(timeout_s=240)
-                    if not health.get("ok"):
-                        _note(f"relay unreachable: {health.get('error')}")
-                        emit_stale_or_fail(metric, f"relay unreachable: {health.get('error')}")
+                    health, kind, err = probe_with_retry()
+                    if health is None:
+                        _note(f"relay unreachable ({kind}): {err}")
+                        emit_stale_or_fail(
+                            metric, f"relay unreachable: {err}", kind=kind
+                        )
                     _note(f"relay healthy ({health.get('platform')}, {health.get('elapsed_s')}s)")
                 _enable_compile_cache()
                 result = do_run()
         except RelayBusy as e:
             _note(str(e))
-            emit_stale_or_fail(metric, f"relay lock busy: {e.owner}")
+            emit_stale_or_fail(
+                metric, f"relay lock busy: {e.owner}", kind="relay_busy"
+            )
     value = result[value_key]
     if args.multihost and jax.process_index() != 0:
         return  # one JSON line total: the chief's
@@ -792,7 +1089,9 @@ def main() -> None:
     # becomes that platform's baseline; later runs report against it.
     baseline = None
     if not args.smoke:
-        baseline_key = result["platform"] + ("_lm" if args.lm else "")
+        baseline_key = result["platform"] + (
+            "_lmserv" if args.lm_serving else ("_lm" if args.lm else "")
+        )
         recorded = json.loads(BASELINE_FILE.read_text()) if BASELINE_FILE.exists() else {}
         entry = recorded.get(baseline_key)
         if entry is not None:
@@ -831,6 +1130,21 @@ def main() -> None:
             model_tflops_per_sec_per_chip=result["model_tflops_per_sec_per_chip"],
             n_params_m=result["n_params_m"],
             seq_len=result["seq_len"],
+        )
+    if args.lm_serving:
+        # The paged engine's headline plus the dense same-memory
+        # baseline it beat — the comparison IS the measurement.
+        line.update(
+            engine="paged",
+            ttft_p50_ms=result["ttft_p50_ms"],
+            ttft_p99_ms=result["ttft_p99_ms"],
+            slot_occupancy=result["slot_occupancy"],
+            block_pool_peak_util=result["block_pool_peak_util"],
+            prefill_chunks=result["prefill_chunks"],
+            preempted_prefills=result["preempted_prefills"],
+            dense_tokens_per_sec_per_chip=result["dense_tokens_per_sec_per_chip"],
+            dense_ttft_p99_ms=result["dense_ttft_p99_ms"],
+            speedup_vs_dense=result["speedup_vs_dense"],
         )
     print(json.dumps(line))
 

@@ -11,4 +11,5 @@ inference logging rides the pubsub layer.
 
 from hops_tpu.modelrepo import batch, registry, serving  # noqa: F401
 from hops_tpu.modelrepo.lm_engine import LMEngine  # noqa: F401
+from hops_tpu.modelrepo.paged import BlockPool, BlockPoolExhausted  # noqa: F401
 from hops_tpu.modelrepo.registry import Metric, export, get_best_model, get_model  # noqa: F401

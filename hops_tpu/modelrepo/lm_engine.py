@@ -47,15 +47,22 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from hops_tpu.models.generation import top_p_mask
+from hops_tpu.modelrepo.paged import BlockPool
+from hops_tpu.runtime import faultinject
+from hops_tpu.runtime.logging import get_logger
 from hops_tpu.telemetry.metrics import REGISTRY
 
+log = get_logger(__name__)
 
-def _map_cache(cache: Any, fn_kv, fn_idx, *rest: Any) -> Any:
-    """Apply ``fn_kv`` to k/v/scale leaves and ``fn_idx`` to the 'idx'
-    leaves of a transformer KV-cache pytree (the same layout contract
-    as generation._rewind). Extra trees in ``rest`` (same treedef) are
-    zipped leaf-for-leaf into the callbacks — the single definition of
-    "walk a cache by leaf role" in this module."""
+
+def _map_cache(cache: Any, fn_kv, fn_idx, *rest: Any, fn_pages=None) -> Any:
+    """Apply ``fn_kv`` to k/v/scale leaves, ``fn_idx`` to the 'idx'
+    leaves, and ``fn_pages`` (default: ``fn_kv``) to the 'pages' leaves
+    of a transformer KV-cache pytree (the same layout contract as
+    generation._rewind; 'pages' exists only on paged caches). Extra
+    trees in ``rest`` (same treedef) are zipped leaf-for-leaf into the
+    callbacks — the single definition of "walk a cache by leaf role"
+    in this module."""
     import jax.tree_util as jtu
 
     hits = 0
@@ -66,6 +73,8 @@ def _map_cache(cache: Any, fn_kv, fn_idx, *rest: Any) -> Any:
         if name == "idx":
             hits += 1
             return fn_idx(leaf, *others)
+        if name == "pages" and fn_pages is not None:
+            return fn_pages(leaf, *others)
         return fn_kv(leaf, *others)
 
     out = jtu.tree_map_with_path(fix, cache, *rest)
@@ -80,9 +89,14 @@ def _map_cache(cache: Any, fn_kv, fn_idx, *rest: Any) -> Any:
 def _clamp_idx(cache: Any, active: Any) -> Any:
     """Clamp inactive rows' cache index to 0 (the free-slot
     convention): a free row writes one position, attends one block,
-    and its output is discarded host-side."""
+    and its output is discarded host-side. On a PAGED cache the row's
+    page table is zeroed too, so that one write lands in the reserved
+    scratch block — a dead row pointing at its old pages would scribble
+    garbage into physical blocks that may already be shared or
+    reallocated."""
     return _map_cache(
-        cache, lambda leaf: leaf, lambda idx: jnp.where(active, idx, 0)
+        cache, lambda leaf: leaf, lambda idx: jnp.where(active, idx, 0),
+        fn_pages=lambda pg: jnp.where(active[:, None], pg, 0),
     )
 
 
@@ -160,12 +174,32 @@ class _Request:
     top_k: int = 0  # 0 = no top-k truncation
     top_p: float = 0.0  # 0 = no nucleus truncation
     seed: int = 0
-    # (target_cache, draft_cache_or_None, length) snapshot taken at
-    # submit time: re-registering the name later must not invalidate
-    # this request's capacity validation or swap its prefix mid-queue.
-    prefix: tuple[Any, Any | None, int] | None = None
+    # Snapshot taken at submit time: re-registering the name later must
+    # not invalidate this request's capacity validation or swap its
+    # prefix mid-queue. Dense engine: (target_cache,
+    # draft_cache_or_None, length); paged engine: a _PagedPrefix.
+    prefix: Any = None
     # monotonic submit time — the TTFT histogram's start mark.
     submitted_at: float = 0.0
+    # Preemption restarts a request from scratch (deterministic
+    # sampling makes the replayed stream identical); its TTFT was
+    # already observed the first time around.
+    ttft_observed: bool = False
+
+
+@dataclasses.dataclass
+class _PagedPrefix:
+    """A registered prefix on the PAGED engine: tokens at registration,
+    and — once the first request that names it finishes its prefill —
+    the physical blocks holding the prefix's COMPLETE pages, each
+    carrying one registry reference. Later admissions point their page
+    tables at these blocks (pool.ref per reader) and re-compute only
+    from the first incomplete block: page-table sharing with
+    copy-on-write at the divergence boundary."""
+
+    name: str
+    tokens: np.ndarray  # (L,) int32
+    blocks: list[int] | None = None  # full pages only: L // page blocks
 
 
 @dataclasses.dataclass
@@ -179,6 +213,15 @@ class _SlotState:
     top_p: float = 0.0
     seed: int = 0
     n_sampled: int = 1  # tokens drawn so far (prefill's counts as #0)
+    # --- paged-engine scheduling state (None/0 on the dense engine) ---
+    req: Any = None  # the _Request, for preemption requeue
+    pending: np.ndarray | None = None  # un-prefilled prompt tail
+    base_len: int = 0  # true tokens written so far (device idx mirror)
+    prompt_total: int = 0  # prefix + prompt length
+    worst_len: int = 0  # deepest position this request can ever write
+    blocks: list[int] | None = None  # physical blocks, logical order
+    shared_hit: bool = False  # admission reused prefix pages
+    seq: int = 0  # admission order — preemption picks the newest
 
 
 class LMEngine:
@@ -210,6 +253,25 @@ class LMEngine:
     configuration that matters when per-dispatch latency, not chip
     time, bounds serving throughput), and either or both run
     tensor-parallel under ``mesh``.
+
+    ``kv_page_size`` switches the MEMORY core to the paged layout:
+    per-layer caches become one shared block pool of
+    ``kv_pool_blocks`` pages plus per-slot page tables
+    (``transformer.paged_decode`` + ``ops.paged_decode_attention``), so
+    persistent HBM is bounded by LIVE tokens rather than
+    ``slots x max_decode_len`` — more concurrent slots at equal memory.
+    Blocks allocate on demand as decode advances and free on
+    completion; a dry pool queues admissions and, for live decode
+    growth, preempts the newest request (replayed deterministically).
+    Prefix-cache hits become page-table sharing with copy-on-write at
+    the first incomplete block. Prompts prefill in ``prefill_chunk``-
+    token chunks FUSED into the decode dispatch (chunked prefill), so
+    a long prompt's admission no longer freezes tokens-out for every
+    live slot. Token streams are bit-identical to the dense engine
+    (tests/test_lm_engine.py paged parity), and the paged layout
+    composes with speculation (draft pool pages ride the same table)
+    and with ``mesh`` (pools shard on their head axis,
+    ``tp_inference.tp_cache_specs``).
     """
 
     def __init__(
@@ -224,6 +286,9 @@ class LMEngine:
         draft_model: Any = None,
         draft_params: Any = None,
         spec_k: int = 4,
+        kv_page_size: int | None = None,
+        kv_pool_blocks: int | None = None,
+        prefill_chunk: int | None = None,
     ):
         if not getattr(model, "ragged_decode", False):
             raise ValueError(
@@ -231,6 +296,74 @@ class LMEngine:
                 "the (slots,) cache index is what lets rows advance "
                 "independently"
             )
+        # --- paged KV cache + chunked prefill (the serving memory core)
+        # ``kv_page_size`` switches the engine to the paged layout:
+        # per-layer caches become a shared block pool plus per-slot page
+        # tables (transformer.paged_decode), slot memory is bounded by
+        # LIVE tokens instead of slots x max_decode_len, prefix-cache
+        # hits become page-table sharing, and long prompts prefill in
+        # ``prefill_chunk``-token chunks fused into the same dispatch as
+        # the decode step (no admission freeze for live slots).
+        self._paged = kv_page_size is not None
+        if self._paged:
+            if kv_page_size < 1:
+                raise ValueError(f"kv_page_size must be >= 1, got {kv_page_size}")
+            if getattr(model, "kv_cache_dtype", None) is not None:
+                raise ValueError(
+                    "paged engine supports kv_cache_dtype=None only "
+                    "(int8 pools need paged scale tables)"
+                )
+            cap0 = model.max_decode_len
+            max_blocks = -(-cap0 // kv_page_size)
+            if kv_pool_blocks is None:
+                # Parity default: same token capacity as the dense
+                # reservation (+ the reserved scratch block). Shrink it
+                # to actually SAVE memory; the scheduler queues/preempts
+                # when it runs dry.
+                kv_pool_blocks = 1 + slots * max_blocks
+            if kv_pool_blocks < 2:
+                raise ValueError(
+                    f"kv_pool_blocks must be >= 2, got {kv_pool_blocks}"
+                )
+            self._page_size = int(kv_page_size)
+            self._max_blocks = max_blocks
+            self.prefill_chunk = int(prefill_chunk or min(64, cap0))
+            if not 1 <= self.prefill_chunk <= cap0:
+                raise ValueError(
+                    f"prefill_chunk must be in [1, {cap0}], got "
+                    f"{self.prefill_chunk}"
+                )
+            model = model.clone(
+                paged_decode=True, kv_page_size=self._page_size,
+                kv_pool_blocks=int(kv_pool_blocks),
+            )
+            if draft_model is not None:
+                if draft_model.max_decode_len != cap0:
+                    raise ValueError(
+                        "paged speculative engine needs "
+                        "draft.max_decode_len == model.max_decode_len "
+                        f"({draft_model.max_decode_len} != {cap0}) — the "
+                        "two pools share one page table"
+                    )
+                draft_model = draft_model.clone(
+                    paged_decode=True, kv_page_size=self._page_size,
+                    kv_pool_blocks=int(kv_pool_blocks),
+                )
+            self._pool = BlockPool(int(kv_pool_blocks))
+            self._pages_np = np.zeros((slots, max_blocks), np.int32)
+            self._pages_dirty = True
+            # True when some LIVE row rode a dispatch inert (its device
+            # idx scratch-clamped in-graph): the next decode dispatch
+            # must re-graft the host mirror.
+            self._idx_stale = False
+        elif prefill_chunk is not None:
+            raise ValueError(
+                "prefill_chunk requires the paged cache (kv_page_size=): "
+                "chunked prefill writes in place through page tables"
+            )
+        else:
+            self._pool = None
+            self.prefill_chunk = None
         self.model = model
         self.params = params
         self.slots = slots
@@ -331,10 +464,15 @@ class LMEngine:
                 dvariables["cache"], jnp.zeros_like, jnp.zeros_like
             )
         if mesh is not None:
-            # (slots, heads, ...) k/v/scale leaves shard on the head
-            # dim; the (slots,) index replicates.
-            cache_specs = _map_cache(
-                self._cache, lambda leaf: P(None, tp_axis), lambda idx: P()
+            # Dense: (slots, heads, ...) k/v/scale leaves shard on the
+            # head dim. Paged: (kv_heads, blocks, page, d) pools shard
+            # on their leading head dim; the replicated page table
+            # indexes the same logical blocks on every shard. One
+            # definition for both layouts: tp_inference.tp_cache_specs.
+            from hops_tpu.parallel.tp_inference import tp_cache_specs
+
+            cache_specs = tp_cache_specs(
+                self._cache, tp_axis, paged=self._paged
             )
             self._cache = jax.tree.map(
                 lambda leaf, spec: jax.device_put(
@@ -343,8 +481,8 @@ class LMEngine:
                 self._cache, cache_specs,
             )
             if self._draft_cache is not None:
-                draft_cache_specs = _map_cache(
-                    self._draft_cache, lambda leaf: P(None, tp_axis), lambda idx: P()
+                draft_cache_specs = tp_cache_specs(
+                    self._draft_cache, tp_axis, paged=self._paged
                 )
                 self._draft_cache = jax.tree.map(
                     lambda leaf, spec: jax.device_put(
@@ -362,6 +500,26 @@ class LMEngine:
                 body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
                 check_rep=False,
             )
+
+        # Rebuild templates for dispatch-failure recovery: a wave that
+        # raised AFTER donation consumed the old cache buffers, and the
+        # failed requests' state is discarded anyway — _fail_inflight
+        # re-materializes fresh all-free caches from these specs so the
+        # scheduler really does keep serving (not just for errors that
+        # fired before dispatch).
+        def cache_tmpl(cache):
+            return jax.tree.map(
+                lambda leaf: jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype, sharding=leaf.sharding
+                ),
+                cache,
+            )
+
+        self._cache_tmpl = cache_tmpl(self._cache)
+        self._draft_cache_tmpl = (
+            cache_tmpl(self._draft_cache)
+            if self._draft_cache is not None else None
+        )
 
         self._queue: collections.deque[_Request] = collections.deque()
         self._slot_state: list[_SlotState | None] = [None] * slots
@@ -1041,6 +1199,116 @@ class LMEngine:
             return run(params, dparams, t_cache, d_cache, tokens, live0,
                        rems, eos_ids, temps, topks, topps, seeds, ns)
 
+        # --- paged programs -------------------------------------------
+        # One fused dispatch serves BOTH roles every iteration: rows
+        # mid-prefill write their next prompt chunk, decode rows write
+        # their single next token (padded to the chunk width — pad
+        # writes land past idx or in the scratch block, unreachable
+        # either way), and each row's last-true logit yields its next
+        # token. This is chunked prefill: admitting a long prompt costs
+        # ceil(L/chunk) of these dispatches WITH decode riding along,
+        # instead of one monolithic prefill that freezes tokens-out for
+        # every live slot.
+        def paged_mixed(params, cache, tokens, base_lens, true_lens, temps,
+                        topks, topps, seeds, ns, *, sampled=False,
+                        nucleus=False):
+            def run(params, cache, tokens, base_lens, true_lens, temps,
+                    topks, topps, seeds, ns):
+                active = true_lens > 0
+                cache2 = _clamp_idx(_rewind_idx(cache, base_lens), active)
+                logits, variables = local_model.apply(
+                    {"params": params, "cache": cache2}, tokens,
+                    decode=True, mutable=["cache"],
+                )
+                last = jnp.take_along_axis(
+                    logits, jnp.maximum(true_lens - 1, 0)[:, None, None],
+                    axis=1,
+                )[:, 0]
+                if sampled:
+                    toks = _sample_rows(
+                        last, temps, topks, topps, seeds, ns,
+                        use_top_p=nucleus,
+                    )
+                else:
+                    toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                # Rewind every row to ITS true end — pad garbage past it
+                # stays masked forever (kernel invariant), exactly the
+                # dense batched-admission convention.
+                cache3 = _map_cache(
+                    variables["cache"], lambda leaf: leaf,
+                    lambda idx: jnp.asarray(base_lens + true_lens, idx.dtype),
+                )
+                return toks, cache3
+
+            run = sharded(
+                run, (param_specs, cache_specs) + (P(),) * 8,
+                (P(), cache_specs),
+            )
+            return run(params, cache, tokens, base_lens, true_lens, temps,
+                       topks, topps, seeds, ns)
+
+        # Speculative twin: the chunk appends into BOTH pools (the
+        # draft's pages ride alongside the target's — one page table,
+        # two pools) so target and draft enter the next speculative
+        # dispatch at the same position. Decode rows pass through inert
+        # (true_len 0: clamped to the scratch block, no emit) — their
+        # tokens come from the spec decode dispatch that follows.
+        def spec_paged_chunk(params, dparams, t_cache, d_cache, tokens,
+                             base_lens, true_lens, temps, topks, topps,
+                             seeds, ns, *, sampled=False, nucleus=False):
+            def run(params, dparams, t_cache, d_cache, tokens, base_lens,
+                    true_lens, temps, topks, topps, seeds, ns):
+                active = true_lens > 0
+                t2 = _clamp_idx(_rewind_idx(t_cache, base_lens), active)
+                d2 = _clamp_idx(_rewind_idx(d_cache, base_lens), active)
+                logits, t_vars = local_model.apply(
+                    {"params": params, "cache": t2}, tokens, decode=True,
+                    mutable=["cache"],
+                )
+                _, d_vars = local_draft.apply(
+                    {"params": dparams, "cache": d2}, tokens, decode=True,
+                    mutable=["cache"],
+                )
+                last = jnp.take_along_axis(
+                    logits, jnp.maximum(true_lens - 1, 0)[:, None, None],
+                    axis=1,
+                )[:, 0]
+                if sampled:
+                    toks = _sample_rows(
+                        last, temps, topks, topps, seeds, ns,
+                        use_top_p=nucleus,
+                    )
+                else:
+                    toks = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                end = base_lens + true_lens
+                t3 = _rewind_idx(t_vars["cache"], end)
+                d3 = _rewind_idx(d_vars["cache"], end)
+                return toks, t3, d3
+
+            run = sharded(
+                run,
+                (param_specs, draft_param_specs, cache_specs,
+                 draft_cache_specs) + (P(),) * 8,
+                (P(), cache_specs, draft_cache_specs),
+            )
+            return run(params, dparams, t_cache, d_cache, tokens, base_lens,
+                       true_lens, temps, topks, topps, seeds, ns)
+
+        self._paged_mixed = (
+            jax.jit(
+                paged_mixed, donate_argnums=(1,),
+                static_argnames=("sampled", "nucleus"),
+            )
+            if self._paged else None
+        )
+        self._spec_paged_chunk = (
+            jax.jit(
+                spec_paged_chunk, donate_argnums=(2, 3),
+                static_argnames=("sampled", "nucleus"),
+            )
+            if self._paged and draft_model is not None else None
+        )
+
         self._prefill = prefill
         self._append = append
         self._prefill_batch = prefill_batch
@@ -1076,8 +1344,9 @@ class LMEngine:
             if draft_model is not None else None
         )
         self._insert = jax.jit(insert, donate_argnums=(0,))
-        # (target cache, draft cache or None, length) per prefix name.
-        self._prefixes: dict[str, tuple[Any, Any | None, int]] = {}
+        # Dense: (target cache, draft cache or None, length) per prefix
+        # name. Paged: a _PagedPrefix (tokens + shared block ids).
+        self._prefixes: dict[str, Any] = {}
         # The effective cache capacity: a speculative engine is bounded
         # by the SMALLER of the two caches — the single definition every
         # capacity check uses.
@@ -1128,6 +1397,38 @@ class LMEngine:
             "Admissions by prefix-cache outcome",
             labels=("result",),
         )
+        # Paged-engine telemetry (registered unconditionally so the
+        # metric catalog is one list; the dense engine simply never
+        # moves them).
+        self._m_pool_util = REGISTRY.gauge(
+            "hops_tpu_lm_block_pool_utilization",
+            "Live KV blocks / allocatable pool blocks, sampled at "
+            "dispatch time",
+        ).labels()
+        self._m_prefill_chunks = REGISTRY.counter(
+            "hops_tpu_lm_prefill_chunks_total",
+            "Prompt chunks prefilled by the paged engine",
+        ).labels()
+        self._m_preemptions = REGISTRY.counter(
+            "hops_tpu_lm_preemptions_total",
+            "Requests preempted (blocks freed, requeued for replay) "
+            "because the block pool ran dry",
+        ).labels()
+        self._m_dispatch_failures = REGISTRY.counter(
+            "hops_tpu_lm_dispatch_failures_total",
+            "Engine dispatch waves that raised; their in-flight "
+            "requests were failed and the scheduler continued",
+        ).labels()
+        # Host scheduling state shared by both layouts.
+        self.preemptions = 0
+        self.prefill_chunks = 0
+        self._occ_sum = 0.0  # sum of per-dispatch occupancy samples
+        self._admit_seq = 0
+        self._admitting: list[_Request] = []  # popped, not yet slotted
+        # Per-ticket TTFT (seconds) and failure records; both consumed
+        # by take_result / take_error so a long-lived server stays flat.
+        self.ttft_s: dict[int, float] = {}
+        self._errors: dict[int, BaseException] = {}
 
     # --- public API -----------------------------------------------------
 
@@ -1139,7 +1440,16 @@ class LMEngine:
         optimization. On a speculative engine the DRAFT's prefix cache
         is prefilled and stored alongside the target's (the draft must
         enter every dispatch at the same position). Re-registering a
-        name replaces it."""
+        name replaces it.
+
+        On the PAGED engine the prefix is not prefilled here at all:
+        the first request that names it prefills normally, and the
+        physical blocks holding the prefix's complete pages are then
+        captured (one registry reference each). Every later admission
+        points its page table at those shared blocks and re-computes
+        only from the first incomplete block — page-table sharing with
+        copy-on-write at the divergence boundary, no stored cache
+        copy."""
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         if tokens.size == 0:
             raise ValueError("empty prefix")
@@ -1149,6 +1459,15 @@ class LMEngine:
                 f"prefix {tokens.size} leaves no room in "
                 f"max_decode_len {cap}"
             )
+        if self._paged:
+            old = self._prefixes.get(name)
+            if isinstance(old, _PagedPrefix) and old.blocks:
+                # Drop the registry's references; blocks still shared
+                # by live requests survive until those finish.
+                self._pool.unref_all(old.blocks)
+                old.blocks = None
+            self._prefixes[name] = _PagedPrefix(name=name, tokens=tokens)
+            return name
         L = tokens.size
         bucket = min(self._bucket(L), cap)
         padded = np.zeros((1, bucket), np.int32)
@@ -1199,7 +1518,9 @@ class LMEngine:
             # Snapshot: re-registering the name later must not swap the
             # prefix (or invalidate this validation) for queued work.
             prefix = self._prefixes[prefix_id]
-            prefix_len = prefix[2]
+            prefix_len = (
+                prefix.tokens.size if self._paged else prefix[2]
+            )
         total = prefix_len + prompt.size + max_new_tokens
         if total > self.model.max_decode_len:
             raise ValueError(
@@ -1225,6 +1546,19 @@ class LMEngine:
                     f"(+{self.spec_k - 2} speculation slack) exceeds "
                     f"max_decode_len {cap2}"
                 )
+        if self._paged:
+            # The deepest position this request can EVER write must fit
+            # the pool even when it is the only live request — the
+            # preemption policy can evict everyone else, never itself.
+            worst = total + (max(0, self.spec_k - 2) if self.spec_k else 0)
+            need = -(-worst // self._page_size)
+            if need > self._pool.total:
+                raise ValueError(
+                    f"request needs {need} KV blocks at its deepest "
+                    f"write; the pool has {self._pool.total} "
+                    f"(kv_pool_blocks={self._pool.num_blocks}, "
+                    f"page={self._page_size})"
+                )
         seed = int(seed) & 0x7FFFFFFF  # fold into int32 before it hits jit
         ticket = self._next_ticket
         self._next_ticket += 1
@@ -1240,15 +1574,39 @@ class LMEngine:
 
     def step(self) -> list[int]:
         """One engine iteration: admit queued requests into free slots,
-        then one decode dispatch for all slots (``decode_horizon``
+        then one decode dispatch wave for all slots (``decode_horizon``
         device-side steps — admission happens only at horizon
-        boundaries, the standard latency/throughput trade). Returns
-        tickets that finished this iteration."""
+        boundaries, the standard latency/throughput trade; on the paged
+        engine the wave also advances every in-progress chunked
+        prefill). Returns tickets that finished this iteration.
+
+        Failure isolation: a dispatch error — injected through the
+        ``lm_engine.dispatch`` fault point or a real backend failure —
+        fails ONLY the in-flight requests. Their slots (and, paged,
+        their blocks) are freed, the error is retrievable per ticket
+        via :meth:`take_error` (serving turns it into a 5xx), and the
+        scheduler keeps draining the queue on the next iteration.
+        """
+        try:
+            faultinject.fire("lm_engine.dispatch")
+            if self._paged:
+                return self._step_paged()
+            return self._step_dense()
+        except Exception as e:  # noqa: BLE001 — isolate to in-flight work
+            return self._fail_inflight(e)
+        finally:
+            self._admitting.clear()
+
+    def _step_dense(self) -> list[int]:
+        """One iteration of the dense-cache engine (the seed layout:
+        per-slot max-length cache reservations, monolithic bucketed
+        prefill at admission)."""
         finished = []
         wave: list[tuple[int, _Request]] = []
         for row in range(self.slots):
             if self._slot_state[row] is None and self._queue:
                 req = self._queue.popleft()
+                self._admitting.append(req)
                 if req.prefix is not None:
                     # Prefix-append admissions keep the per-request
                     # path: each starts from a different stored cache.
@@ -1307,17 +1665,7 @@ class LMEngine:
             )
 
         def account(row: int, tok: int) -> None:
-            # The one emit-and-finish bookkeeping path, shared by the
-            # single-step and horizon loops (must mirror the in-graph
-            # live-mask retirement exactly).
-            st = self._slot_state[row]
-            st.emitted.append(tok)
-            st.remaining -= 1
-            st.n_sampled += 1
-            self.tokens_emitted += 1
-            self._m_tokens.inc()
-            if st.remaining == 0 or (st.eos_id is not None and tok == st.eos_id):
-                finished.append(self._finish(row))
+            self._account(row, tok, finished)
 
         if self.spec_k and self.decode_horizon > 1:
             rems = jnp.asarray(
@@ -1460,9 +1808,12 @@ class LMEngine:
         """
         if (
             self.spec_k
+            or self._paged
             or any(r.prefix is not None for r in self._queue)
             or any(st is not None for st in self._slot_state)
         ):
+            # (Paged engines use the online scheduler: the fused wave
+            # program assumes the dense transient-cache layout.)
             return self.run()
         # Budget-major sort: uniform budgets per wave minimize the scan
         # steps finished rows idle through; bucket-minor keeps prompt
@@ -1541,8 +1892,7 @@ class LMEngine:
             # Offline waves never carry prefixes (run_offline falls
             # back to run() for those) — every admission is a miss.
             self._m_prefix_cache.inc(result="miss")
-            if r.submitted_at:
-                self._m_ttft.observe(time.monotonic() - r.submitted_at)
+            self._observe_ttft(r)
             self._results[r.ticket] = out
 
     def result(self, ticket: int) -> list[int] | None:
@@ -1551,8 +1901,20 @@ class LMEngine:
 
     def take_result(self, ticket: int) -> list[int] | None:
         """Like :meth:`result` but consuming — long-lived servers must
-        use this or ``_results`` grows without bound."""
+        use this or ``_results`` grows without bound. Also drops the
+        ticket's TTFT record."""
+        self.ttft_s.pop(ticket, None)
         return self._results.pop(ticket, None)
+
+    def error(self, ticket: int) -> BaseException | None:
+        """The dispatch failure that killed this ticket, if any (set
+        when a decode wave raised while the request was in flight)."""
+        return self._errors.get(ticket)
+
+    def take_error(self, ticket: int) -> BaseException | None:
+        """Consuming :meth:`error` — serving surfaces call this to turn
+        the failure into a 5xx without leaking the record."""
+        return self._errors.pop(ticket, None)
 
     def cancel(self, ticket: int) -> bool:
         """Remove a still-QUEUED request (admitted requests run to
@@ -1582,13 +1944,31 @@ class LMEngine:
             "slots_busy": sum(st is not None for st in self._slot_state),
             "slots": self.slots,
             "decode_horizon": self.decode_horizon,
+            "mean_occupancy": round(
+                self._occ_sum / max(self.dispatches, 1), 4
+            ),
+            "cache_layout": "paged" if self._paged else "dense",
         }
+        if self._paged:
+            out.update(self._pool.stats())
+            out.update(
+                page_size=self._page_size,
+                prefill_chunk=self.prefill_chunk,
+                prefill_chunks=self.prefill_chunks,
+                preemptions=self.preemptions,
+            )
         if self.spec_k:
             out["spec_k"] = self.spec_k
             out["spec_acceptance"] = round(
                 self.spec_accepted / max(self.spec_offered, 1), 3
             )
         return out
+
+    @property
+    def has_failures(self) -> bool:
+        """Unconsumed per-ticket dispatch failures exist (the serving
+        driver uses this to wake waiters whose tickets just failed)."""
+        return bool(self._errors)
 
     @property
     def has_work(self) -> bool:
@@ -1604,19 +1984,551 @@ class LMEngine:
 
     def _mark_dispatch(self) -> None:
         """The one dispatch-accounting path: the legacy ``dispatches``
-        counter plus the registry metrics; batch-slot occupancy is
-        sampled here because dispatch cadence IS the engine's clock."""
+        counter plus the registry metrics; batch-slot occupancy (and,
+        paged, block-pool utilization) is sampled here because dispatch
+        cadence IS the engine's clock."""
         self.dispatches += 1
         self._m_dispatches.inc()
-        self._m_occupancy.set(
-            sum(st is not None for st in self._slot_state) / self.slots
+        occ = sum(st is not None for st in self._slot_state) / self.slots
+        self._occ_sum += occ
+        self._m_occupancy.set(occ)
+        if self._paged:
+            self._m_pool_util.set(self._pool.stats()["utilization"])
+
+    def _observe_ttft(self, req: "_Request") -> None:
+        """First-token latency, once per request — a preempted request
+        replays its stream but keeps its original TTFT."""
+        if req.submitted_at and not req.ttft_observed:
+            dt = time.monotonic() - req.submitted_at
+            self._m_ttft.observe(dt)
+            self.ttft_s[req.ticket] = dt
+            req.ttft_observed = True
+
+    def _account(self, row: int, tok: int, finished: list[int]) -> None:
+        """The one emit-and-finish bookkeeping path, shared by the
+        single-step and horizon loops of BOTH cache layouts (must
+        mirror the in-graph live-mask retirement exactly)."""
+        st = self._slot_state[row]
+        st.emitted.append(tok)
+        st.remaining -= 1
+        st.n_sampled += 1
+        self.tokens_emitted += 1
+        self._m_tokens.inc()
+        if st.remaining == 0 or (st.eos_id is not None and tok == st.eos_id):
+            finished.append(self._finish(row))
+
+    def _fail_inflight(self, exc: BaseException) -> list[int]:
+        """Dispatch-failure isolation: every in-flight request fails
+        with ``exc`` (ticket -> :meth:`take_error`), slots and blocks
+        free, and the scheduler stays serviceable for the queue."""
+        self._m_dispatch_failures.inc()
+        failed: list[int] = []
+        for row in range(self.slots):
+            st = self._slot_state[row]
+            if st is None:
+                continue
+            self._errors[st.ticket] = exc
+            failed.append(st.ticket)
+            self._slot_state[row] = None
+            if self._paged and st.blocks is not None:
+                self._release_blocks(row, st.blocks)
+        for req in self._admitting:
+            # Popped from the queue but not yet slotted when the wave
+            # died (dense batched admission): fail those too rather
+            # than lose them silently.
+            if req.ticket not in self._errors and req.ticket not in self._results:
+                self._errors[req.ticket] = exc
+                failed.append(req.ticket)
+        self._admitting.clear()
+        # Re-materialize fresh all-free caches: a program that raised
+        # AFTER buffer donation consumed the old ones, and every slot's
+        # state was just discarded anyway — without this, the next
+        # dispatch would trip over deleted buffers and wedge the
+        # engine for good.
+        def fresh(tmpl):
+            return jax.tree.map(
+                lambda s: jax.device_put(
+                    jnp.zeros(s.shape, s.dtype), s.sharding
+                ),
+                tmpl,
+            )
+
+        self._cache = fresh(self._cache_tmpl)
+        if self._draft_cache_tmpl is not None:
+            self._draft_cache = fresh(self._draft_cache_tmpl)
+        if self._paged:
+            self._pages_dirty = True
+        log.warning(
+            "lm_engine dispatch failed; %d in-flight request(s) failed "
+            "(%s: %s)", len(failed), type(exc).__name__, exc,
         )
+        return []
 
     def _bucket(self, n: int) -> int:
         for b in self.prefill_buckets:
             if n <= b:
                 return b
         return self.model.max_decode_len
+
+    # --- paged scheduler ------------------------------------------------
+    # Host bookkeeping for the paged layout: which physical blocks each
+    # slot owns (BlockPool refcounts), how much of each prompt is still
+    # un-prefilled, and when to preempt. Admission costs NO dispatch —
+    # the prompt enters the cache through prefill_chunk-token chunks
+    # fused into the regular decode waves.
+
+    def _graft_cache_leaf(self, leaf_name: str, host_value: np.ndarray) -> None:
+        """Overwrite every layer's ``leaf_name`` cache leaf (in both
+        caches) with ``host_value`` — the single host->device graft
+        walker. Each leaf gets a FRESH buffer: the programs donate the
+        cache pytree, and donation rejects one buffer aliased across
+        leaves (f(donate(a), donate(a)))."""
+        import jax.tree_util as jtu
+
+        def set_leaf(path, leaf):
+            name = str(path[-1].key) if hasattr(path[-1], "key") else ""
+            return jnp.array(host_value) if name == leaf_name else leaf
+
+        self._cache = jtu.tree_map_with_path(set_leaf, self._cache)
+        if self._draft_cache is not None:
+            self._draft_cache = jtu.tree_map_with_path(
+                set_leaf, self._draft_cache
+            )
+
+    def _sync_pages(self) -> None:
+        """Push the host page table into every layer's 'pages' cache
+        leaf if it changed since the last dispatch. Must run before ANY
+        dispatch that follows an admission, free, preemption, or
+        in-graph scratch-clamp."""
+        if not self._pages_dirty:
+            return
+        self._graft_cache_leaf("pages", self._pages_np)
+        self._pages_dirty = False
+
+    def _graft_idx(self, idx_np: np.ndarray) -> None:
+        """Overwrite every layer's cache-index leaf with the host's
+        authoritative per-row lengths. The decode programs that do not
+        take an explicit base (spec_step / spec_horizon / step_horizon)
+        trust the device idx — but a live row that rode a previous
+        dispatch INERT (mid-prefill during a spec decode wave) had its
+        idx scratch-clamped to 0 in-graph. The host mirror is exact at
+        every iteration boundary, so re-grafting it is always sound;
+        callers gate on ``_idx_stale`` to keep it off the steady-state
+        hot path."""
+        self._graft_cache_leaf("idx", idx_np)
+
+    def _release_blocks(self, row: int, blocks: list[int]) -> None:
+        self._pool.unref_all(blocks)
+        self._pages_np[row, :] = 0
+        self._pages_dirty = True
+
+    def _admit_paged(self, row: int) -> bool:
+        """Try to admit the queue head into free slot ``row``:
+        bookkeeping only (page-table row + block refs + slot state).
+        False = the pool can't cover the prompt right now — the request
+        QUEUES (admission control) rather than OOMing or corrupting
+        live slots."""
+        req = self._queue[0]
+        entry = req.prefix
+        if entry is not None:
+            full = np.concatenate([entry.tokens, req.prompt])
+        else:
+            full = req.prompt
+        ps = self._page_size
+        shared: list[int] = list(entry.blocks) if (
+            entry is not None and entry.blocks
+        ) else []
+        shared_len = len(shared) * ps
+        n_new = -(-full.size // ps) - len(shared)
+        while n_new > self._pool.available:
+            # Idle prefix registrations must not starve admissions
+            # forever: with no live slot to ever free blocks, the
+            # registry's references would deadlock a queued request
+            # that submit-time validation promised fits. Evict those
+            # (cheap — re-computed on the next prefix hit; this
+            # request's own snapshot is kept, its shared list is
+            # already built on it); never preempt live work to admit.
+            if not self._evict_idle_prefix(keep=entry):
+                return False
+        new_blocks = self._pool.alloc(n_new)
+        for blk in shared:
+            self._pool.ref(blk)
+        blocks = shared + new_blocks
+        self._queue.popleft()
+        self._pages_np[row, :] = 0
+        self._pages_np[row, : len(blocks)] = blocks
+        self._pages_dirty = True
+        worst = full.size + req.max_new_tokens + (
+            max(0, self.spec_k - 2) if self.spec_k else 0
+        )
+        self._slot_state[row] = _SlotState(
+            ticket=req.ticket, emitted=[], remaining=req.max_new_tokens,
+            eos_id=req.eos_id, temperature=req.temperature,
+            top_k=req.top_k, top_p=req.top_p, seed=req.seed, n_sampled=0,
+            req=req, pending=full[shared_len:], base_len=shared_len,
+            prompt_total=int(full.size), worst_len=worst, blocks=blocks,
+            shared_hit=bool(shared), seq=self._admit_seq,
+        )
+        self._admit_seq += 1
+        return True
+
+    def _capture_prefix_blocks(self, st: "_SlotState") -> None:
+        """Prefill just crossed the prefix boundary: publish the
+        prefix's COMPLETE pages for sharing (one registry reference
+        each). Only the first finisher publishes, and only while its
+        snapshot is still the registered entry."""
+        entry = st.req.prefix
+        if not isinstance(entry, _PagedPrefix) or entry.blocks is not None:
+            return
+        if self._prefixes.get(entry.name) is not entry:
+            return  # re-registered since this request was submitted
+        nfull = entry.tokens.size // self._page_size
+        if nfull == 0:
+            return
+        entry.blocks = list(st.blocks[:nfull])
+        for blk in entry.blocks:
+            self._pool.ref(blk)
+
+    def _ensure_blocks(self, row: int, st: "_SlotState", cover_len: int) -> None:
+        """Grow ``row``'s page table to cover positions < cover_len —
+        the on-demand allocation as decode advances. A dry pool first
+        evicts idle prefix registrations, then preempts the
+        newest-admitted OTHER slot (its blocks free, its request
+        replays from the queue front — deterministic sampling makes the
+        replayed stream identical)."""
+        ps = self._page_size
+        need = -(-cover_len // ps)
+        while need > len(st.blocks):
+            want = need - len(st.blocks)
+            if self._pool.available >= want:
+                newb = self._pool.alloc(want)
+                self._pages_np[
+                    row, len(st.blocks): len(st.blocks) + want
+                ] = newb
+                st.blocks.extend(newb)
+                self._pages_dirty = True
+                return
+            if not self._reclaim(row):
+                raise RuntimeError(
+                    "block pool wedged: no free blocks, no evictable "
+                    "prefix, no preemptible slot — submit-time "
+                    "validation should have made this impossible"
+                )
+
+    def _evict_idle_prefix(self, keep: Any = None) -> bool:
+        """Drop ONE prefix registration's block references (no lost
+        work — the next hit re-computes them). ``keep`` protects a
+        specific entry (the admission in progress already points at
+        its blocks). False = nothing evictable."""
+        for entry in self._prefixes.values():
+            if entry is keep:
+                continue
+            if isinstance(entry, _PagedPrefix) and entry.blocks:
+                self._pool.unref_all(entry.blocks)
+                entry.blocks = None
+                return True
+        return False
+
+    def _reclaim(self, needy_row: int) -> bool:
+        """Free capacity for ``needy_row``: drop an idle prefix
+        registration's references first (no lost work), else preempt
+        the newest-admitted other slot. False = nothing left to take."""
+        if self._evict_idle_prefix():
+            return True
+        victims = [
+            (st.seq, r)
+            for r, st in enumerate(self._slot_state)
+            if st is not None and r != needy_row
+        ]
+        if not victims:
+            return False
+        self._preempt(max(victims)[1])
+        return True
+
+    def _preempt(self, row: int) -> None:
+        st = self._slot_state[row]
+        self._slot_state[row] = None
+        self._release_blocks(row, st.blocks)
+        # Queue FRONT: the victim re-admits as soon as space frees, and
+        # replays to an identical token stream (greedy is
+        # deterministic; sampled keys fold (seed, token index) only).
+        self._queue.appendleft(st.req)
+        self.preemptions += 1
+        self._m_preemptions.inc()
+
+    def _first_token(self, row: int, st: "_SlotState", tok: int) -> int | None:
+        """Prefill completed this chunk: the row's first emitted token.
+        The paged twin of :meth:`_register`'s bookkeeping tail."""
+        self.tokens_emitted += 1
+        self._m_tokens.inc()
+        self._m_prefix_cache.inc(result="hit" if st.shared_hit else "miss")
+        self._observe_ttft(st.req)
+        st.emitted = [tok]
+        st.remaining = st.req.max_new_tokens - 1
+        st.n_sampled = 1
+        if st.remaining == 0 or (st.eos_id is not None and tok == st.eos_id):
+            return self._finish(row)
+        return None
+
+    def _step_paged(self) -> list[int]:
+        """One iteration of the paged engine: admit (bookkeeping only),
+        grow decode rows' page tables on demand (preempting if dry),
+        then ONE fused chunk+decode dispatch — or, on speculative
+        engines, a chunk dispatch followed by the spec decode dispatch.
+        Decode-only iterations use the horizon/speculative programs
+        unchanged (they operate on the cache pytree, whatever its
+        layout)."""
+        finished: list[int] = []
+        for row in range(self.slots):
+            if self._queue and self._slot_state[row] is None:
+                if not self._admit_paged(row):
+                    break  # FIFO: pool pressure queues, never reorders
+        live = [
+            (r, st) for r, st in enumerate(self._slot_state) if st is not None
+        ]
+        if not live:
+            return finished
+        prefilling = [(r, st) for r, st in live if st.pending is not None]
+        # Worst-case decode advance of this wave, for block coverage.
+        horizon = 1 if prefilling else self.decode_horizon
+        adv = (self.spec_k or 1) * horizon
+        for r, st in live:
+            if self._slot_state[r] is not st or st.pending is not None:
+                continue  # preempted meanwhile, or still prefilling
+            mirror = st.prompt_total + len(st.emitted) - 1
+            self._ensure_blocks(r, st, min(mirror + adv, st.worst_len))
+        # _ensure_blocks may have preempted: rebuild the worklists.
+        live = [
+            (r, st) for r, st in enumerate(self._slot_state) if st is not None
+        ]
+        if not live:
+            return finished
+        prefilling = [(r, st) for r, st in live if st.pending is not None]
+        decoding = [(r, st) for r, st in live if st.pending is None]
+        sampled = any(st.temperature > 0 for _, st in live)
+        nucleus = any(
+            st.temperature > 0 and 0.0 < st.top_p < 1.0 for _, st in live
+        )
+        temps = jnp.asarray(
+            [st.temperature if st else 0.0 for st in self._slot_state],
+            jnp.float32,
+        )
+        topks = jnp.asarray(
+            [st.top_k if st else 0 for st in self._slot_state], jnp.int32
+        )
+        topps = jnp.asarray(
+            [st.top_p if st else 0.0 for st in self._slot_state], jnp.float32
+        )
+        seeds = jnp.asarray(
+            [st.seed if st else 0 for st in self._slot_state], jnp.int32
+        )
+
+        if prefilling:
+            W = self.prefill_chunk
+            tokens = np.zeros((self.slots, W), np.int32)
+            base = np.zeros((self.slots,), np.int32)
+            tl = np.zeros((self.slots,), np.int32)
+            ns = np.zeros((self.slots,), np.int32)
+            for r, st in prefilling:
+                n = min(W, int(st.pending.size))
+                tokens[r, :n] = st.pending[:n]
+                base[r] = st.base_len
+                tl[r] = n
+            fused_decode = not self.spec_k
+            for r, st in decoding:
+                base[r] = st.prompt_total + len(st.emitted) - 1
+                if fused_decode:
+                    tokens[r, 0] = st.emitted[-1]
+                    tl[r] = 1
+                    ns[r] = st.n_sampled
+            self._sync_pages()
+            if self.spec_k:
+                toks, self._cache, self._draft_cache = self._spec_paged_chunk(
+                    self.params, self.draft_params, self._cache,
+                    self._draft_cache, jnp.asarray(tokens),
+                    jnp.asarray(base), jnp.asarray(tl), temps, topks,
+                    topps, seeds, jnp.asarray(ns),
+                    sampled=sampled, nucleus=nucleus,
+                )
+                # Inert decode rows were scratch-clamped in-graph; the
+                # next _sync_pages restores their real pages.
+                self._pages_dirty = True
+            else:
+                toks, self._cache = self._paged_mixed(
+                    self.params, self._cache, jnp.asarray(tokens),
+                    jnp.asarray(base), jnp.asarray(tl), temps, topks,
+                    topps, seeds, jnp.asarray(ns),
+                    sampled=sampled, nucleus=nucleus,
+                )
+            self._mark_dispatch()
+            toks = np.asarray(toks)
+            for r, st in prefilling:
+                n = int(tl[r])
+                self.prefill_chunks += 1
+                self._m_prefill_chunks.inc()
+                st.base_len += n
+                st.pending = st.pending[n:]
+                if st.pending.size == 0:
+                    st.pending = None
+                    self._capture_prefix_blocks(st)
+                    done = self._first_token(r, st, int(toks[r]))
+                    if done is not None:
+                        finished.append(done)
+            if fused_decode:
+                for r, st in decoding:
+                    if self._slot_state[r] is st:
+                        self._account(r, int(toks[r]), finished)
+                return finished
+            if not decoding:
+                return finished
+
+        # --- decode dispatch --------------------------------------------
+        # Decode set = the rows captured BEFORE the chunk dispatch. A
+        # row that completed its prefill THIS iteration (first token
+        # just emitted) must sit this dispatch out — letting it decode
+        # here would advance its cache with tokens the host never
+        # accounted.
+        self._sync_pages()
+        dec_rows = {r for r, _ in decoding}
+        is_decode = [r in dec_rows for r in range(self.slots)]
+        if self._idx_stale:
+            # Host-authoritative cache index: some live row rode an
+            # earlier dispatch inert and had its device idx
+            # scratch-clamped. Steady-state decode (no inert
+            # passengers since the last graft) skips the transfer.
+            self._graft_idx(np.asarray(
+                [
+                    (st.prompt_total + len(st.emitted) - 1)
+                    if is_decode[r]
+                    else (st.base_len if st is not None else 0)
+                    for r, st in enumerate(self._slot_state)
+                ],
+                np.int32,
+            ))
+            self._idx_stale = False
+        tokens = jnp.asarray(
+            [st.emitted[-1] if dec else 0
+             for st, dec in zip(self._slot_state, is_decode)],
+            jnp.int32,
+        )
+        active = jnp.asarray(is_decode, jnp.bool_)
+        ns = jnp.asarray(
+            [st.n_sampled if dec else 0
+             for st, dec in zip(self._slot_state, is_decode)],
+            jnp.int32,
+        )
+        base = jnp.asarray(
+            [st.prompt_total + len(st.emitted) - 1 if dec else 0
+             for st, dec in zip(self._slot_state, is_decode)],
+            jnp.int32,
+        )
+        if self.spec_k:
+            rems = jnp.asarray(
+                [st.remaining if dec else 0
+                 for st, dec in zip(self._slot_state, is_decode)],
+                jnp.int32,
+            )
+            eos_ids = jnp.asarray(
+                [st.eos_id if dec and st.eos_id is not None else -1
+                 for st, dec in zip(self._slot_state, is_decode)],
+                jnp.int32,
+            )
+            if horizon > 1:
+                toks, emits, accs, lives, self._cache, self._draft_cache = (
+                    self._spec_horizon(
+                        self.params, self.draft_params, self._cache,
+                        self._draft_cache, tokens, active, rems, eos_ids,
+                        temps, topks, topps, seeds, ns,
+                        horizon=horizon, sampled=sampled, nucleus=nucleus,
+                    )
+                )
+                self._mark_dispatch()
+                toks, emits = np.asarray(toks), np.asarray(emits)
+                accs, lives = np.asarray(accs), np.asarray(lives)
+                for i in range(horizon):
+                    for r in range(self.slots):
+                        st = self._slot_state[r]
+                        if st is None or st.pending is not None or not lives[i, r]:
+                            continue
+                        self.spec_offered += self.spec_k - 1
+                        self.spec_accepted += int(accs[i, r])
+                        for j in range(self.spec_k):
+                            if emits[i, r, j] and self._slot_state[r] is st:
+                                self._account(r, int(toks[i, r, j]), finished)
+                return finished
+            if sampled:
+                drafts, a_rows, bonus, self._cache, self._draft_cache = (
+                    self._spec_step_sampled(
+                        self.params, self.draft_params, self._cache,
+                        self._draft_cache, tokens, active, temps, topks,
+                        topps, seeds, ns, nucleus=nucleus,
+                    )
+                )
+            else:
+                drafts, a_rows, bonus, self._cache, self._draft_cache = (
+                    self._spec_step(
+                        self.params, self.draft_params, self._cache,
+                        self._draft_cache, tokens, active,
+                    )
+                )
+            self._mark_dispatch()
+            if prefilling:
+                # Still-prefilling rows rode this dispatch inactive:
+                # the in-graph scratch-clamp zeroed their device pages
+                # AND idx, so later dispatches must restore both from
+                # the host.
+                self._pages_dirty = True
+                self._idx_stale = True
+            drafts = np.asarray(drafts)
+            a_rows, bonus = np.asarray(a_rows), np.asarray(bonus)
+            for r, st in decoding:
+                if self._slot_state[r] is not st:
+                    continue
+                self.spec_offered += self.spec_k - 1
+                self.spec_accepted += int(a_rows[r])
+                for tok in [int(t) for t in drafts[r, : a_rows[r]]] + [
+                    int(bonus[r])
+                ]:
+                    if self._slot_state[r] is not st:
+                        break
+                    self._account(r, tok, finished)
+            return finished
+        if horizon > 1:
+            rems = jnp.asarray(
+                [st.remaining if dec else 0
+                 for st, dec in zip(self._slot_state, is_decode)],
+                jnp.int32,
+            )
+            eos_ids = jnp.asarray(
+                [st.eos_id if dec and st.eos_id is not None else -1
+                 for st, dec in zip(self._slot_state, is_decode)],
+                jnp.int32,
+            )
+            toks, lives, self._cache = self._step_horizon(
+                self.params, self._cache, tokens, active, rems, eos_ids,
+                temps, topks, topps, seeds, ns,
+                horizon=horizon, sampled=sampled, nucleus=nucleus,
+            )
+            self._mark_dispatch()
+            toks, lives = np.asarray(toks), np.asarray(lives)
+            for i in range(horizon):
+                for r in range(self.slots):
+                    st = self._slot_state[r]
+                    if st is not None and st.pending is None and lives[i, r]:
+                        self._account(r, int(toks[i, r]), finished)
+            return finished
+        # Single-step decode: the mixed program at chunk width 1.
+        toks, self._cache = self._paged_mixed(
+            self.params, self._cache, tokens[:, None], base,
+            active.astype(jnp.int32), temps, topks, topps, seeds, ns,
+            sampled=sampled, nucleus=nucleus,
+        )
+        self._mark_dispatch()
+        toks = np.asarray(toks)
+        for r, st in decoding:
+            if self._slot_state[r] is st:
+                self._account(r, int(toks[r]), finished)
+        return finished
 
     def _admit(self, req: _Request, row: int) -> int | None:
         """Prefix-append admission: prefill ``req``'s suffix onto its
@@ -1770,8 +2682,7 @@ class LMEngine:
         self._m_prefix_cache.inc(
             result="hit" if req.prefix is not None else "miss"
         )
-        if req.submitted_at:
-            self._m_ttft.observe(time.monotonic() - req.submitted_at)
+        self._observe_ttft(req)
         st = _SlotState(
             ticket=req.ticket,
             emitted=[tok],
@@ -1791,6 +2702,10 @@ class LMEngine:
         st = self._slot_state[row]
         self._results[st.ticket] = st.emitted
         self._slot_state[row] = None
-        # The slot's cache rows stay as-is; the next insert overwrites
-        # idx (and the ragged kernel never reads past idx).
+        if self._paged and st.blocks is not None:
+            # Blocks free the moment the last reader is gone; shared
+            # prefix pages survive on the registry's reference.
+            self._release_blocks(row, st.blocks)
+        # Dense: the slot's cache rows stay as-is; the next insert
+        # overwrites idx (and the ragged kernel never reads past idx).
         return st.ticket

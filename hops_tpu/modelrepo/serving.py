@@ -192,6 +192,19 @@ class LMEnginePredictor:
             draft_model=draft_module,
             draft_params=draft_params,
             spec_k=int(cfg.get("spec_k", 4)),
+            # Paged KV cache + chunked prefill: {"kv_page_size": 64,
+            # "kv_pool_blocks": N?, "prefill_chunk": C?} — block-pool
+            # memory bounded by live tokens, long prompts admitted in
+            # chunks fused into the decode wave.
+            kv_page_size=(
+                int(cfg["kv_page_size"]) if cfg.get("kv_page_size") else None
+            ),
+            kv_pool_blocks=(
+                int(cfg["kv_pool_blocks"]) if cfg.get("kv_pool_blocks") else None
+            ),
+            prefill_chunk=(
+                int(cfg["prefill_chunk"]) if cfg.get("prefill_chunk") else None
+            ),
         )
         # Shared prompt prefixes (system prompts): prefilled once at
         # startup; instances opt in with {"prefix_id": name}.
@@ -212,8 +225,10 @@ class LMEnginePredictor:
                         return
                     # The dispatch runs under the lock: admissions only
                     # land at iteration boundaries anyway, and waiters
-                    # are woken the moment their ticket finishes.
-                    if self._engine.step():
+                    # are woken the moment their ticket finishes — or
+                    # fails (a dispatch error records per-ticket errors
+                    # and returns no finishers).
+                    if self._engine.step() or self._engine.has_failures:
                         self._cv.notify_all()
         except BaseException:  # noqa: BLE001
             # A dying driver thread must fail the waiters, not strand
@@ -263,18 +278,35 @@ class LMEnginePredictor:
                     self._engine.cancel(t)
                 raise
             self._cv.notify_all()  # wake the driver thread
-            while any(self._engine.result(t) is None for t in tickets):
+            while any(
+                self._engine.result(t) is None
+                and self._engine.error(t) is None
+                for t in tickets
+            ):
                 if self._stopping:
                     # The driver thread is gone; nothing will ever
                     # finish these. Fail the request instead of hanging
                     # the handler (and its HTTP connection) forever.
                     for t in tickets:
                         self._engine.take_result(t)
+                        self._engine.take_error(t)
                     raise RuntimeError("serving stopped")
                 self._cv.wait()
-            # take_result (consuming): one engine serves the process
-            # lifetime — result() would leak every request's tokens.
-            return [self._engine.take_result(t) for t in tickets]
+            # take_result / take_error (consuming): one engine serves
+            # the process lifetime — result() would leak every
+            # request's tokens. A dispatch failure (lm_engine.dispatch
+            # fault point, real backend error) failed only the affected
+            # tickets; surface it as this request's 5xx while other
+            # callers keep streaming.
+            errors = [self._engine.take_error(t) for t in tickets]
+            results = [self._engine.take_result(t) for t in tickets]
+            first = next((e for e in errors if e is not None), None)
+            if first is not None:
+                raise RuntimeError(
+                    f"lm engine dispatch failed for this request: "
+                    f"{type(first).__name__}: {first}"
+                )
+            return results
 
     def stop(self) -> None:
         with self._cv:
@@ -748,10 +780,15 @@ def create_or_update(
     ``slots``, ``prefill_buckets``, ``decode_horizon`` — device-side
     steps per dispatch, amortizing host-dispatch latency —
     ``prefixes``, a ``{name: token_ids}`` dict of shared prompt
-    prefixes prefilled once at startup, and
+    prefixes prefilled once at startup,
     ``draft_model``/``draft_version``/``spec_k`` — a second registry
-    model proposing tokens for greedy speculative serving); it does
-    its own cross-request scheduling, so it composes with
+    model proposing tokens for greedy speculative serving — and
+    ``kv_page_size``/``kv_pool_blocks``/``prefill_chunk``, which
+    switch the engine to the paged KV cache: slot memory bounded by
+    live tokens instead of slots x max_decode_len, prefix hits shared
+    through page tables, and long prompts prefilled in chunks fused
+    into the decode wave so they never freeze live generations); it
+    does its own cross-request scheduling, so it composes with
     ``batching_enabled=False`` only.
 
     ``resilience_config`` knobs (docs/operations.md "Failure

@@ -32,6 +32,7 @@ from hops_tpu.ops.attention import (
     decode_attention,
     decode_attention_q8,
     flash_attention,
+    paged_decode_attention,
     quantize_kv,
     repeat_kv,
 )
@@ -100,6 +101,18 @@ class Attention(nn.Module):
     # per-row positions, and cache writes land at per-row offsets. The
     # serving engine (modelrepo/lm_engine.py) drives this.
     ragged_decode: bool = False
+    # Paged decode (requires ragged_decode): the per-layer KV cache is
+    # a shared BLOCK POOL ``(kv_heads, kv_pool_blocks, kv_page_size,
+    # head_dim)`` plus a ``(batch, ceil(max_decode_len/page))`` page
+    # table mapping each row's logical block to a physical pool block,
+    # so persistent HBM is bounded by LIVE tokens instead of
+    # batch x max_decode_len. Pool block 0 is the engine's reserved
+    # scratch block (an all-zero page-table row writes there and never
+    # reads it back). The engine owns allocation/free/sharing — the
+    # module only translates positions through the table.
+    paged_decode: bool = False
+    kv_page_size: int = 64
+    kv_pool_blocks: int | None = None
 
     @nn.compact
     def __call__(self, x, decode: bool = False):
@@ -220,6 +233,8 @@ class Attention(nn.Module):
                 f"unknown kv_cache_dtype {self.kv_cache_dtype!r} "
                 "(None or 'int8')"
             )
+        if self.paged_decode:
+            return self._paged_decode_attend(q, k, v, b, s, dm, head_dim)
         fresh_cache = not self.has_variable("cache", "k")
         int8_cache = self.kv_cache_dtype == "int8"
         store_dtype = jnp.int8 if int8_cache else self.dtype
@@ -300,6 +315,76 @@ class Attention(nn.Module):
             )
         return self._project_out(o, b, s, dm)
 
+    def _paged_decode_attend(self, q, k, v, b, s, dm, head_dim):
+        """Autoregressive attention against a paged block-pool cache.
+
+        Every write and read addresses the pool through the per-row
+        page table: position ``p`` of row ``r`` lives in pool block
+        ``pages[r, p // page]`` at offset ``p % page``. Positions whose
+        table entry is 0 land in the reserved scratch block — that is
+        where free rows (page table all zeros, index clamped to 0) and
+        pad garbage past a row's true length go; the validity mask
+        makes both unreachable, exactly the dense ragged path's
+        "garbage past idx stays masked forever" invariant. There is no
+        fresh-cache flash shortcut here: a paged prefill is a chunked
+        warm append at the row's own offset (the causal mask in
+        :func:`paged_decode_attention` handles intra-chunk causality),
+        which is what lets the serving engine interleave prefill chunks
+        with decode steps in one dispatch.
+        """
+        if not self.ragged_decode:
+            raise ValueError(
+                "paged_decode requires ragged_decode=True — the page "
+                "table is per-row, so rows must advance independently"
+            )
+        if self.kv_cache_dtype is not None:
+            raise NotImplementedError(
+                "paged_decode supports only the bf16/fp32 cache "
+                "(kv_cache_dtype=None); the int8 pool needs paged "
+                "scale tables"
+            )
+        if self.kv_pool_blocks is None or self.kv_pool_blocks < 2:
+            raise ValueError(
+                "paged_decode needs kv_pool_blocks >= 2 (block 0 is "
+                "the reserved scratch block)"
+            )
+        page = self.kv_page_size
+        if page < 1:
+            raise ValueError(f"kv_page_size must be >= 1, got {page}")
+        kv_heads = k.shape[1]
+        max_blocks = -(-self.max_decode_len // page)
+        pool_shape = (kv_heads, self.kv_pool_blocks, page, head_dim)
+        ck = self.variable("cache", "k", jnp.zeros, pool_shape, self.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, pool_shape, self.dtype)
+        pages = self.variable(
+            "cache", "pages", jnp.zeros, (b, max_blocks), jnp.int32
+        )
+        idx = self.variable("cache", "idx", lambda: jnp.zeros((b,), jnp.int32))
+        offset = idx.value
+
+        pos = offset[:, None] + jnp.arange(s)[None, :]  # (b, s) absolute
+        q = rotary_embedding(q, pos)
+        k = rotary_embedding(k, pos)
+        # Clamp pad positions into the table's domain; rows whose pad
+        # runs past their allocation hit entry 0 = the scratch block.
+        posc = jnp.minimum(pos, self.max_decode_len - 1)
+        blk = jnp.take_along_axis(pages.value, posc // page, axis=1)  # (b, s)
+        off = posc % page
+        # pool[:, blk, off] — adjacent advanced indices land at axis 1:
+        # updates arrive head-major (kv_heads, b, s, head_dim).
+        ck.value = ck.value.at[:, blk, off].set(
+            jnp.swapaxes(k.astype(self.dtype), 0, 1)
+        )
+        cv.value = cv.value.at[:, blk, off].set(
+            jnp.swapaxes(v.astype(self.dtype), 0, 1)
+        )
+        idx.value = offset + s
+
+        o = paged_decode_attention(
+            q, ck.value, cv.value, idx.value, pages.value, window=self.window
+        )
+        return self._project_out(o, b, s, dm)
+
 
 class MLP(nn.Module):
     """SwiGLU: two fused up-projections + gated down-projection.
@@ -350,6 +435,9 @@ class Block(nn.Module):
     num_kv_heads: int | None = None
     window: int | None = None
     ragged_decode: bool = False
+    paged_decode: bool = False
+    kv_page_size: int = 64
+    kv_pool_blocks: int | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -367,6 +455,9 @@ class Block(nn.Module):
             num_kv_heads=self.num_kv_heads,
             window=self.window,
             ragged_decode=self.ragged_decode,
+            paged_decode=self.paged_decode,
+            kv_page_size=self.kv_page_size,
+            kv_pool_blocks=self.kv_pool_blocks,
             name="attn",
         )(RMSNorm(dtype=self.dtype)(x), decode=decode)
         if self.dropout_rate:
@@ -405,6 +496,12 @@ class TransformerLM(nn.Module):
     num_kv_heads: int | None = None  # GQA: shrink the decode cache
     window: int | None = None  # sliding-window causal attention
     ragged_decode: bool = False  # (b,) cache index: continuous batching
+    # Paged KV cache (serving engine's memory core): per-layer block
+    # pool + per-row page tables instead of (b, heads, capacity, d)
+    # reservations. See Attention.paged_decode.
+    paged_decode: bool = False
+    kv_page_size: int = 64
+    kv_pool_blocks: int | None = None
     # Megatron tensor parallelism: params hold num_heads/tp_shards
     # heads (gate/up shard hidden columns), one psum per block over
     # tp_axis. Apply inside a shard_map whose param specs slice the
@@ -428,6 +525,11 @@ class TransformerLM(nn.Module):
                 "tensor parallelism composes with dense TransformerLMs; "
                 "shard MoE models over an expert axis instead "
                 "(parallel/pipeline.py expert_axis, models/moe.py)"
+            )
+        if self.paged_decode and self.moe_every:
+            raise NotImplementedError(
+                "paged_decode serves dense TransformerLMs; MoE blocks "
+                "keep the dense ragged cache"
             )
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype, name="embed")(tokens)
         block_cls = nn.remat(Block, static_argnums=(2, 3)) if self.remat else Block
@@ -467,6 +569,9 @@ class TransformerLM(nn.Module):
                 num_kv_heads=self.num_kv_heads,
                 window=self.window,
                 ragged_decode=self.ragged_decode,
+                paged_decode=self.paged_decode,
+                kv_page_size=self.kv_page_size,
+                kv_pool_blocks=self.kv_pool_blocks,
                 name=f"block_{i}",
             )(x, train, decode)
         x = RMSNorm(dtype=self.dtype, name="final_norm")(x)

@@ -27,6 +27,9 @@ from hops_tpu.ops.attention import (  # noqa: F401
     decode_attention_reference,
     dequantize_kv,
     flash_attention,
+    paged_decode_attention,
+    paged_decode_attention_reference,
+    paged_gather_kv,
     quantize_kv,
     repeat_kv,
 )

@@ -859,6 +859,219 @@ def decode_attention(
 
 
 # ---------------------------------------------------------------------------
+# Paged KV cache: block-pool storage addressed through per-row page tables
+# ---------------------------------------------------------------------------
+
+
+def paged_gather_kv(pool: jax.Array, pages: jax.Array) -> jax.Array:
+    """Materialize the dense ``(b, hkv, max_blocks*page, d)`` view of a
+    ``(hkv, nblocks, page, d)`` block pool under a ``(b, max_blocks)``
+    page table — the reference formulation (and the ground truth the
+    kernel is tested against). The real kernel never does this gather:
+    it translates logical block -> physical block inside the BlockSpec
+    index map, so pool attention costs the same HBM bytes as dense."""
+    hkv, _, ps, d = pool.shape
+    b, mb = pages.shape
+    # pool[:, pages] -> (hkv, b, mb, ps, d); batch-major for attention.
+    return jnp.moveaxis(pool[:, pages], 1, 0).reshape(b, hkv, mb * ps, d)
+
+
+def paged_decode_attention_reference(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    valid_len: jax.Array,
+    pages: jax.Array,
+    sm_scale: float | None = None,
+    window: int | None = None,
+) -> jax.Array:
+    """XLA ground truth for :func:`paged_decode_attention`: gather the
+    dense view, then :func:`decode_attention_reference`. Kept for (a)
+    numeric tests, (b) page sizes the kernel's tiling can't take."""
+    dk = paged_gather_kv(k, pages)
+    dv = paged_gather_kv(v, pages)
+    return decode_attention_reference(q, dk, dv, valid_len, sm_scale, window)
+
+
+def _paged_decode_kernel(
+    vl_ref, pages_ref, q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+    *, sm_scale, block_q, page, s, rows, window,
+):
+    """One (bh, qi, kj) grid step of page-table cache attention.
+
+    Identical math to :func:`_decode_kernel` at ``block_bh=1`` with
+    ``block_k = page`` — the ONLY difference is that the k/v BlockSpec
+    index maps resolved grid block ``kj`` through the scalar-prefetched
+    page table before this body ran, so ``k_ref``/``v_ref`` hold the
+    PHYSICAL pool block while every position in the mask math below is
+    LOGICAL (``kj * page + lane``). Blocks past the row's valid prefix
+    are skipped by the same compute guard / clamped-index-map pairing
+    as the dense kernel, so HBM traffic is O(valid_len) here too.
+    """
+    bi, qi, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    vl = _read_vl(vl_ref, bi)
+    first, last = _decode_block_range(vl, block_k=page, s=s, window=window)
+
+    @pl.when((kj >= first) & (kj <= last))
+    def _body():
+        sc = jax.lax.dot_general(
+            q_ref[0], k_ref[0, 0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        visible = _decode_mask(
+            vl, qi, kj, block_q=block_q, block_k=page, s=s, rows=rows,
+            window=window,
+        )
+        sc = jnp.where(visible, sc * sm_scale, NEG_INF)
+        _online_softmax_update(
+            sc, v_ref[0, 0], m_scr.at[0], l_scr.at[0], acc_scr.at[0]
+        )
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_scr[...][:, :, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    valid_len: jax.Array,
+    pages: jax.Array,
+    *,
+    sm_scale: float | None = None,
+    window: int | None = None,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """:func:`decode_attention` over a PAGED KV cache.
+
+    ``k``/``v`` are shared block pools ``(hkv, nblocks, page, d)`` —
+    one physical allocation serving every batch row — and ``pages`` is
+    the ``(b, max_blocks)`` int32 page table mapping each row's logical
+    block ``j`` (cache positions ``j*page .. (j+1)*page - 1``) to a
+    physical pool block. ``valid_len`` is the per-row (or scalar) cache
+    index AFTER the current chunk, exactly as in the dense kernel; the
+    query chunk occupies logical positions ``valid_len - s ..
+    valid_len - 1``.
+
+    The page translation happens in the BlockSpec index maps (the page
+    table rides scalar prefetch next to ``valid_len``), so the kernel
+    DMAs each visible physical block exactly once per grid row — HBM
+    traffic is O(valid_len), the same bytes as the dense kernel, with
+    no gathered intermediate. Blocks past a row's valid prefix clamp to
+    the range edge and are skipped, identical to the dense kernel's
+    free-slot behavior (a ``valid_len == 0`` row outputs zeros). A row
+    whose page-table entries are 0 by convention points at a reserved
+    scratch block; masking makes its contents unreachable.
+
+    Pool rows the page table never references are never read. Page
+    sizes that don't tile (``page % 8 != 0``) fall back to the gathered
+    reference formulation.
+    """
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    b, h, s, d = q.shape
+    hkv, nblocks, page, dk = k.shape
+    if dk != d:
+        raise ValueError(f"pool head_dim {dk} != query head_dim {d}")
+    if h % hkv:
+        raise ValueError(f"{h} query heads not divisible by {hkv} kv heads")
+    if pages.shape[0] != b:
+        raise ValueError(
+            f"page table rows {pages.shape[0]} != batch {b}"
+        )
+    max_blocks = pages.shape[1]
+    valid_len = _normalize_valid_len(valid_len, b)
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    if page % 8:
+        # Sub-sublane pages can't be a Mosaic block; the gathered
+        # reference is the shape fallback (tests use it as ground truth).
+        return paged_decode_attention_reference(
+            q, k, v, valid_len, pages, sm_scale, window
+        ).astype(q.dtype)
+    if interpret is None:
+        if jax.default_backend() != "tpu":
+            # Non-TPU backends take the XLA reference twin: the paged
+            # grid has one step per PAGE per (batch, kv-head) row, and
+            # interpret mode executes grid steps as a host loop —
+            # orders of magnitude slower than the gathered XLA
+            # formulation. Pass interpret=True to force the kernel
+            # (the unit tests do, to pin kernel/reference parity).
+            return paged_decode_attention_reference(
+                q, k, v, valid_len, pages, sm_scale, window
+            ).astype(q.dtype)
+        interpret = False
+
+    g = h // hkv
+    rows = g * s
+    bh = b * hkv
+    block_q = 64 if rows > 64 else max(8, -(-rows // 8) * 8)
+    q_rows = -(-rows // block_q) * block_q
+    qf = q.reshape(bh, rows, d)
+    if q_rows != rows:
+        qf = jnp.pad(qf, ((0, 0), (0, q_rows - rows), (0, 0)))
+    vl = jnp.repeat(valid_len, hkv)  # one entry per (batch, kv-head) row
+    pages32 = jnp.asarray(pages, jnp.int32)
+
+    # Index maps receive (*grid_indices, *scalar_prefetch_refs). The
+    # logical->physical translation lives HERE: grid block kj clamps to
+    # the row's visible range (out-of-range steps revisit the edge
+    # block -> Mosaic issues no copy), then the page table picks the
+    # pool block to DMA.
+    def kv_index(bi, qi, kj, vl_ref, pages_ref):
+        first, last = _decode_block_range(
+            _read_vl(vl_ref, bi), block_k=page, s=s, window=window
+        )
+        kjc = jnp.maximum(jnp.clip(kj, first, last), 0)  # vl==0: last=-1
+        return bi % hkv, pages_ref[bi // hkv, kjc], 0, 0
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_decode_kernel, sm_scale=sm_scale, block_q=block_q,
+            page=page, s=s, rows=rows, window=window,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(bh, q_rows // block_q, max_blocks),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, block_q, d),
+                    lambda bi, qi, kj, vl_ref, pages_ref: (bi, qi, 0),
+                ),
+                pl.BlockSpec((1, 1, page, d), kv_index),
+                pl.BlockSpec((1, 1, page, d), kv_index),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, block_q, d),
+                lambda bi, qi, kj, vl_ref, pages_ref: (bi, qi, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((1, block_q, _LANES), jnp.float32),
+                pltpu.VMEM((1, block_q, _LANES), jnp.float32),
+                pltpu.VMEM((1, block_q, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((bh, q_rows, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")
+        ),
+        interpret=interpret,
+    )(vl, pages32, qf, k, v)
+    return out[:, :rows].reshape(b, hkv, g, s, d).reshape(b, h, s, d)
+
+
+# ---------------------------------------------------------------------------
 # int8 KV cache: half the decode HBM traffic, dequantized in-kernel
 # ---------------------------------------------------------------------------
 

@@ -69,6 +69,31 @@ def tp_param_specs(params: Any, tp_axis: str) -> Any:
     return jax.tree_util.tree_map_with_path(leaf_spec, params)
 
 
+def tp_cache_specs(cache: Any, tp_axis: str, paged: bool = False) -> Any:
+    """PartitionSpecs sharding a TransformerLM decode cache over
+    ``tp_axis`` — the single definition for BOTH cache layouts, so the
+    dense and paged engines cannot drift:
+
+    * dense ragged leaves ``(slots, heads, capacity, d)`` (and int8
+      scale leaves ``(slots, heads, capacity)``) shard on the head
+      axis, dim 1;
+    * paged pool leaves ``(kv_heads, pool_blocks, page, d)`` shard on
+      the head axis, dim 0 — each device owns its head-shard of every
+      physical block, and the (replicated) page table indexes the same
+      logical blocks on every shard;
+    * the ``(slots,)`` cache index and the ``(slots, max_blocks)`` page
+      table replicate (host-maintained scheduling state).
+    """
+
+    def leaf_spec(path, leaf):
+        name = str(path[-1].key) if hasattr(path[-1], "key") else ""
+        if name in ("idx", "pages"):
+            return P()
+        return P(tp_axis) if paged else P(None, tp_axis)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, cache)
+
+
 def tp_generate(
     model: Any,
     params: Any,

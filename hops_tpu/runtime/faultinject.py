@@ -42,6 +42,9 @@ docs/operations.md "Failure handling & fault injection"):
 ``search.trial``        ``TrialDriver._run_trial``, around the train fn
 ``pubsub.publish``      ``pubsub.Producer.send`` (corrupt: mangles the
                         encoded record)
+``lm_engine.dispatch``  ``LMEngine.step``, before the iteration's device
+                        dispatch wave (an error fails only the in-flight
+                        requests; the scheduler keeps serving)
 ==================  ========================================================
 """
 
@@ -72,6 +75,7 @@ POINTS = (
     "serving.handle",
     "search.trial",
     "pubsub.publish",
+    "lm_engine.dispatch",
 )
 
 _MODES = ("error", "latency", "corrupt")

@@ -47,6 +47,11 @@ MEASUREMENT_STEPS: list[tuple[str, list[str]]] = [
     ("decode_continuous_offline", [sys.executable, _DB, "--continuous",
                                    "--offline", "--batch", "4",
                                    "--tokens", "32", "--layers", "4"]),
+    # LM serving tier (PR 6): paged KV cache + chunked prefill vs the
+    # dense engine at equal memory under Poisson load — tiny model,
+    # small compiles, so it rides before the big ones.
+    ("lm_serving_bench", [sys.executable, "bench.py", "--lm-serving",
+                          "--no-probe"]),
     # LM training headline (round-4 review item #4): tokens/s/chip +
     # MFU% at ~180M params — a LARGE compile, so it sits after the
     # decode evidence is banked.

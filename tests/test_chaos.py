@@ -370,3 +370,141 @@ class TestPubsubChaos:
         assert [r["value"]["seq"] for r in records] == [1, 2]
         producer.send({"seq": 3})
         assert [r["value"]["seq"] for r in consumer.poll()] == [3]
+
+
+@pytest.mark.slow  # compiles the tiny LM engine programs (jit) — slow tier
+class TestLMEngineDispatchFaults:
+    """The ``lm_engine.dispatch`` fault point: an injected transient
+    dispatch error must fail ONLY the affected requests — their slots
+    and (paged) blocks freed, the error surfaced per ticket / as a 5xx
+    — and must never wedge the scheduler loop."""
+
+    def _engine(self, paged: bool):
+        import jax
+        import jax.numpy as jnp
+
+        from hops_tpu.models.transformer import TransformerLM
+        from hops_tpu.modelrepo.lm_engine import LMEngine
+
+        tiny = dict(
+            vocab_size=64, d_model=32, num_heads=4, num_layers=2,
+            dtype=jnp.float32, attention_impl="reference",
+            max_decode_len=64,
+        )
+        model = TransformerLM(**tiny, ragged_decode=True)
+        params = model.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        kw = (
+            dict(kv_page_size=8, prefill_chunk=8)
+            if paged else dict(prefill_buckets=(8, 16))
+        )
+        return LMEngine(model, params, slots=2, **kw)
+
+    @pytest.mark.parametrize("paged", [False, True])
+    def test_transient_dispatch_error_fails_only_inflight(self, paged):
+        engine = self._engine(paged)
+        rs = np.random.RandomState(0)
+        t1 = engine.submit(rs.randint(1, 64, (10,)), max_new_tokens=6)
+        t2 = engine.submit(rs.randint(1, 64, (10,)), max_new_tokens=6)
+        engine.step()
+        engine.step()  # both requests decoding
+        faultinject.arm("lm_engine.dispatch=error:RuntimeError@times=1")
+        assert engine.step() == []  # the failed wave finishes nobody
+        faultinject.disarm()
+        # Both in-flight requests failed, slots and blocks freed...
+        for t in (t1, t2):
+            err = engine.error(t)
+            assert isinstance(err, RuntimeError), (t, err)
+            assert engine.result(t) is None
+        assert all(st is None for st in engine._slot_state)
+        if paged:
+            assert engine._pool.used == 0
+        # ...and the scheduler keeps serving: a fresh request completes.
+        t3 = engine.submit(rs.randint(1, 64, (8,)), max_new_tokens=4)
+        res = engine.run()
+        assert len(res[t3]) == 4
+        assert engine.take_error(t1) is not None
+        assert engine.take_error(t1) is None  # consumed
+        assert _counter("hops_tpu_lm_dispatch_failures_total") >= 1
+
+    def test_queued_requests_survive_the_failed_wave(self):
+        engine = self._engine(True)
+        rs = np.random.RandomState(1)
+        inflight = engine.submit(rs.randint(1, 64, (10,)), max_new_tokens=6)
+        engine.step()
+        engine.step()
+        # Fill every slot's worth and more — the tail stays queued.
+        queued = [
+            engine.submit(rs.randint(1, 64, (10,)), max_new_tokens=4)
+            for _ in range(3)
+        ]
+        faultinject.arm("lm_engine.dispatch=error:RuntimeError@times=1")
+        engine.step()
+        faultinject.disarm()
+        assert isinstance(engine.error(inflight), RuntimeError)
+        res = engine.run()
+        for t in queued:  # queued work was never "in flight": it runs
+            assert len(res[t]) == 4, t
+        assert engine._pool.used == 0
+
+    def test_serving_surfaces_dispatch_failure_as_500(self):
+        """End to end through the HTTP surface: the affected caller gets
+        a 5xx, the endpoint stays up, and the next request succeeds."""
+        import jax
+        import jax.numpy as jnp
+
+        from hops_tpu.models.transformer import TransformerLM
+        from hops_tpu.modelrepo import registry, serving
+
+        tiny = dict(
+            vocab_size=64, d_model=32, num_heads=4, num_layers=2,
+            dtype=jnp.float32, attention_impl="reference",
+            max_decode_len=64,
+        )
+        plain = TransformerLM(**tiny)
+        params = plain.init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+        )["params"]
+        registry.save_flax(plain, params, "chaos-lm", metrics={"loss": 1.0})
+        serving.create_or_update(
+            "chaos-lm", model_name="chaos-lm", model_server="LM",
+            lm_config={"slots": 2, "kv_page_size": 8, "prefill_chunk": 8},
+        )
+        serving.start("chaos-lm")
+        try:
+            port = serving._load_registry()["chaos-lm"]["port"]
+            # Warm request (compiles outside the armed window).
+            code, body, _ = _post(
+                port, "chaos-lm",
+                {"instances": [{"prompt": [1, 2, 3], "max_new_tokens": 2}]},
+                timeout=120,
+            )
+            assert code == 200, body
+            # The fault must hit a wave with the request IN FLIGHT (a
+            # queued-only request rightly survives — step-level faults
+            # fail only admitted work), so skip the first two engine
+            # iterations deterministically: passage 1 admits + first
+            # chunk, passage 2 decodes, passage 3 fires mid-stream.
+            faultinject.arm(
+                "lm_engine.dispatch=error:RuntimeError@times=1,after=2"
+            )
+            code, body, _ = _post(
+                port, "chaos-lm",
+                {"instances": [{"prompt": [4, 5, 6],
+                                "max_new_tokens": 16}]},
+                timeout=120,
+            )
+            faultinject.disarm()
+            assert code == 500, body
+            assert "dispatch failed" in body["error"]
+            # The scheduler survived: the endpoint serves again.
+            code, body, _ = _post(
+                port, "chaos-lm",
+                {"instances": [{"prompt": [4, 5, 6], "max_new_tokens": 4}]},
+                timeout=120,
+            )
+            assert code == 200, body
+            assert len(body["predictions"][0]) == 4
+        finally:
+            serving.stop("chaos-lm")

@@ -35,6 +35,16 @@ def _module_names() -> list[str]:
     return sorted(names)
 
 
+def test_paged_engine_registered_in_drift_guard():
+    """The paged-KV-cache layer (block pool + the engine and kernel
+    modules it rides) must stay in the sweep: its kernel leans on
+    Pallas scalar-prefetch APIs that have drifted before."""
+    names = _module_names()
+    assert "hops_tpu.modelrepo.paged" in names
+    assert "hops_tpu.modelrepo.lm_engine" in names
+    assert "hops_tpu.ops.attention" in names
+
+
 def test_grad_comms_registered_in_drift_guard():
     """The gradient-comms layer leans on collective APIs that JAX has
     renamed before (psum_scatter, shard_map, axis_index); pin it here so

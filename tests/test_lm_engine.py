@@ -1086,3 +1086,377 @@ def test_engine_speculative_sampled_is_lossless():
     emp = np.bincount(seconds, minlength=16) / seconds.size
     tv = 0.5 * np.abs(emp - probs).sum()
     assert tv < 0.22, (tv, seconds.size)
+
+
+# --- paged KV cache + chunked prefill ---------------------------------------
+# The memory/scheduling core rebuild: per-layer caches as a shared block
+# pool + per-slot page tables, prompts prefilled in chunks fused into the
+# decode wave. The contract everywhere: token streams BIT-IDENTICAL to
+# the dense engine — the difference is memory/scheduling, never output.
+
+PAGED = dict(kv_page_size=8, prefill_chunk=8)
+
+
+def _mixed_prompts(rs, n=6, lo=3, hi=30):
+    """Short + long mix so some prompts span multiple chunks AND pages."""
+    return [rs.randint(1, 64, (rs.randint(lo, hi),)) for _ in range(n)]
+
+
+def _run_both(model, params, prompts, *, submit_kwargs=None, dense_kw=None,
+              paged_kw=None):
+    submit_kwargs = submit_kwargs or [{} for _ in prompts]
+    dense = LMEngine(model, params, slots=2, prefill_buckets=(8, 16, 32),
+                     **(dense_kw or {}))
+    paged = LMEngine(model, params, slots=2, **PAGED, **(paged_kw or {}))
+    outs = []
+    for engine in (dense, paged):
+        ts = [
+            engine.submit(p, **kw) for p, kw in zip(prompts, submit_kwargs)
+        ]
+        res = engine.run()
+        outs.append([res[t] for t in ts])
+    return outs[0], outs[1], dense, paged
+
+
+def test_engine_paged_matches_dense_greedy():
+    """Greedy streams are bit-identical dense vs paged across a mixed
+    short/long workload, and every block returns to the pool."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    params = _params(TransformerLM(**TINY))
+    rs = np.random.RandomState(0)
+    prompts = _mixed_prompts(rs)
+    d, p, _, paged = _run_both(
+        model, params, prompts,
+        submit_kwargs=[{"max_new_tokens": 10} for _ in prompts],
+    )
+    assert d == p
+    assert paged._pool.used == 0  # completion freed every block
+    assert paged.prefill_chunks > len(prompts)  # long prompts chunked
+    assert paged.stats()["cache_layout"] == "paged"
+
+
+def test_engine_paged_matches_dense_sampled_top_p_eos():
+    """Sampled rows (temperature/top-k/top-p/seed) and eos truncation:
+    identical streams — the (seed, token-index) key chain is layout-
+    independent."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    params = _params(TransformerLM(**TINY))
+    rs = np.random.RandomState(1)
+    prompts = _mixed_prompts(rs, n=5)
+    kws = [
+        {"max_new_tokens": 8, "temperature": 0.8, "top_k": 8, "seed": 11},
+        {"max_new_tokens": 6, "temperature": 1.1, "top_p": 0.9, "seed": 12},
+        {"max_new_tokens": 9},
+        {"max_new_tokens": 7, "eos_id": 5},
+        {"max_new_tokens": 5, "temperature": 0.5, "seed": 13},
+    ]
+    d, p, _, paged = _run_both(model, params, prompts, submit_kwargs=kws)
+    assert d == p
+    assert paged._pool.used == 0
+
+
+def test_engine_paged_speculative_matches_dense():
+    """The speculative path composes with paging: draft pool pages ride
+    the target's page table; accepted/bonus streams stay identical."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    draft_params = _params(plain, seed=5)
+    rs = np.random.RandomState(2)
+    prompts = _mixed_prompts(rs, n=5)
+    spec = dict(draft_model=model, draft_params=draft_params, spec_k=3)
+    d, p, _, paged = _run_both(
+        model, params, prompts,
+        submit_kwargs=[{"max_new_tokens": 9} for _ in prompts],
+        dense_kw=spec, paged_kw=spec,
+    )
+    assert d == p
+    assert paged.spec_offered > 0
+    assert paged._pool.used == 0
+
+
+def test_engine_paged_chunked_prefill_identical_across_chunk_sizes():
+    """The chunk width is a scheduling knob, not a numerics knob: any
+    prefill_chunk yields the same streams as unchunked (chunk >= max
+    prompt), greedy and sampled."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    params = _params(TransformerLM(**TINY))
+    rs = np.random.RandomState(3)
+    prompts = _mixed_prompts(rs, n=4, lo=10, hi=30)
+    kws = [
+        {"max_new_tokens": 6},
+        {"max_new_tokens": 6, "temperature": 0.9, "seed": 7},
+        {"max_new_tokens": 4},
+        {"max_new_tokens": 8},
+    ]
+    streams = []
+    for chunk in (4, 8, 32):
+        engine = LMEngine(model, params, slots=2, kv_page_size=8,
+                          prefill_chunk=chunk)
+        ts = [engine.submit(p, **kw) for p, kw in zip(prompts, kws)]
+        res = engine.run()
+        streams.append([res[t] for t in ts])
+    assert streams[0] == streams[1] == streams[2]
+
+
+def test_engine_paged_pool_exhaustion_queues_not_corrupts():
+    """A pool too small for the whole queue ADMITS what fits and queues
+    the rest — no OOM, no corruption: streams still match dense, the
+    queue drains in order, and blocks all free at the end."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    params = _params(TransformerLM(**TINY))
+    rs = np.random.RandomState(4)
+    prompts = [rs.randint(1, 64, (20,)) for _ in range(4)]
+    # 8 usable blocks; each request needs 3 for its prompt and up to 5
+    # at its deepest write — the pool can't hold all four at once.
+    paged = LMEngine(model, params, slots=4, kv_page_size=8,
+                     kv_pool_blocks=9, prefill_chunk=8)
+    ts = [paged.submit(p, max_new_tokens=8) for p in prompts]
+    paged.step()
+    # Admission control: not all four fit — some stayed queued.
+    assert paged.stats()["queued"] > 0
+    res = paged.run()
+    dense = LMEngine(model, params, slots=4, prefill_buckets=(8, 16, 32))
+    td = [dense.submit(p, max_new_tokens=8) for p in prompts]
+    dres = dense.run()
+    assert [res[a] for a in ts] == [dres[b] for b in td]
+    assert paged._pool.used == 0
+    # An outright-impossible request (deeper than the whole pool even
+    # with everyone else evicted) is rejected at submit, not OOMed.
+    tiny_pool = LMEngine(model, params, slots=2, kv_page_size=8,
+                         kv_pool_blocks=5, prefill_chunk=8)
+    with pytest.raises(ValueError, match="KV blocks"):
+        tiny_pool.submit(rs.randint(1, 64, (30,)), max_new_tokens=8)
+
+
+def test_engine_paged_preemption_replays_identically():
+    """Decode growth on a dry pool preempts the newest request (blocks
+    freed, request requeued) and the replayed stream is identical —
+    greedy AND sampled (keys fold (seed, index) only). The preemption
+    counter proves the path actually ran."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    params = _params(TransformerLM(**TINY))
+    rs = np.random.RandomState(5)
+    p1, p2 = rs.randint(1, 64, (20,)), rs.randint(1, 64, (20,))
+    for kws in (
+        [{"max_new_tokens": 20}, {"max_new_tokens": 20}],
+        [{"max_new_tokens": 20, "temperature": 0.7, "seed": 5},
+         {"max_new_tokens": 20, "temperature": 0.7, "seed": 9}],
+    ):
+        paged = LMEngine(model, params, slots=2, kv_page_size=8,
+                         kv_pool_blocks=9, prefill_chunk=8)
+        a = paged.submit(p1, **kws[0])
+        b = paged.submit(p2, **kws[1])
+        res = paged.run()
+        dense = LMEngine(model, params, slots=2, prefill_buckets=(8, 32))
+        da = dense.submit(p1, **kws[0])
+        db = dense.submit(p2, **kws[1])
+        dres = dense.run()
+        assert res[a] == dres[da] and res[b] == dres[db]
+        assert paged.preemptions > 0
+        assert paged._pool.used == 0
+        # TTFT observed once per request, preemption notwithstanding.
+        assert set(paged.ttft_s) == {a, b}
+
+
+def test_engine_paged_prefix_sharing_cow():
+    """Prefix-cache hits are PAGE-TABLE SHARING: the prefix's complete
+    pages are captured once (registry ref), later admissions point at
+    the same physical blocks (refcount++) and re-compute only from the
+    first incomplete block — with streams identical to the dense
+    engine's stored-cache prefix path."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    params = _params(TransformerLM(**TINY))
+    rs = np.random.RandomState(6)
+    prefix = rs.randint(1, 64, (20,))  # 2 complete pages of 8 + 4 tail
+    s1, s2 = rs.randint(1, 64, (5,)), rs.randint(1, 64, (7,))
+
+    dense = LMEngine(model, params, slots=2, prefill_buckets=(8, 16, 32))
+    dense.register_prefix("sys", prefix)
+    d1 = dense.submit(s1, max_new_tokens=8, prefix_id="sys")
+    d2 = dense.submit(s2, max_new_tokens=8, prefix_id="sys")
+    dres = dense.run()
+
+    paged = LMEngine(model, params, slots=2, **PAGED)
+    paged.register_prefix("sys", prefix)
+    u1 = paged.submit(s1, max_new_tokens=8, prefix_id="sys")
+    u2 = paged.submit(s2, max_new_tokens=8, prefix_id="sys")
+    pres = paged.run()
+    assert dres[d1] == pres[u1] and dres[d2] == pres[u2]
+
+    entry = paged._prefixes["sys"]
+    assert entry.blocks is not None and len(entry.blocks) == 20 // 8
+    # A third admission shares those physical blocks outright.
+    u3 = paged.submit(s1, max_new_tokens=4, prefix_id="sys")
+    paged.step()
+    row = next(
+        r for r, st in enumerate(paged._slot_state)
+        if st is not None and st.ticket == u3
+    )
+    assert list(paged._pages_np[row, :2]) == entry.blocks
+    assert paged._slot_state[row].shared_hit
+    for blk in entry.blocks:
+        assert paged._pool.refcount(blk) == 2  # registry + live sharer
+    res3 = paged.run()
+    assert res3[u3] == dres[d1][:4]
+    # Sharer gone: only the registry reference remains.
+    for blk in entry.blocks:
+        assert paged._pool.refcount(blk) == 1
+    # Re-registering drops the registry refs; the pool drains fully.
+    paged.register_prefix("sys", prefix[:8])
+    assert paged._pool.used == 0
+
+
+def test_engine_paged_horizon_identical_fewer_dispatches():
+    """decode_horizon composes with the paged cache: identical output,
+    fewer dispatches once prefills are done."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    params = _params(TransformerLM(**TINY))
+    rs = np.random.RandomState(7)
+    prompts = _mixed_prompts(rs, n=4)
+    e1 = LMEngine(model, params, slots=2, **PAGED)
+    e4 = LMEngine(model, params, slots=2, **PAGED, decode_horizon=4)
+    outs = []
+    for engine in (e1, e4):
+        ts = [engine.submit(p, max_new_tokens=10) for p in prompts]
+        res = engine.run()
+        outs.append([res[t] for t in ts])
+    assert outs[0] == outs[1]
+    assert e4.dispatches < e1.dispatches
+
+
+def test_engine_paged_tensor_parallel_matches_dense():
+    """mesh= composes with the paged cache: pools shard on their head
+    axis (tp_cache_specs paged layout), page tables replicate, output
+    identical to the single-device paged engine and the dense one."""
+    from hops_tpu.parallel import mesh as mesh_lib
+
+    model = TransformerLM(**TINY, ragged_decode=True)
+    params = _params(TransformerLM(**TINY))
+    rs = np.random.RandomState(8)
+    prompts = _mixed_prompts(rs, n=4)
+    mesh = mesh_lib.make_mesh({"model": 2}, devices=jax.devices()[:2])
+    tp = LMEngine(model, params, slots=2, **PAGED, mesh=mesh)
+    single = LMEngine(model, params, slots=2, **PAGED)
+    outs = []
+    for engine in (tp, single):
+        ts = [engine.submit(p, max_new_tokens=8) for p in prompts]
+        res = engine.run()
+        outs.append([res[t] for t in ts])
+    assert outs[0] == outs[1]
+    # The pool leaves really are head-sharded over the mesh.
+    kpool = tp._cache["block_0"]["attn"]["k"]
+    assert kpool.sharding.spec == jax.sharding.PartitionSpec("model")
+
+
+def test_engine_paged_rejects_invalid_config():
+    model = TransformerLM(**TINY, ragged_decode=True)
+    params = _params(TransformerLM(**TINY))
+    with pytest.raises(ValueError, match="prefill_chunk requires"):
+        LMEngine(model, params, prefill_chunk=8)
+    with pytest.raises(ValueError, match="kv_pool_blocks"):
+        LMEngine(model, params, kv_page_size=8, kv_pool_blocks=1)
+    int8 = TransformerLM(**TINY, ragged_decode=True, kv_cache_dtype="int8")
+    with pytest.raises(ValueError, match="int8"):
+        LMEngine(int8, params, kv_page_size=8)
+
+
+def test_bench_lm_serving_smoke_e2e():
+    """`bench.py --lm-serving --smoke` runs the Poisson-load serving
+    tier end-to-end on the CPU tier and its JSON line carries the full
+    metric set the driver relays: tokens/s/chip, TTFT p50/p99, slot
+    occupancy, block-pool utilization, prefill-chunk and
+    preempted-prefill counts, plus the dense same-memory baseline."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    root = Path(__file__).resolve().parents[1]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, str(root / "bench.py"), "--lm-serving", "--smoke"],
+        capture_output=True, text=True, env=env, cwd=root, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    import json as _json
+
+    line = _json.loads(proc.stdout.strip().splitlines()[-1])
+    assert line["metric"] == "lm_serving_tokens_per_sec_per_chip"
+    assert line["unit"] == "tokens/s/chip"
+    assert line["engine"] == "paged"
+    assert line["value"] > 0
+    assert line["ttft_p50_ms"] > 0 and line["ttft_p99_ms"] >= line["ttft_p50_ms"]
+    assert 0.0 <= line["slot_occupancy"] <= 1.0
+    assert 0.0 <= line["block_pool_peak_util"] <= 1.0
+    assert line["prefill_chunks"] > 0
+    assert line["preempted_prefills"] >= 0
+    assert line["dense_tokens_per_sec_per_chip"] > 0
+    assert line["dense_ttft_p99_ms"] > 0
+    assert line["speedup_vs_dense"] > 0
+
+
+def test_engine_paged_admission_evicts_idle_prefix_instead_of_deadlock():
+    """Review regression: with NO live slot, an idle prefix
+    registration's block references must not starve a queued admission
+    forever — the admission path evicts idle prefixes (never preempting
+    live work) and the request runs."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    params = _params(TransformerLM(**TINY))
+    rs = np.random.RandomState(9)
+    eng = LMEngine(model, params, slots=2, kv_page_size=8,
+                   kv_pool_blocks=6, prefill_chunk=8)  # 5 usable blocks
+    eng.register_prefix("sys", rs.randint(1, 64, (17,)))  # 2 full pages
+    t0 = eng.submit(rs.randint(1, 64, (4,)), max_new_tokens=2,
+                    prefix_id="sys")
+    eng.run()  # registry now holds the prefix's 2 blocks
+    assert eng._prefixes["sys"].blocks is not None
+    assert eng._pool.used == 2
+    # Needs 4 blocks for its prompt; only 3 free. Before the fix this
+    # queued forever (no live slot would ever free anything).
+    t1 = eng.submit(rs.randint(1, 64, (30,)), max_new_tokens=8)
+    for _ in range(64):
+        eng.step()
+        if eng.result(t1) is not None:
+            break
+    assert eng.result(t1) is not None and len(eng.result(t1)) == 8
+    assert eng._prefixes["sys"].blocks is None  # evicted, not leaked
+    assert eng._pool.used == 0
+    assert eng.result(t0) is not None
+
+
+@pytest.mark.parametrize("paged", [False, True])
+def test_engine_recovers_after_midflight_program_failure(paged):
+    """Review regression: a program that raises AFTER consuming its
+    donated cache buffers must not wedge the engine — _fail_inflight
+    re-materializes fresh all-free caches, so the next request really
+    is served (not just when the error fired before dispatch)."""
+    model = TransformerLM(**TINY, ragged_decode=True)
+    params = _params(TransformerLM(**TINY))
+    kw = (dict(kv_page_size=8, prefill_chunk=8) if paged
+          else dict(prefill_buckets=(8, 16)))
+    eng = LMEngine(model, params, slots=2, **kw)
+    rs = np.random.RandomState(10)
+    t1 = eng.submit(rs.randint(1, 64, (6,)), max_new_tokens=8)
+    eng.step()  # admitted + first token
+
+    # Poison the decode program: it RUNS (donating the cache) and then
+    # raises, like an XlaRuntimeError surfacing mid-wave.
+    target = "_paged_mixed" if paged else "_step_greedy"
+    real = getattr(eng, target)
+
+    def poisoned(*args, **kwargs):
+        real(*args, **kwargs)
+        raise RuntimeError("backend died mid-wave")
+
+    setattr(eng, target, poisoned)
+    assert eng.step() == []
+    setattr(eng, target, real)
+    assert isinstance(eng.error(t1), RuntimeError)
+    # The engine was NOT wedged: fresh requests complete.
+    t2 = eng.submit(rs.randint(1, 64, (5,)), max_new_tokens=4)
+    res = eng.run()
+    assert len(res[t2]) == 4
+    if paged:
+        assert eng._pool.used == 0

@@ -252,6 +252,80 @@ def test_decode_attention_non_dividing_block_k_falls_back():
     np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
 
 
+# -- paged decode cache: block pool + page-table translation -----------------
+
+
+def _pool_inputs(hkv=2, nblocks=10, page=8, d=32, seed=3):
+    rs = np.random.RandomState(seed)
+    k = jnp.asarray(rs.randn(hkv, nblocks, page, d), jnp.float32)
+    v = jnp.asarray(rs.randn(hkv, nblocks, page, d), jnp.float32)
+    return k, v
+
+
+@pytest.mark.parametrize("s", [1, 4])
+def test_paged_decode_attention_matches_reference_and_dense(s):
+    """The paged kernel (page translation in the BlockSpec index maps,
+    forced via interpret=True off-TPU) equals both its gathered XLA
+    reference and the dense kernel run on the gathered view — including
+    GQA head grouping and ragged per-row valid lengths."""
+    from hops_tpu.ops.attention import (
+        decode_attention,
+        paged_decode_attention,
+        paged_decode_attention_reference,
+        paged_gather_kv,
+    )
+
+    k, v = _pool_inputs()
+    pages = jnp.asarray([[1, 2, 3, 4], [5, 6, 0, 0], [7, 8, 9, 0]], jnp.int32)
+    vl = jnp.asarray([30, 9, 17], jnp.int32)
+    rs = np.random.RandomState(4)
+    q = jnp.asarray(rs.randn(3, 4, s, 32), jnp.float32)  # 4 q heads / 2 kv
+    out = paged_decode_attention(q, k, v, vl, pages, interpret=True)
+    ref = paged_decode_attention_reference(q, k, v, vl, pages)
+    np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+    dense = decode_attention(
+        q, paged_gather_kv(k, pages), paged_gather_kv(v, pages), vl
+    )
+    np.testing.assert_allclose(out, dense, atol=2e-6, rtol=2e-6)
+
+
+def test_paged_decode_attention_zero_row_and_scratch_block():
+    """A vl == 0 row outputs zeros (the free-slot convention), and the
+    reserved scratch block's contents are unreachable: scribbling 1e30
+    garbage into block 0 changes nothing for rows that don't map it."""
+    from hops_tpu.ops.attention import paged_decode_attention
+
+    k, v = _pool_inputs()
+    pages = jnp.asarray([[0, 0, 0, 0], [5, 6, 0, 0], [7, 8, 9, 0]], jnp.int32)
+    vl = jnp.asarray([0, 9, 17], jnp.int32)
+    rs = np.random.RandomState(5)
+    q = jnp.asarray(rs.randn(3, 4, 1, 32), jnp.float32)
+    clean = paged_decode_attention(q, k, v, vl, pages, interpret=True)
+    assert np.allclose(np.asarray(clean)[0], 0.0)
+    k2 = k.at[:, 0].set(1e30)
+    v2 = v.at[:, 0].set(-1e30)
+    dirty = paged_decode_attention(q, k2, v2, vl, pages, interpret=True)
+    np.testing.assert_array_equal(np.asarray(clean)[1:], np.asarray(dirty)[1:])
+
+
+def test_paged_decode_attention_sub_sublane_page_falls_back():
+    """page % 8 != 0 can't tile on Mosaic: routes to the gathered XLA
+    reference (same contract as the dense kernel's odd-capacity path)."""
+    from hops_tpu.ops.attention import (
+        paged_decode_attention,
+        paged_decode_attention_reference,
+    )
+
+    k, v = _pool_inputs(page=6)
+    pages = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    vl = jnp.asarray([7, 12], jnp.int32)
+    rs = np.random.RandomState(6)
+    q = jnp.asarray(rs.randn(2, 2, 1, 32), jnp.float32)
+    out = paged_decode_attention(q, k, v, vl, pages)
+    ref = paged_decode_attention_reference(q, k, v, vl, pages)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
 # -- int8-quantized decode cache ---------------------------------------------
 
 
