@@ -178,11 +178,19 @@ def run_bench(
 
     ``grad_comms`` picks the gradient-communication schedule
     (``none`` = XLA's implicit fp32 AllReduce; ``quantized`` /
-    ``zero1`` / ``quantized+zero1`` route through
+    ``zero1`` / ``quantized+zero1`` / ``overlap`` /
+    ``quantized+overlap`` / ``zero2`` / ``zero3`` route through
     ``hops_tpu.parallel.grad_comms``) so the trajectory can attribute
     comms wins; the chosen mode and its compression ratio travel in
-    the result.
+    the result. Overlap-scheduled modes (``overlap``/``zero2``/
+    ``zero3``) additionally re-time the step against the matching
+    compute-then-communicate schedule and a no-reduction reference to
+    report ``overlap_fraction`` — the share of comms time hidden under
+    backward — plus per-chip optimizer-state bytes (the ZeRO ladder's
+    memory story).
     """
+    import dataclasses as _dc
+
     from hops_tpu.models import common
     from hops_tpu.models.resnet import ResNet18ish, ResNet50
     from hops_tpu.parallel import grad_comms as gc_lib
@@ -239,27 +247,44 @@ def run_bench(
     # One jit wrapper, hoisted: a fresh ``jax.jit(init_fn)`` per
     # remake_state call would recompile init on every transient-retry.
     jit_init = jax.jit(init_fn)
-    make_state = lambda: strategy.replicate(jit_init(jax.random.PRNGKey(0)))  # noqa: E731
+
+    def make_state_for(cfg):
+        st = strategy.replicate(jit_init(jax.random.PRNGKey(0)))
+        if cfg is not None and cfg.update_sharding == "zero3":
+            # ZeRO-3 trains on the flat-shard state carrier: params and
+            # moments live 1/N-sharded across the data axis at rest.
+            st = gc_lib.zero3_init(st, strategy.mesh, strategy.data_axis)
+        return st
+
+    def build_step(cfg):
+        ts = common.make_bn_train_step(grad_comms=cfg)
+
+        def multi_step(state, batch):
+            def body(st, _):
+                st, metrics = ts(st, batch)
+                return st, metrics["loss"]
+
+            state, losses = jax.lax.scan(body, state, None, length=scan_chunk)
+            return state, losses[-1]
+
+        # Propagate the inner step's grad-comms marker (and the scan
+        # factor, so the wire-byte counters account every fused
+        # optimizer step).
+        multi_step.grad_comms = cfg
+        multi_step.grad_comms_steps = scan_chunk
+        return strategy.step(multi_step, grad_comms=cfg)
+
+    make_state = lambda: make_state_for(gc_cfg)  # noqa: E731
     state = make_state()
     _note("params initialized")
-    train_step = common.make_bn_train_step(grad_comms=gc_cfg)
-
-    def multi_step(state, batch):
-        def body(st, _):
-            st, metrics = train_step(st, batch)
-            return st, metrics["loss"]
-
-        state, losses = jax.lax.scan(body, state, None, length=scan_chunk)
-        return state, losses[-1]
-
-    # Propagate the inner step's grad-comms marker (and the scan factor,
-    # so the wire-byte counters account every fused optimizer step).
-    multi_step.grad_comms = gc_cfg
-    multi_step.grad_comms_steps = scan_chunk
-    step_fn = strategy.step(multi_step, grad_comms=gc_cfg)
+    step_fn = build_step(gc_cfg)
     gc_pre, gc_post = (
         gc_lib.wire_bytes(state.params, gc_cfg) if gc_cfg is not None else (0, 0)
     )
+    # Read off the live initial state BEFORE the timed loop donates it
+    # — re-initializing a whole state later just to count bytes would
+    # double the init cost and peak memory.
+    gc_opt_bytes = _opt_state_bytes(state) if gc_cfg is not None else (0, 0)
 
     # Each process contributes its own local shard of the global batch.
     rs = np.random.RandomState(jax.process_index())
@@ -286,7 +311,60 @@ def run_bench(
     if gc_cfg is not None:
         result["grad_comms"] = gc_cfg.mode
         result["grad_comms_compression"] = round(gc_pre / gc_post, 2) if gc_post else 1.0
+        result["opt_state_bytes"] = gc_opt_bytes[0]
+        result["opt_state_bytes_per_chip"] = gc_opt_bytes[1]
+        overlapish = gc_cfg.overlap or gc_cfg.update_sharding in ("zero2", "zero3")
+        if overlapish:
+            # Re-time against (a) the matching compute-then-communicate
+            # schedule and (b) a no-reduction reference: the comms time
+            # is (a) - (b), the hidden share is ((a) - overlap) / comms.
+            seq_cfg = (
+                _dc.replace(gc_cfg, overlap=False)
+                if gc_cfg.overlap
+                else _dc.replace(gc_cfg, update_sharding="cross_replica")
+            )
+            local_cfg = gc_lib.GradCommsConfig(local_only=True)
+            t_overlap = elapsed / total_steps
+            ref = {}
+            for name, cfg in (("sequential", seq_cfg), ("local", local_cfg)):
+                _note(f"overlap attribution: timing the {name} reference "
+                      f"({cfg.mode})")
+                el, n = _timed_loop(
+                    build_step(cfg), make_state_for(cfg), batch,
+                    steps=steps, warmup=warmup, scan_chunk=scan_chunk,
+                    remake_state=lambda cfg=cfg: make_state_for(cfg),
+                )
+                ref[name] = el / n
+            comms_s = max(ref["sequential"] - ref["local"], 0.0)
+            hidden_s = max(ref["sequential"] - t_overlap, 0.0)
+            frac = min(1.0, hidden_s / comms_s) if comms_s > 0 else 0.0
+            result["overlap_fraction"] = round(frac, 4)
+            result["seq_step_time_ms"] = round(ref["sequential"] * 1e3, 3)
+            result["nocomms_step_time_ms"] = round(ref["local"] * 1e3, 3)
+            from hops_tpu.telemetry import REGISTRY
+
+            REGISTRY.gauge(
+                "hops_tpu_grad_comms_overlap_fraction",
+                "Share of gradient-comms time hidden under backward "
+                "compute (bench-measured)",
+                labels=("mode",),
+            ).set(frac, mode=gc_cfg.mode)
     return result
+
+
+def _opt_state_bytes(state) -> tuple[int, int]:
+    """(total, per-chip) optimizer-state bytes: per-chip counts each
+    leaf's addressable shard, so ZeRO-3's sharded-at-rest moments show
+    their 1/N footprint while replicated-contract modes show the full
+    one."""
+    total = per_chip = 0
+    for leaf in jax.tree.leaves(state.opt_state):
+        itemsize = jnp.dtype(leaf.dtype).itemsize
+        nbytes = leaf.size * itemsize
+        total += nbytes
+        shards = getattr(leaf, "addressable_shards", None)
+        per_chip += shards[0].data.size * itemsize if shards else nbytes
+    return int(total), int(per_chip)
 
 
 def run_lm_bench(
@@ -751,14 +829,22 @@ class _ProbeError(RuntimeError):
     """The health probe answered, but with an error."""
 
 
-def probe_with_retry() -> tuple[dict | None, str, str]:
+def probe_with_retry(
+    attempt_deadline_s: float = 150.0,
+    probe_timeout_s: float = 120,
+    total_timeout_s: float = 360.0,
+    base_delay_s: float = 15.0,
+) -> tuple[dict | None, str, str]:
     """The BENCH_r04/r05 wedge fix: the pre-run health probe under a
     bounded ``RetryPolicy`` with per-attempt ``with_deadline`` instead
     of one open-ended 240 s wait. Returns ``(health, kind, error)`` —
     ``health`` non-None means reachable; otherwise ``kind`` is
     ``probe_timeout`` (hang — the wedge signature) or ``relay_error``
     (probe answered with an error), which flows into the stale line's
-    ``stale_kind`` so consumers can tell the two apart."""
+    ``stale_kind`` so consumers can tell the two apart. The budgets are
+    parameters so the deadline contract is testable at test-sized
+    timescales (tests/test_loader.py pins that a hung probe returns
+    within ~total_timeout_s instead of wedging the driver)."""
     from hops_tpu.runtime.resilience import DeadlineExceeded, RetryPolicy, with_deadline
 
     def attempt() -> dict:
@@ -766,7 +852,9 @@ def probe_with_retry() -> tuple[dict | None, str, str]:
         # a hang in process spawning must not blow the attempt budget.
         # (probe_tpu's timeout rides positionally — with_deadline's own
         # second parameter is also named timeout_s.)
-        health = with_deadline(probe_tpu, 150.0, 120, op="bench.probe")
+        health = with_deadline(
+            probe_tpu, attempt_deadline_s, probe_timeout_s, op="bench.probe"
+        )
         if health.get("ok"):
             return health
         err = str(health.get("error", "unknown"))
@@ -775,8 +863,8 @@ def probe_with_retry() -> tuple[dict | None, str, str]:
         raise _ProbeError(err)
 
     policy = RetryPolicy(
-        max_attempts=2, base_delay_s=15.0, jitter=False,
-        total_timeout_s=360.0,
+        max_attempts=2, base_delay_s=base_delay_s, jitter=False,
+        total_timeout_s=total_timeout_s,
         retry_on=(_ProbeTimeout, _ProbeError, DeadlineExceeded),
     )
     try:
@@ -864,11 +952,16 @@ def main() -> None:
     )
     parser.add_argument(
         "--grad-comms",
-        choices=["none", "quantized", "zero1", "quantized+zero1"],
+        choices=["none", "quantized", "zero1", "quantized+zero1",
+                 "overlap", "quantized+overlap", "zero2",
+                 "quantized+zero2", "zero3", "quantized+zero3"],
         default="none",
         help="gradient-communication schedule for the ResNet bench: "
-        "block-scaled int8 quantized all-reduce, ZeRO-1 cross-replica "
-        "sharded weight update, or both (hops_tpu.parallel.grad_comms)",
+        "block-scaled int8 quantized all-reduce, ZeRO-1/2/3 sharded "
+        "updates, and overlap-scheduled (bucket-as-ready, launched "
+        "under backward) variants (hops_tpu.parallel.grad_comms); "
+        "overlap/zero2/zero3 lines carry overlap_fraction and "
+        "per-chip optimizer-state bytes",
     )
     parser.add_argument(
         "--remat", action="store_true",
@@ -1122,6 +1215,17 @@ def main() -> None:
             grad_comms=result["grad_comms"],
             grad_comms_compression=result["grad_comms_compression"],
         )
+        if "opt_state_bytes_per_chip" in result:
+            line["opt_state_bytes_per_chip"] = result["opt_state_bytes_per_chip"]
+        if "overlap_fraction" in result:
+            # The headline of the overlap-scheduled modes: comms time
+            # hidden under backward / total comms time, with the raw
+            # reference step times for the trajectory.
+            line.update(
+                overlap_fraction=result["overlap_fraction"],
+                seq_step_time_ms=result["seq_step_time_ms"],
+                nocomms_step_time_ms=result["nocomms_step_time_ms"],
+            )
     if args.lm:
         # The roofline context travels with the number (review item #4:
         # "tokens/s/chip AND MFU% with the same roofline treatment").

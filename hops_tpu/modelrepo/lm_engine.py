@@ -179,6 +179,10 @@ class _Request:
     # prefix mid-queue. Dense engine: (target_cache,
     # draft_cache_or_None, length); paged engine: a _PagedPrefix.
     prefix: Any = None
+    # The prefix_id this request was submitted under (None = no
+    # prefix): the admission-ordering key that groups same-prefix
+    # requests into one wave so they share cached pages/caches.
+    prefix_key: str | None = None
     # monotonic submit time — the TTFT histogram's start mark.
     submitted_at: float = 0.0
     # Preemption restarts a request from scratch (deterministic
@@ -1397,6 +1401,11 @@ class LMEngine:
             "Admissions by prefix-cache outcome",
             labels=("result",),
         )
+        self._m_prefix_batched = REGISTRY.counter(
+            "hops_tpu_lm_prefix_batched_total",
+            "Requests admitted in a wave with another request sharing "
+            "their prefix (prefix-aware admission ordering)",
+        ).labels()
         # Paged-engine telemetry (registered unconditionally so the
         # metric catalog is one list; the dense engine simply never
         # moves them).
@@ -1567,7 +1576,7 @@ class LMEngine:
                 ticket, prompt, max_new_tokens, eos_id,
                 temperature=float(temperature), top_k=int(top_k or 0),
                 top_p=float(top_p or 0.0), seed=int(seed), prefix=prefix,
-                submitted_at=time.monotonic(),
+                prefix_key=prefix_id, submitted_at=time.monotonic(),
             )
         )
         return ticket
@@ -1589,13 +1598,67 @@ class LMEngine:
         """
         try:
             faultinject.fire("lm_engine.dispatch")
+            self._order_queue_for_prefix_waves()
             if self._paged:
-                return self._step_paged()
-            return self._step_dense()
+                out = self._step_paged()
+            else:
+                out = self._step_dense()
+            self._count_prefix_batched()
+            return out
         except Exception as e:  # noqa: BLE001 — isolate to in-flight work
             return self._fail_inflight(e)
         finally:
             self._admitting.clear()
+
+    def _order_queue_for_prefix_waves(self) -> None:
+        """Prefix-aware admission ordering: stable-group the queue so
+        requests submitted under the same ``prefix_id`` sit adjacent
+        and land in the same admission wave — the wave that can share
+        the cached prefix (paged: page-table refs on the published
+        blocks; dense: copies of one stored cache) instead of straddling
+        waves and re-admitting cold. Groups anchor at their oldest
+        still-queued member and pull forward at most ``slots`` members
+        (one admission wave's worth); later same-prefix arrivals anchor
+        a NEW wave at their own position, so a hot prefix under
+        sustained load can overtake an older request by at most one
+        wave — never starve it. The sort is stable, so relative order
+        inside a wave — and for prefix-less requests — never changes;
+        per-ticket token streams are placement- and company-independent
+        ((seed, n)-keyed sampling), so outputs stay bit-identical to
+        FIFO admission."""
+        if len(self._queue) < 2 or not any(
+            r.prefix_key is not None for r in self._queue
+        ):
+            return
+        q = list(self._queue)  # deque random access is O(n) per element
+        wave_rank: dict[str, int] = {}
+        wave_fill: dict[str, int] = {}
+        ranks = []
+        for pos, req in enumerate(q):
+            key = req.prefix_key
+            if key is None:
+                ranks.append(pos)  # singleton group at its own position
+                continue
+            if wave_fill.get(key, self.slots) >= self.slots:
+                wave_rank[key] = pos  # start a new wave here
+                wave_fill[key] = 0
+            ranks.append(wave_rank[key])
+            wave_fill[key] += 1
+        if all(a <= b for a, b in zip(ranks, ranks[1:])):
+            return  # already wave-grouped — skip the rebuild
+        order = sorted(range(len(ranks)), key=ranks.__getitem__)
+        self._queue = collections.deque(q[i] for i in order)
+
+    def _count_prefix_batched(self) -> None:
+        """Tally requests whose admission wave contained another request
+        sharing their prefix — the prefix-aware ordering's win."""
+        keys: dict[str, int] = {}
+        for req in self._admitting:
+            if req.prefix_key is not None:
+                keys[req.prefix_key] = keys.get(req.prefix_key, 0) + 1
+        batched = sum(c for c in keys.values() if c >= 2)
+        if batched:
+            self._m_prefix_batched.inc(batched)
 
     def _step_dense(self) -> list[int]:
         """One iteration of the dense-cache engine (the seed layout:
@@ -2035,7 +2098,12 @@ class LMEngine:
         for req in self._admitting:
             # Popped from the queue but not yet slotted when the wave
             # died (dense batched admission): fail those too rather
-            # than lose them silently.
+            # than lose them silently. A paged admission that was
+            # PREEMPTED back to the queue within this same dispatch is
+            # still live — it replays next iteration, so failing it
+            # here would hand the client an error AND a later result.
+            if any(r is req for r in self._queue):  # identity: _Request
+                continue  # holds ndarrays, == would be ambiguous
             if req.ticket not in self._errors and req.ticket not in self._results:
                 self._errors[req.ticket] = exc
                 failed.append(req.ticket)
@@ -2155,6 +2223,9 @@ class LMEngine:
             self._pool.ref(blk)
         blocks = shared + new_blocks
         self._queue.popleft()
+        # Wave membership for the prefix-batching tally (slot failures
+        # surface through _slot_state, so _fail_inflight skips these).
+        self._admitting.append(req)
         self._pages_np[row, :] = 0
         self._pages_np[row, : len(blocks)] = blocks
         self._pages_dirty = True

@@ -65,8 +65,12 @@ def make_train_step(
 
     With a ``grad_comms`` config (``parallel.grad_comms.GradCommsConfig``)
     the step takes explicit control of gradient synchronization —
-    bucketed/quantized all-reduce or the ZeRO-1 sharded update — and
-    must then run inside ``shard_map`` over ``axis_name``, which
+    bucketed/quantized all-reduce (optionally overlap-scheduled: each
+    leaf's collective launches inside backward via VJP hooks), the
+    ZeRO-1 sharded update, ZeRO-2 (gradients reduce-scattered as
+    produced, optimizer on shards), or ZeRO-3 (params sharded at rest;
+    the state must come from ``grad_comms.zero3_init``) — and must then
+    run inside ``shard_map`` over ``axis_name``, which
     ``Strategy.step(fn, grad_comms=cfg)`` arranges. Metrics and
     BatchNorm updates are pmean'd across the axis on that path.
     """
@@ -76,6 +80,16 @@ def make_train_step(
         has_bn = bool(getattr(state, "batch_stats", None))
 
         def compute_loss(params):
+            if grad_comms is not None:
+                # Mode-specific view of the differentiated argument:
+                # overlap/zero2 install the during-backward collective
+                # hooks; zero3 gathers the resident shards on demand.
+                from hops_tpu.parallel import grad_comms as gc
+
+                params = gc.prepare_params(
+                    params, grad_comms, axis_name,
+                    meta=getattr(state, "meta", None),
+                )
             variables = {"params": params}
             if has_bn:
                 variables["batch_stats"] = state.batch_stats

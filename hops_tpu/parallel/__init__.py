@@ -13,6 +13,10 @@ from hops_tpu.parallel.grad_comms import (  # noqa: F401
     all_reduce_grads,
     psum_quantized,
     sharded_apply_gradients,
+    tag_backward_comms,
+    zero2_apply_gradients,
+    zero3_init,
+    zero3_unshard,
 )
 from hops_tpu.parallel.tp_inference import (  # noqa: F401
     tp_generate,

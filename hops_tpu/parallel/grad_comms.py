@@ -34,6 +34,18 @@ control of that traffic with three composable optimizations:
    large per-dtype buffers so per-collective launch overhead is
    amortized and block quantization sees long runs.
 
+4. **Overlap scheduling + ZeRO-2/3** (arXiv:1909.09756's
+   comms-under-backward recipe): per-leaf ``custom_vjp`` hooks
+   (:func:`tag_backward_comms`) launch each gradient's collective the
+   moment backward produces it — ``overlap`` all-reduces (bit-identical
+   to the sequential path), ``zero2`` reduce-scatters so gradients stay
+   sharded from birth and the optimizer runs on shards
+   (:func:`zero2_apply_gradients`), and ``zero3``
+   (:func:`zero3_init` / :func:`zero3_unshard`) keeps parameters and
+   moments 1/N-sharded at rest with on-demand per-leaf all-gather whose
+   autodiff transpose IS the as-ready reduce-scatter. All exact for
+   elementwise optimizers; all composing with the quantized wire.
+
 Everything here runs inside ``shard_map`` over the strategy's data
 axis — ``Strategy.step(fn, grad_comms=cfg)`` does the wrapping, and
 ``models.common.make_train_step(grad_comms=cfg)`` builds a step that
@@ -75,31 +87,79 @@ class GradCommsConfig:
     Passing any config (even the default) to ``Strategy.step`` /
     ``make_train_step`` switches the step from XLA's implicit gradient
     AllReduce to the explicit bucketed collectives in this module;
-    ``quantize`` and ``update_sharding`` then select the optimizations.
-    Hashable (frozen) so compiled steps memoize per config.
+    ``quantize``, ``overlap`` and ``update_sharding`` then select the
+    optimizations. Hashable (frozen) so compiled steps memoize per
+    config.
+
+    ``update_sharding`` picks the ZeRO stage of the weight update:
+
+    - ``"replicated"``   — every replica runs the full update (stage 0);
+    - ``"cross_replica"``— ZeRO-1: reduce-scatter grads at update time,
+      optimizer on each replica's 1/N bucket slice, all-gather params;
+    - ``"zero2"``        — gradients are reduce-scattered *during
+      backward* by per-leaf VJP hooks (never materialized reduced in
+      full), optimizer runs on the shards;
+    - ``"zero3"``        — parameters live sharded at rest
+      (:func:`zero3_init`); the step all-gathers them per leaf before
+      the forward and autodiff transposes that gather into the
+      bucket-as-ready reduce-scatter during backward.
+
+    ``overlap=True`` (stage-0 only) swaps the post-backward bucketed
+    all-reduce for per-leaf VJP hooks, so each gradient's collective is
+    launched the moment backward produces it and XLA's latency-hiding
+    scheduler can run it under the remaining backward compute.
+    ``zero2``/``zero3`` overlap by construction.
+
+    ``local_only=True`` is the bench's timing reference: the step runs
+    the explicit-path machinery but skips every cross-replica
+    reduction (training diverges per device — measurement only).
     """
 
     quantize: bool = False
-    update_sharding: str = "replicated"  # "replicated" | "cross_replica"
+    update_sharding: str = "replicated"  # replicated|cross_replica|zero2|zero3
     qdtype: Any = jnp.int8  # int8 (block-scaled) or bfloat16 (cast-only)
     block_size: int = 256
     bucket_bytes: int = DEFAULT_BUCKET_BYTES
+    overlap: bool = False
+    local_only: bool = False  # bench-only: no reduction (compute-time probe)
 
     def __post_init__(self):
-        if self.update_sharding not in ("replicated", "cross_replica"):
+        if self.update_sharding not in (
+            "replicated", "cross_replica", "zero2", "zero3"
+        ):
             raise ValueError(
-                f"update_sharding must be 'replicated' or 'cross_replica', "
-                f"got {self.update_sharding!r}"
+                f"update_sharding must be one of 'replicated', "
+                f"'cross_replica', 'zero2', 'zero3', got "
+                f"{self.update_sharding!r}"
             )
+        if self.overlap and self.update_sharding != "replicated":
+            raise ValueError(
+                "overlap=True applies to the replicated update only; "
+                "zero2/zero3 overlap by construction and zero1 "
+                "(cross_replica) reduce-scatters at update time"
+            )
+        if self.local_only and (self.overlap or self.update_sharding != "replicated"):
+            raise ValueError("local_only is a bench timing reference; "
+                             "combine it with nothing")
+
+    @property
+    def zero_stage(self) -> int:
+        """0 (replicated) / 1 (cross_replica) / 2 / 3."""
+        return {"replicated": 0, "cross_replica": 1,
+                "zero2": 2, "zero3": 3}[self.update_sharding]
 
     @property
     def mode(self) -> str:
-        """Human/flag name: allreduce | quantized | zero1 | quantized+zero1."""
+        """Human/flag name, e.g. allreduce | quantized+overlap | zero3."""
+        if self.local_only:
+            return "local"
         parts = []
         if self.quantize:
             parts.append("quantized")
-        if self.update_sharding == "cross_replica":
-            parts.append("zero1")
+        if self.overlap:
+            parts.append("overlap")
+        if self.zero_stage:
+            parts.append(f"zero{self.zero_stage}")
         return "+".join(parts) or "allreduce"
 
     @classmethod
@@ -112,8 +172,14 @@ class GradCommsConfig:
         known = {
             "allreduce": cls(),
             "quantized": cls(quantize=True),
+            "overlap": cls(overlap=True),
+            "quantized+overlap": cls(quantize=True, overlap=True),
             "zero1": cls(update_sharding="cross_replica"),
             "quantized+zero1": cls(quantize=True, update_sharding="cross_replica"),
+            "zero2": cls(update_sharding="zero2"),
+            "quantized+zero2": cls(quantize=True, update_sharding="zero2"),
+            "zero3": cls(update_sharding="zero3"),
+            "quantized+zero3": cls(quantize=True, update_sharding="zero3"),
         }
         if mode not in known:
             raise ValueError(
@@ -375,12 +441,175 @@ def sharded_apply_gradients(
         shard = lax.psum_scatter(buf, axis_name, scatter_dimension=0, tiled=True)
         gshards.append(shard / n)
 
-    # 2. Slice the same flat layout out of params and the param-shaped
-    #    optimizer-state subtrees (no communication: state is replicated).
-    #    The params layout is kept for the unflatten in step 4: grads
-    #    may arrive in a different dtype (bf16 comms casts), and the
-    #    grads layout's dtypes would silently downcast the params.
-    pbufs, playout = flatten_buckets(state.params, cfg.bucket_bytes, pad_multiple=n)
+    # 2-4. Sharded optimizer tail on the same per-dtype bucket layout.
+    #    The params layout drives the unflatten: grads may arrive in a
+    #    different dtype (bf16 comms casts), and the grads layout's
+    #    dtypes would silently downcast the params.
+    return _sharded_state_update(
+        state, gshards,
+        lambda t: flatten_buckets(t, cfg.bucket_bytes, pad_multiple=n),
+        axis_name, n, idx, extra,
+    )
+
+
+# -- overlap hooks: collectives launched during backward ----------------------
+#
+# The compute-then-communicate paths above fence every collective behind
+# the full backward pass. The hooks here restore the TPU-v3 pods
+# overlap recipe (arXiv:1909.09756 §3): each parameter leaf is wrapped
+# in an identity ``custom_vjp`` whose backward rule runs that leaf's
+# collective, so the reduce lands in the backward graph exactly where
+# autodiff produces the gradient. Each leaf is its own ready-bucket and
+# the bucket-ready schedule IS the gradient production order (reverse
+# forward order) — XLA's latency-hiding scheduler interleaves the
+# collectives with the remaining backward compute instead of running
+# them all after it. Values are bit-identical to the post-backward
+# reduction: psum is elementwise, so per-leaf vs per-dtype-bucket
+# grouping cannot change a single bit.
+
+
+def _overlap_psum_hook(axis_name: Any, cfg: GradCommsConfig) -> Callable[[Any], Any]:
+    """Identity whose VJP all-reduces (optionally quantized) and means
+    the cotangent — the bucket-as-ready replacement for
+    :func:`all_reduce_grads`."""
+
+    @jax.custom_vjp
+    def tag(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        n = lax.psum(1, axis_name)
+        if n == 1:
+            return (g,)
+        if not jnp.issubdtype(g.dtype, jnp.floating):
+            return (lax.psum(g, axis_name),)
+        if cfg.quantize:
+            r = psum_quantized(
+                g, axis_name, block_size=cfg.block_size, qdtype=cfg.qdtype
+            )
+        else:
+            r = lax.psum(g, axis_name)
+        return (r / n,)
+
+    tag.defvjp(fwd, bwd)
+    return tag
+
+
+def _scatter_shard_hook(axis_name: Any, cfg: GradCommsConfig) -> Callable[[Any], Any]:
+    """Identity whose VJP reduce-scatters the cotangent as soon as it is
+    produced (ZeRO-2/3 wire schedule): each replica keeps only its own
+    1/N mean-gradient slice, returned embedded at its flat offset in an
+    otherwise-zero leaf-shaped buffer (the cotangent must match the
+    primal shape). :func:`extract_grad_shards` recovers the slices; the
+    off-shard zeros are never read. Only the reduce-scatter touches the
+    wire — the gradient is never all-gathered."""
+
+    @jax.custom_vjp
+    def tag(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        n = lax.psum(1, axis_name)
+        if n == 1:
+            return (g,)
+        idx = lax.axis_index(axis_name)
+        shape, size, dtype = g.shape, g.size, g.dtype
+        flat = g.reshape(-1)
+        pad = (-size) % n
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
+        if cfg.quantize and jnp.issubdtype(dtype, jnp.floating):
+            flat = _wire(flat, cfg.block_size, cfg.qdtype)
+        shard = lax.psum_scatter(flat, axis_name, scatter_dimension=0, tiled=True)
+        if jnp.issubdtype(dtype, jnp.floating):
+            shard = shard / n
+        m = flat.shape[0] // n
+        out = lax.dynamic_update_slice(jnp.zeros_like(flat), shard, (idx * m,))
+        # Positions >= size are block padding whose reduced value is 0,
+        # so truncating back to the leaf shape loses nothing — the
+        # extractor re-pads with the same zeros.
+        return (out[:size].reshape(shape),)
+
+    tag.defvjp(fwd, bwd)
+    return tag
+
+
+def _wire_cotangent_hook(cfg: GradCommsConfig) -> Callable[[Any], Any]:
+    """Identity whose VJP quantize→dequantizes the cotangent — the
+    EQuARX hop-1 wire format for the ZeRO-3 path, where the
+    reduce-scatter itself is autodiff's transpose of the parameter
+    all-gather and can't be swapped out."""
+
+    @jax.custom_vjp
+    def tag(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        if jnp.issubdtype(g.dtype, jnp.floating):
+            return (_wire(g, cfg.block_size, cfg.qdtype),)
+        return (g,)
+
+    tag.defvjp(fwd, bwd)
+    return tag
+
+
+def tag_backward_comms(params: Any, axis_name: Any, cfg: GradCommsConfig) -> Any:
+    """Wrap every param leaf so its gradient collective launches during
+    backward (``overlap`` → all-reduce hooks, ``zero2`` →
+    reduce-scatter hooks). Call INSIDE the differentiated function on
+    the argument being differentiated."""
+    if cfg.local_only:
+        return params
+    hook = (
+        _scatter_shard_hook(axis_name, cfg)
+        if cfg.update_sharding in ("zero2", "zero3")
+        else _overlap_psum_hook(axis_name, cfg)
+    )
+    return jax.tree.map(hook, params)
+
+
+# -- ZeRO-2: sharded gradients + sharded update --------------------------------
+
+
+def _per_leaf_buffers(tree: Any, n: int) -> tuple[list[jax.Array], BucketLayout]:
+    """Per-leaf flat buffers padded to the replica count — the shared
+    layout of the scatter hooks, the ZeRO-2 update, and the ZeRO-3
+    state (bucket_bytes=1 closes every bucket after one leaf)."""
+    return flatten_buckets(tree, bucket_bytes=1, pad_multiple=n)
+
+
+def extract_grad_shards(grads: Any, n: int, idx: jax.Array) -> list[jax.Array]:
+    """Recover each replica's owned slices from scatter-hook cotangents
+    (shard values at the flat offset, zeros elsewhere). A local slice —
+    no communication."""
+    bufs, _ = _per_leaf_buffers(grads, n)
+    return [_shard_slice(b, n, idx) for b in bufs]
+
+
+def _sharded_state_update(
+    state: Any,
+    gshards: list[jax.Array],
+    flatten_fn: Callable[[Any], tuple[list[jax.Array], BucketLayout]],
+    axis_name: Any,
+    n: int,
+    idx: jax.Array,
+    extra: dict[str, Any],
+) -> Any:
+    """Shared ZeRO-1/2 tail: optimizer on the 1/N flat shards of params
+    and param-shaped optimizer state, params (and moments, to keep the
+    replicated state contract) all-gathered back. ``flatten_fn`` fixes
+    the flat layout — per-dtype buckets for ZeRO-1, per-leaf buffers
+    for ZeRO-2 (must match how ``gshards`` was produced)."""
+    pbufs, playout = flatten_fn(state.params)
     pshards = [_shard_slice(b, n, idx) for b in pbufs]
     is_param_like = _param_subtree_pred(state.params)
     opt_vals, opt_def = jax.tree.flatten(state.opt_state, is_leaf=is_param_like)
@@ -388,7 +617,7 @@ def sharded_apply_gradients(
     opt_shards, opt_layouts = [], []
     for val, flag in zip(opt_vals, opt_flags):
         if flag:
-            bufs, vlayout = flatten_buckets(val, cfg.bucket_bytes, pad_multiple=n)
+            bufs, vlayout = flatten_fn(val)
             opt_shards.append([_shard_slice(b, n, idx) for b in bufs])
             opt_layouts.append(vlayout)
         else:
@@ -396,18 +625,13 @@ def sharded_apply_gradients(
             opt_layouts.append(None)
     opt_state_shard = jax.tree.unflatten(opt_def, opt_shards)
 
-    # 3. Optimizer update on the shard only — 1/N of the math.
     updates, new_opt_shard = state.tx.update(gshards, opt_state_shard, pshards)
     new_pshards = jax.tree.map(lambda p, u: p + u.astype(p.dtype), pshards, updates)
 
-    # 4. All-gather updated params (and moments, to keep the state
-    #    contract replicated) and restore the original tree layout.
     new_params = unflatten_buckets(
         [lax.all_gather(s, axis_name, tiled=True) for s in new_pshards], playout
     )
     new_opt_vals = []
-    # flatten_up_to keeps each leaf slot's value intact (a param-shaped
-    # slot holds its list of shard buffers).
     for flag, vlayout, new_val in zip(
         opt_flags, opt_layouts, opt_def.flatten_up_to(new_opt_shard)
     ):
@@ -423,6 +647,256 @@ def sharded_apply_gradients(
     )
 
 
+def zero2_apply_gradients(
+    state: Any,
+    grads: Any,
+    axis_name: Any = "data",
+    config: GradCommsConfig | None = None,
+    extra_updates: dict[str, Any] | None = None,
+) -> Any:
+    """ZeRO-2 train-state update: ``grads`` arrived from the scatter
+    hooks already reduce-scattered during backward (shard-in-zeros
+    leaves), so this slices the owned shards locally and runs the
+    ZeRO-1-style sharded optimizer tail — no gradient collective here
+    at all. Exact vs the replicated update for elementwise optimizers,
+    same replicated-in/out state contract as ZeRO-1."""
+    extra = extra_updates or {}
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return state.apply_gradients(grads=grads, **extra)
+    idx = lax.axis_index(axis_name)
+    gshards = extract_grad_shards(grads, n, idx)
+    return _sharded_state_update(
+        state, gshards, lambda t: _per_leaf_buffers(t, n), axis_name, n, idx, extra
+    )
+
+
+# -- ZeRO-3: parameters sharded at rest ----------------------------------------
+
+
+def _flax_struct():
+    from flax import struct
+
+    return struct
+
+
+def _zero3_meta(params: Any, n: int) -> tuple:
+    """Static per-leaf layout: (shape, dtype name, size, padded size) in
+    tree-leaves order — hashable, rides the state as aux data."""
+    meta = []
+    for leaf in jax.tree.leaves(params):
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        padded = size + ((-size) % n)
+        meta.append((tuple(leaf.shape), jnp.dtype(leaf.dtype).name, size, padded))
+    return tuple(meta)
+
+
+def zero3_init(state: Any, mesh: Any, axis_name: Any = "data") -> Any:
+    """Convert a replicated train state into the ZeRO-3 carrier: every
+    param leaf (and its optimizer moments) becomes a flat buffer padded
+    to the replica count and placed sharded ``P(axis_name)`` across the
+    mesh — 1/N parameter + optimizer bytes per chip at rest. Host-side;
+    the inverse is :func:`zero3_unshard`."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    n = math.prod(mesh.shape[a] for a in axes)
+    meta = _zero3_meta(state.params, n)
+    sharded = NamedSharding(mesh, P(axis_name))
+    replicated = NamedSharding(mesh, P())
+
+    def _flat(leaf, m):
+        flat = np.asarray(leaf).reshape(-1)
+        if m[3] != m[2]:
+            flat = np.concatenate([flat, np.zeros((m[3] - m[2],), flat.dtype)])
+        return jax.device_put(flat, sharded)
+
+    leaves = jax.tree.leaves(state.params)
+    shard_params = jax.tree.unflatten(
+        jax.tree.structure(state.params),
+        [_flat(l, m) for l, m in zip(leaves, meta)],
+    )
+    # The INCOMING optimizer state converts leaf-for-leaf (param-shaped
+    # moments flatten/pad/shard exactly like params, scalars like
+    # Adam's count replicate) — a mid-training state resumes on the
+    # same trajectory instead of silently re-warming zeroed moments.
+    # Padding regions are zeros and only ever see zero gradients, so
+    # they stay inert.
+    is_param_like = _param_subtree_pred(state.params)
+    opt_vals, opt_def = jax.tree.flatten(state.opt_state, is_leaf=is_param_like)
+    conv_vals = []
+    for v in opt_vals:
+        if is_param_like(v):
+            vl = jax.tree.leaves(v)
+            conv_vals.append(jax.tree.unflatten(
+                jax.tree.structure(v),
+                [_flat(l, m) for l, m in zip(vl, meta)],
+            ))
+        else:
+            conv_vals.append(jax.device_put(v, replicated))
+    opt_state = jax.tree.unflatten(opt_def, conv_vals)
+    cls = _make_zero3_state_cls()
+    return cls(
+        step=jax.device_put(state.step, replicated),
+        apply_fn=state.apply_fn,
+        params=shard_params,
+        tx=state.tx,
+        opt_state=opt_state,
+        rng=(
+            jax.device_put(state.rng, replicated)
+            if getattr(state, "rng", None) is not None else None
+        ),
+        batch_stats=(
+            jax.device_put(state.batch_stats, replicated)
+            if getattr(state, "batch_stats", None) else None
+        ),
+        meta=meta,
+    )
+
+
+_ZERO3_CLS = None
+
+
+def _make_zero3_state_cls():
+    """The ZeRO-3 state carrier (built lazily so flax import stays at
+    call time): a TrainState twin whose ``params``/``opt_state`` leaves
+    are flat 1/N shards; ``meta`` (static) remembers the dense layout."""
+    global _ZERO3_CLS
+    if _ZERO3_CLS is None:
+        struct = _flax_struct()
+
+        class Zero3TrainState(struct.PyTreeNode):
+            step: Any
+            apply_fn: Callable = struct.field(pytree_node=False)
+            params: Any = None
+            tx: Any = struct.field(pytree_node=False, default=None)
+            opt_state: Any = None
+            rng: Any = None
+            batch_stats: Any = None
+            meta: Any = struct.field(pytree_node=False, default=())
+
+        _ZERO3_CLS = Zero3TrainState
+    return _ZERO3_CLS
+
+
+def zero3_gather_params(shard_params: Any, meta: tuple, axis_name: Any) -> Any:
+    """All-gather the flat shards back into dense param leaves — the
+    on-demand materialization before forward/backward. Runs inside
+    ``shard_map``; autodiff transposes each tiled all-gather into a
+    tiled reduce-scatter, which is exactly the ZeRO-3 backward wire
+    schedule, launched per leaf as backward produces its gradient."""
+    leaves = jax.tree.leaves(shard_params)
+    treedef = jax.tree.structure(shard_params)
+    out = []
+    for leaf, (shape, dtype, size, _padded) in zip(leaves, meta):
+        full = lax.all_gather(leaf, axis_name, tiled=True)
+        out.append(full[:size].reshape(shape).astype(dtype))
+    return jax.tree.unflatten(treedef, out)
+
+
+def zero3_apply_gradients(
+    state: Any,
+    shard_grads: Any,
+    extra_updates: dict[str, Any] | None = None,
+) -> Any:
+    """ZeRO-3 update: gradients arrive as the local flat shards (the
+    transpose of :func:`zero3_gather_params`), the optimizer runs on
+    the resident shards, and nothing is gathered back — the next step's
+    forward re-gathers on demand."""
+    extra = extra_updates or {}
+    updates, new_opt = state.tx.update(shard_grads, state.opt_state, state.params)
+    new_params = jax.tree.map(
+        lambda p, u: p + u.astype(p.dtype), state.params, updates
+    )
+    return state.replace(
+        step=state.step + 1, params=new_params, opt_state=new_opt, **extra
+    )
+
+
+def zero3_unshard(state: Any) -> Any:
+    """Host-side inverse of :func:`zero3_init` for eval / checkpoint
+    export: dense replicated params (and param-shaped moments) from the
+    flat shard state. Returns ``(params, opt_state)`` pytrees."""
+    leaves = jax.tree.leaves(state.params)
+    treedef = jax.tree.structure(state.params)
+
+    def _dense(flat, m):
+        return np.asarray(flat)[: m[2]].reshape(m[0]).astype(m[1])
+
+    params = jax.tree.unflatten(
+        treedef, [_dense(l, m) for l, m in zip(leaves, state.meta)]
+    )
+    is_param_like = _param_subtree_pred(state.params)
+    opt_vals, opt_def = jax.tree.flatten(state.opt_state, is_leaf=is_param_like)
+    out_vals = []
+    for v in opt_vals:
+        if is_param_like(v):
+            vl = jax.tree.leaves(v)
+            out_vals.append(jax.tree.unflatten(
+                jax.tree.structure(v),
+                [_dense(l, m) for l, m in zip(vl, state.meta)],
+            ))
+        else:
+            out_vals.append(v)
+    return params, jax.tree.unflatten(opt_def, out_vals)
+
+
+def zero3_state_specs(state: Any, axis_name: Any = "data") -> Any:
+    """PartitionSpec tree for a ZeRO-3 state under ``shard_map``: flat
+    param/moment shards split over the data axis, scalars (step, Adam
+    count, rng, batch_stats) replicated. ``Strategy.step`` derives its
+    in/out specs from this on first call."""
+    from jax.sharding import PartitionSpec as P
+
+    p_specs = jax.tree.map(lambda _: P(axis_name), state.params)
+    is_param_like = _param_subtree_pred(state.params)
+    opt_vals, opt_def = jax.tree.flatten(state.opt_state, is_leaf=is_param_like)
+    opt_specs = jax.tree.unflatten(
+        opt_def,
+        [
+            jax.tree.map(lambda _: P(axis_name), v)
+            if is_param_like(v)
+            else jax.tree.map(lambda _: P(), v)
+            for v in opt_vals
+        ],
+    )
+    # tree.map mirrors structure exactly (None stays None, {} stays {}),
+    # which the shard_map spec tree must do too.
+    return state.replace(
+        step=P(),
+        params=p_specs,
+        opt_state=opt_specs,
+        rng=jax.tree.map(lambda _: P(), state.rng),
+        batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
+    )
+
+
+# -- mode dispatch -------------------------------------------------------------
+
+
+def prepare_params(params: Any, config: GradCommsConfig, axis_name: Any,
+                   meta: tuple | None = None) -> Any:
+    """Per-mode parameter view for the loss function — call INSIDE the
+    differentiated function on the argument being differentiated.
+    Stage 0/1 without overlap: identity (reduction happens at update
+    time). ``overlap``/``zero2``: backward hooks. ``zero3``: ``params``
+    are the flat shards; gather them (and install the quantized-wire
+    cotangent hook when asked)."""
+    if config.local_only:
+        return params
+    if config.update_sharding == "zero3":
+        if meta is None:
+            raise ValueError("zero3 needs the state's layout meta "
+                             "(build the state with zero3_init)")
+        full = zero3_gather_params(params, meta, axis_name)
+        if config.quantize:
+            full = jax.tree.map(_wire_cotangent_hook(config), full)
+        return full
+    if config.overlap or config.update_sharding == "zero2":
+        return tag_backward_comms(params, axis_name, config)
+    return params
+
+
 def apply_gradients(
     state: Any,
     grads: Any,
@@ -430,14 +904,31 @@ def apply_gradients(
     axis_name: Any = "data",
     extra_updates: dict[str, Any] | None = None,
 ) -> Any:
-    """Explicit-comms replacement for ``TrainState.apply_gradients``:
-    dispatches to the ZeRO-1 sharded update or to bucketed (quantized)
-    all-reduce + replicated update, per ``config``."""
+    """Explicit-comms replacement for ``TrainState.apply_gradients``.
+    ``grads`` must come from differentiating a loss whose params went
+    through :func:`prepare_params` with the same config; their meaning
+    is mode-dependent (raw per-replica for stage 0/1, reduced for
+    overlap, scattered for zero2, shard-shaped for zero3)."""
     extra = extra_updates or {}
+    if config.local_only:
+        return state.apply_gradients(grads=grads, **extra)
+    if config.update_sharding == "zero3":
+        n = lax.psum(1, axis_name)
+        shard_grads = jax.tree.map(
+            lambda g: g / n if jnp.issubdtype(g.dtype, jnp.floating) else g,
+            grads,
+        )
+        return zero3_apply_gradients(state, shard_grads, extra_updates=extra)
+    if config.update_sharding == "zero2":
+        return zero2_apply_gradients(
+            state, grads, axis_name, config, extra_updates=extra
+        )
     if config.update_sharding == "cross_replica":
         return sharded_apply_gradients(
             state, grads, axis_name, config, extra_updates=extra
         )
+    if config.overlap:  # hooks already reduced + meaned during backward
+        return state.apply_gradients(grads=grads, **extra)
     grads = all_reduce_grads(grads, axis_name, config, mean=True)
     return state.apply_gradients(grads=grads, **extra)
 

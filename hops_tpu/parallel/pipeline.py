@@ -433,6 +433,230 @@ def pipelined_lm_apply(
     return (logits, aux) if return_aux else logits
 
 
+# -- explicit schedules: gpipe / 1F1B / interleaved ---------------------------
+
+
+def _scheduled_lm_loss_and_grads(
+    model: Any,
+    mesh: Mesh,
+    axis: str,
+    sched: Any,
+) -> Callable[[Any, jax.Array, jax.Array], tuple[jax.Array, Any]]:
+    """Build the explicit tick-program forward/backward for a dense
+    ``TransformerLM`` under a :class:`~hops_tpu.parallel.pp_schedule.
+    PipelineSchedule`: per tick each device runs (at most) one stage
+    forward and one stage backward-VJP, activations/cotangents hop the
+    rotated ring, the last virtual stage computes the per-microbatch
+    loss + cotangent seed the moment a microbatch's forward finishes,
+    and per-chunk param grads accumulate microbatch-ascending — the
+    accumulation-order invariant that makes every schedule's gradients
+    bit-identical. Returns ``fn(params, inputs, targets) -> (loss,
+    grads)`` with ``grads`` shaped like the dense param tree.
+    """
+    import optax
+    from flax import linen as nn
+
+    from hops_tpu.models.transformer import Block, RMSNorm
+
+    S, v, V, m = sched.n_stages, sched.v, sched.n_virtual, sched.num_microbatches
+    if model.moe_every:
+        raise NotImplementedError(
+            "explicit pipeline schedules support dense TransformerLMs; "
+            "MoE pipelines use the autodiff ring (schedule=None)")
+    if model.num_layers % V:
+        raise ValueError(
+            f"{model.num_layers} layers not divisible by {V} virtual "
+            f"stages ({S} stages x {v} chunks)")
+    K = model.num_layers // V
+
+    block = Block(
+        model.num_heads, dtype=model.dtype,
+        attention_impl=model.attention_impl, dropout_rate=0.0,
+        num_kv_heads=model.num_kv_heads,
+        kv_cache_dtype=model.kv_cache_dtype, window=model.window,
+    )
+    embed = nn.Embed(model.vocab_size, model.d_model, dtype=model.dtype)
+    norm = RMSNorm(dtype=model.dtype)
+    unembed = nn.Dense(model.vocab_size, dtype=model.dtype, use_bias=False)
+
+    def stage_fn(stage_params, h):
+        def body(h, layer_params):
+            return block.apply({"params": layer_params}, h), None
+
+        h, _ = jax.lax.scan(body, h, stage_params)
+        return h
+
+    def emit_loss(emit_p, h, tgt):
+        logits = unembed.apply(
+            {"params": emit_p["unembed"]},
+            norm.apply({"params": emit_p["final_norm"]}, h),
+        ).astype(jnp.float32)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, tgt).mean()
+
+    # Static per-tick tables, uploaded once.
+    jf_c, jf_m = jnp.asarray(sched.f_chunk), jnp.asarray(sched.f_mb)
+    jb_c, jb_m = jnp.asarray(sched.b_chunk), jnp.asarray(sched.b_mb)
+    jif_c, jif_m = jnp.asarray(sched.in_f_chunk), jnp.asarray(sched.in_f_mb)
+    jib_c, jib_m = jnp.asarray(sched.in_b_chunk), jnp.asarray(sched.in_b_mb)
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def local_fn(stacked, embed_p, emit_p, tokens, targets):
+        params = jax.tree.map(lambda p: p[0], stacked)  # (v, K, ...)
+        s = jax.lax.axis_index(axis)
+        b, t_len = tokens.shape
+        mb_b = b // m
+        emb_all = embed.apply({"params": embed_p}, tokens)
+        d_model = emb_all.shape[-1]
+        micro_h = emb_all.reshape(m, mb_b, t_len, d_model)
+        micro_tok = tokens.reshape(m, mb_b, t_len)
+        micro_tgt = targets.reshape(m, mb_b, t_len)
+
+        # Virtual stage 0's inputs are pre-seeded; everything else
+        # arrives over the ring and is stored as it lands.
+        base = jnp.zeros((v, m, mb_b, t_len, d_model), emb_all.dtype)
+        acts = jnp.where(s == 0, base.at[0].set(micro_h), base)
+        cts = _pvary(jnp.zeros_like(base), (axis,))
+        gacc = jax.tree.map(
+            lambda p: _pvary(jnp.zeros_like(p), (axis,)), params)
+        emb_gacc = jax.tree.map(
+            lambda p: _pvary(jnp.zeros_like(p), (axis,)), embed_p)
+        emit_gacc = jax.tree.map(
+            lambda p: _pvary(jnp.zeros_like(p), (axis,)), emit_p)
+        loss_acc = _pvary(jnp.zeros((), jnp.float32), (axis,))
+        fwd_in = bwd_in = None
+
+        def put(buf, val, c, mb):
+            return jax.lax.dynamic_update_slice(
+                buf, val[None, None].astype(buf.dtype),
+                (c, mb, 0, 0, 0))
+
+        for t in range(sched.ticks):
+            # 1. integrate what last tick's ring hop delivered
+            if fwd_in is not None and (sched.in_f_chunk[t] >= 0).any():
+                ic, im = jif_c[t][s], jif_m[t][s]
+                stored = put(acts, fwd_in, jnp.clip(ic, 0, v - 1),
+                             jnp.clip(im, 0, m - 1))
+                acts = jnp.where(ic >= 0, stored, acts)
+            if bwd_in is not None and (sched.in_b_chunk[t] >= 0).any():
+                ic, im = jib_c[t][s], jib_m[t][s]
+                stored = put(cts, bwd_in, jnp.clip(ic, 0, v - 1),
+                             jnp.clip(im, 0, m - 1))
+                cts = jnp.where(ic >= 0, stored, cts)
+
+            # 2. forward slot
+            if (sched.f_chunk[t] >= 0).any():
+                fc = jnp.clip(jf_c[t][s], 0, v - 1)
+                fm = jnp.clip(jf_m[t][s], 0, m - 1)
+                fvalid = jf_c[t][s] >= 0
+                h_in = acts[fc, fm]
+                params_c = jax.tree.map(lambda p: p[fc], params)
+                h_out = stage_fn(params_c, h_in)
+                # Only the last virtual stage can emit this tick, and
+                # that is statically known from the table.
+                if sched.f_chunk[t][S - 1] == v - 1:
+                    is_last = fvalid & (s == S - 1) & (jf_c[t][s] == v - 1)
+                    tgt = micro_tgt[fm]
+                    loss_mb, evjp = jax.vjp(
+                        lambda ep, h: emit_loss(ep, h, tgt), emit_p, h_out)
+                    d_ep, d_h = evjp(jnp.asarray(1.0 / m, jnp.float32))
+                    loss_acc = loss_acc + jnp.where(
+                        is_last, loss_mb / m, 0.0)
+                    emit_gacc = jax.tree.map(
+                        lambda a, d: a + jnp.where(is_last, d, 0.0),
+                        emit_gacc, d_ep)
+                    cts = jnp.where(is_last, put(cts, d_h, fc, fm), cts)
+                fwd_msg = h_out
+            else:
+                fwd_msg = None
+
+            # 3. backward slot
+            if (sched.b_chunk[t] >= 0).any():
+                bc = jnp.clip(jb_c[t][s], 0, v - 1)
+                bm = jnp.clip(jb_m[t][s], 0, m - 1)
+                bvalid = jb_c[t][s] >= 0
+                g_in = cts[bc, bm]
+                h_saved = acts[bc, bm]
+                params_b = jax.tree.map(lambda p: p[bc], params)
+                _, svjp = jax.vjp(stage_fn, params_b, h_saved)
+                d_p, d_hin = svjp(g_in)
+                gacc = jax.tree.map(
+                    lambda a, d: a.at[bc].add(
+                        jnp.where(bvalid, d, jnp.zeros_like(d))),
+                    gacc, d_p)
+                # Virtual stage 0's input cotangent feeds the embed.
+                if sched.b_chunk[t][0] == 0:
+                    is_first = bvalid & (s == 0) & (jb_c[t][s] == 0)
+                    tok = micro_tok[bm]
+                    _, ev = jax.vjp(
+                        lambda ep: embed.apply({"params": ep}, tok), embed_p)
+                    (d_emb,) = ev(d_hin.astype(emb_all.dtype))
+                    emb_gacc = jax.tree.map(
+                        lambda a, d: a + jnp.where(is_first, d, 0.0),
+                        emb_gacc, d_emb)
+                bwd_msg = d_hin
+            else:
+                bwd_msg = None
+
+            # 4. one ring hop each way
+            fwd_in = (
+                jax.lax.ppermute(fwd_msg, axis, fwd_perm)
+                if fwd_msg is not None else None
+            )
+            bwd_in = (
+                jax.lax.ppermute(bwd_msg, axis, bwd_perm)
+                if bwd_msg is not None else None
+            )
+
+        loss = jax.lax.psum(loss_acc, axis)
+        emb_g = jax.tree.map(lambda g: jax.lax.psum(g, axis), emb_gacc)
+        emit_g = jax.tree.map(lambda g: jax.lax.psum(g, axis), emit_gacc)
+        gacc = jax.tree.map(lambda g: g[None], gacc)  # (1, v, K, ...)
+        return loss, gacc, emb_g, emit_g
+
+    shard_fn = shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(P(axis), P(), P(), P(), P()),
+        out_specs=(P(), P(axis), P(), P()),
+        check_rep=False,
+    )
+
+    def loss_and_grads(params, inputs, targets):
+        per_vs = [
+            jax.tree.map(
+                lambda *xs: jnp.stack(xs),
+                *[params[f"block_{vs * K + k}"] for k in range(K)],
+            )
+            for vs in range(V)
+        ]
+        # Device s holds chunks j = 0..v-1 as virtual stages j*S + s.
+        dev_trees = [
+            jax.tree.map(
+                lambda *xs: jnp.stack(xs),
+                *[per_vs[j * S + s] for j in range(v)],
+            )
+            for s in range(S)
+        ]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *dev_trees)
+        emit_p = {
+            "final_norm": params["final_norm"], "unembed": params["unembed"]
+        }
+        loss, g_st, g_emb, g_emit = shard_fn(
+            stacked, params["embed"], emit_p, inputs, targets)
+        grads = {"embed": g_emb, "final_norm": g_emit["final_norm"],
+                 "unembed": g_emit["unembed"]}
+        for vs in range(V):
+            dev, chunk = vs % S, vs // S
+            for k in range(K):
+                grads[f"block_{vs * K + k}"] = jax.tree.map(
+                    lambda g, d=dev, c=chunk, kk=k: g[d, c, kk], g_st
+                )
+        return loss, grads
+
+    return loss_and_grads
+
+
 def make_pp_lm_train_step(
     model: Any,
     mesh: Mesh,
@@ -444,20 +668,62 @@ def make_pp_lm_train_step(
     tp_axis: str | None = None,
     num_microbatches: int | None = None,
     aux_loss_weight: float = 0.01,
+    schedule: str | None = None,
+    virtual_stages: int | None = None,
 ) -> Callable[[Any, dict[str, jax.Array]], tuple[Any, dict[str, jax.Array]]]:
     """Pipelined next-token-prediction train step for a ``TransformerLM``.
 
     Same ``step(state, batch) -> (state, metrics)`` contract as
     ``models.transformer.make_lm_train_step`` (so the experiment
     launchers accept it unchanged), but the forward/backward runs
-    through the GPipe ring — optionally with sp (``seq_axis``) or ep
-    (``expert_axis``) composed inside the stages. Gradients flow
-    through ``ppermute``/``psum`` back to the caller's dense param
-    tree; the optimizer update itself runs on that replicated tree
-    (stage-sharded optimizer state — true ZeRO-style pp memory for the
-    update — is flat-mesh ``ShardedStrategy`` territory).
+    through the pipeline — optionally with sp (``seq_axis``) or ep
+    (``expert_axis``) composed inside the stages. Gradients flow back
+    to the caller's dense param tree; the optimizer update itself runs
+    on that replicated tree (stage-sharded optimizer state — true
+    ZeRO-style pp memory for the update — is flat-mesh
+    ``ShardedStrategy`` territory).
+
+    ``schedule=None`` (default) differentiates through the naive
+    fill-drain GPipe ring (``pipeline_apply``). ``schedule="gpipe" |
+    "1f1b" | "interleaved"`` switches to the explicit tick-program
+    engine (:mod:`hops_tpu.parallel.pp_schedule`): warmup/steady/
+    cooldown phases are explicit, ``interleaved`` runs
+    ``virtual_stages`` (default 2) chunks per device, and all three
+    produce bit-identical losses AND gradients to each other (backward
+    accumulation is microbatch-ascending under every policy — see
+    ``tests/test_pipeline_schedule.py``). Explicit schedules support
+    dense models on a pure ``stage`` mesh; compositions (sp/ep/tp/dp,
+    MoE) stay on the autodiff ring. The factory registers the
+    schedule's bubble fraction on
+    ``hops_tpu_pp_bubble_fraction{schedule=...}``; wrap the returned
+    step with :func:`instrument_pp_step` for per-microbatch wall-time
+    telemetry.
     """
     import optax
+
+    if schedule is not None:
+        if seq_axis or expert_axis or batch_axis or tp_axis:
+            raise NotImplementedError(
+                "explicit schedules (gpipe/1f1b/interleaved) run on a "
+                "pure stage mesh; inner-axis compositions use the "
+                "autodiff ring (schedule=None)")
+        from hops_tpu.parallel.pp_schedule import build_pp_schedule
+
+        m = num_microbatches or mesh.shape[axis]
+        sched = build_pp_schedule(
+            schedule, m, mesh.shape[axis], virtual_stages)
+        _register_pp_schedule_telemetry(sched)
+        loss_and_grads = _scheduled_lm_loss_and_grads(model, mesh, axis, sched)
+
+        def scheduled_train_step(state, batch):
+            tokens = batch["tokens"]
+            inputs, targets = tokens[:, :-1], tokens[:, 1:]
+            loss, grads = loss_and_grads(state.params, inputs, targets)
+            state = state.apply_gradients(grads=grads)
+            return state, {"loss": loss, "perplexity": jnp.exp(loss)}
+
+        scheduled_train_step.pp_schedule = sched
+        return scheduled_train_step
 
     def train_step(state, batch):
         tokens = batch["tokens"]
@@ -484,3 +750,53 @@ def make_pp_lm_train_step(
         return state, {"loss": loss, "perplexity": jnp.exp(loss)}
 
     return train_step
+
+
+def _register_pp_schedule_telemetry(sched: Any) -> None:
+    """Publish the schedule's static bubble model (host-side, factory
+    time — never inside a compiled step)."""
+    from hops_tpu.telemetry import REGISTRY
+
+    REGISTRY.gauge(
+        "hops_tpu_pp_bubble_fraction",
+        "Idle fraction of pipeline work slots for the built schedule",
+        labels=("schedule",),
+    ).set(sched.bubble_fraction, schedule=sched.kind)
+
+
+def instrument_pp_step(
+    step_fn: Callable[..., Any], sched: Any | None = None
+) -> Callable[..., Any]:
+    """Wrap a (compiled) scheduled pipeline step with host-side
+    per-microbatch timing: each call's wall time divided by the
+    schedule's microbatch count feeds
+    ``hops_tpu_pp_microbatch_seconds{schedule=...}``. Wrap OUTSIDE any
+    ``jax.jit`` — this mutates telemetry."""
+    import time
+
+    from hops_tpu.telemetry import REGISTRY
+
+    sched = sched if sched is not None else getattr(step_fn, "pp_schedule", None)
+    if sched is None:
+        raise ValueError(
+            "instrument_pp_step needs the step's PipelineSchedule "
+            "(build the step with make_pp_lm_train_step(schedule=...))")
+    hist = REGISTRY.histogram(
+        "hops_tpu_pp_microbatch_seconds",
+        "Wall time per microbatch of a scheduled pipeline train step",
+        labels=("schedule",),
+        buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                 0.25, 0.5, 1.0, 2.5),
+    )
+
+    def timed(state, batch):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(step_fn(state, batch))
+        hist.observe(
+            (time.perf_counter() - t0) / sched.num_microbatches,
+            schedule=sched.kind,
+        )
+        return out
+
+    timed.pp_schedule = sched
+    return timed

@@ -129,19 +129,27 @@ class Strategy:
                     f"step was asked to run {cfg}; pass the same config to "
                     "make_train_step and Strategy.step"
                 )
-            from jax.experimental.shard_map import shard_map
-
             from hops_tpu.parallel import grad_comms as gc
 
-            inner = shard_map(
-                fn,
-                mesh=self.mesh,
-                in_specs=(P(), P(self.data_axis)),
-                out_specs=(P(), P()),
-                check_rep=False,
-            )
+            if getattr(cfg, "update_sharding", None) == "zero3":
+                # ZeRO-3 states carry per-device DIFFERENT shard leaves,
+                # so the shard_map specs depend on the state's structure
+                # — derived lazily from the first state seen and
+                # memoized per abstract signature.
+                inner_jit = self._zero3_step(fn, donate)
+            else:
+                from jax.experimental.shard_map import shard_map
+
+                inner = shard_map(
+                    fn,
+                    mesh=self.mesh,
+                    in_specs=(P(), P(self.data_axis)),
+                    out_specs=(P(), P()),
+                    check_rep=False,
+                )
+                inner_jit = jax.jit(inner, donate_argnums=donate)
             stepped = gc.instrument_step(
-                jax.jit(inner, donate_argnums=donate),
+                inner_jit,
                 cfg,
                 steps_per_call=getattr(fn, "grad_comms_steps", 1),
             )
@@ -162,6 +170,37 @@ class Strategy:
             )
         self._step_cache[key] = stepped
         return stepped
+
+    def _zero3_step(self, fn: Callable[..., Any], donate: tuple) -> Callable[..., Any]:
+        """Lazy shard_map compile for ZeRO-3 steps: the state's flat
+        param/moment shards ride ``P(data_axis)``, scalars replicate —
+        specs come from ``grad_comms.zero3_state_specs`` on the actual
+        state at first call (and re-derive per state signature)."""
+        from jax.experimental.shard_map import shard_map
+
+        from hops_tpu.parallel import grad_comms as gc
+
+        compiled: dict[Any, Callable[..., Any]] = {}
+
+        def run(state, batch):
+            key = (
+                jax.tree.structure(state),
+                tuple(jax.numpy.shape(l) for l in jax.tree.leaves(state)),
+            )
+            exe = compiled.get(key)
+            if exe is None:
+                specs = gc.zero3_state_specs(state, self.data_axis)
+                inner = shard_map(
+                    fn,
+                    mesh=self.mesh,
+                    in_specs=(specs, P(self.data_axis)),
+                    out_specs=(specs, P()),
+                    check_rep=False,
+                )
+                exe = compiled[key] = jax.jit(inner, donate_argnums=donate)
+            return exe(state, batch)
+
+        return run
 
     def run(self, fn: Callable[..., Any], state: Any, batch: Any) -> Any:
         return self.step(fn)(state, self.distribute_batch(batch))

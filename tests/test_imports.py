@@ -77,6 +77,19 @@ def test_analysis_registered_in_drift_guard():
         assert mod in names
 
 
+def test_pipeline_schedule_registered_in_drift_guard():
+    """The overlap-comms + scheduled-pipeline layer leans on collective
+    and autodiff APIs with rename history (custom_vjp, psum_scatter,
+    ppermute, shard_map specs); pin the modules so a move or rename
+    surfaces as one named failure instead of a silent drop from the
+    parametrized sweep."""
+    names = _module_names()
+    assert "hops_tpu.parallel.pipeline" in names
+    assert "hops_tpu.parallel.pp_schedule" in names
+    assert "hops_tpu.parallel.grad_comms" in names
+    assert "hops_tpu.parallel.strategy" in names
+
+
 def test_loader_registered_in_drift_guard():
     """The parallel input pipeline is the training hot path's host half
     and sits on APIs with rename history (numpy Generator seeding,

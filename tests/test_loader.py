@@ -440,6 +440,37 @@ def test_bench_input_pipeline_threaded_e2e():
     assert line["speedup_vs_sync"] >= 1.5
 
 
+def test_bench_probe_never_hangs_past_deadline_budget(monkeypatch):
+    """The BENCH_r04/r05 wedge, pinned at test timescale: a probe that
+    HANGS (the wedged-relay signature) must bounce off the per-attempt
+    deadline and return (None, 'probe_timeout', ...) within the retry
+    policy's budget — never block the driver open-endedly. The budgets
+    are probe_with_retry parameters precisely so this contract is
+    testable without a 6-minute test."""
+    import importlib.util
+    import time as _time
+
+    root = Path(__file__).resolve().parents[1]
+    spec = importlib.util.spec_from_file_location("_bench_probe", root / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    def hung_probe(timeout_s=120):
+        _time.sleep(10)  # far past every budget below
+        return {"ok": True}
+
+    monkeypatch.setattr(bench, "probe_tpu", hung_probe)
+    t0 = _time.monotonic()
+    health, kind, err = bench.probe_with_retry(
+        attempt_deadline_s=0.3, probe_timeout_s=0.2,
+        total_timeout_s=1.0, base_delay_s=0.05,
+    )
+    elapsed = _time.monotonic() - t0
+    assert health is None
+    assert kind == "probe_timeout"
+    assert elapsed < 5.0, f"probe hung {elapsed:.1f}s past its budget"
+
+
 def test_bench_stale_fallback_never_chains_stale_lines(tmp_path, monkeypatch, capsys):
     """Regression (emit_stale_or_fail): a logged line already flagged
     ``"stale": true`` is a fallback re-emission, not a measurement —
