@@ -585,6 +585,178 @@ def run_input_pipeline_bench(
     }
 
 
+def run_online_store_bench(
+    smoke: bool = False,
+    *,
+    entities: int = 4096,
+    duration_s: float = 6.0,
+    readers: int = 4,
+    shards: int = 8,
+    batch: int = 32,
+    write_rps: float = 400.0,
+) -> dict:
+    """The ``--online-store`` tier: request-time feature joins against
+    the sharded online store under concurrent write-through load.
+
+    Host-only (no accelerator, no relay lock): two preloaded feature
+    groups (users + items), a pubsub producer streaming user updates at
+    ``write_rps`` rows/s, the write-through Materializer tailing the
+    topic, and ``readers`` threads driving batched entity-ID joins
+    through a FeatureJoinPredictor. Reports lookup QPS (point lookups
+    across both groups), join p50/p99 latency, hit rate, and the
+    freshness lag under that concurrent write-through — the serving-
+    path numbers the online subsystem exists to hold down.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from hops_tpu.featurestore.online_serving import (
+        FeatureJoinPredictor,
+        Materializer,
+        ShardedOnlineStore,
+    )
+    from hops_tpu.messaging import pubsub
+    from hops_tpu.runtime import config as rtconfig
+    from hops_tpu.telemetry.metrics import REGISTRY
+
+    if smoke:
+        entities, duration_s, readers, shards, write_rps = 256, 1.5, 2, 4, 100.0
+
+    tmp = Path(tempfile.mkdtemp(prefix="hops_tpu_onlinebench_"))
+    rtconfig.configure(workspace=str(tmp / "ws"), project="bench")
+    rs = np.random.RandomState(0)
+    try:
+        users = ShardedOnlineStore(
+            "bench_users", 1, primary_key=["user_id"], shards=shards
+        )
+        items = ShardedOnlineStore(
+            "bench_items", 1, primary_key=["item_id"], shards=shards
+        )
+        n_items = max(entities // 4, 1)
+        import pandas as pd
+
+        users.put_dataframe(pd.DataFrame({
+            "user_id": np.arange(entities),
+            "u_clicks": rs.rand(entities),
+            "u_spend": rs.rand(entities),
+        }))
+        items.put_dataframe(pd.DataFrame({
+            "item_id": np.arange(n_items),
+            "i_price": rs.rand(n_items),
+            "i_rank": rs.rand(n_items),
+        }))
+
+        topic = "bench-users-updates"
+        pubsub.create_topic(topic)
+        daemon = Materializer(
+            users, topic, event_time="event_time", poll_interval_s=0.005
+        ).start()
+
+        stop = threading.Event()
+
+        def write_through() -> None:
+            prod = pubsub.Producer(topic)
+            wrs = np.random.RandomState(1)
+            period = 1.0 / write_rps
+            while not stop.is_set():
+                uid = int(wrs.randint(0, entities))
+                prod.send({
+                    "user_id": uid,
+                    "u_clicks": float(wrs.rand()),
+                    "u_spend": float(wrs.rand()),
+                    "event_time": time.time(),
+                })
+                stop.wait(period)
+
+        predictor = FeatureJoinPredictor(
+            lambda vectors: vectors,
+            {
+                "groups": [
+                    {"name": "bench_users", "version": 1,
+                     "primary_key": ["user_id"],
+                     "features": ["u_clicks", "u_spend"]},
+                    {"name": "bench_items", "version": 1,
+                     "primary_key": ["item_id"],
+                     "features": ["i_price", "i_rank"]},
+                ],
+                "missing": "default",
+                "shards": shards,
+            },
+            model="bench",
+            stores={"bench_users": users, "bench_items": items},
+        )
+
+        lookup_counter = REGISTRY.counter(
+            "hops_tpu_online_lookup_total", labels=("store", "result"))
+
+        def lookups(result: str) -> float:
+            return sum(
+                lookup_counter.value(store=s, result=result)
+                for s in ("bench_users_1", "bench_items_1")
+            )
+
+        base = {r: lookups(r) for r in ("hit", "miss", "expired", "error")}
+        lat_lock = threading.Lock()
+        join_lat: list[float] = []  # guarded by: lat_lock
+
+        def reader(seed: int) -> None:
+            rrs = np.random.RandomState(100 + seed)
+            while not stop.is_set():
+                entries = [
+                    {"user_id": int(rrs.randint(0, int(entities * 1.02))),
+                     "item_id": int(rrs.randint(0, n_items))}
+                    for _ in range(batch)
+                ]
+                t0 = time.perf_counter()
+                predictor.predict(entries)
+                dt = time.perf_counter() - t0
+                with lat_lock:
+                    join_lat.append(dt)
+
+        writer = threading.Thread(target=write_through, daemon=True)
+        threads = [writer] + [
+            threading.Thread(target=reader, args=(i,), daemon=True)
+            for i in range(readers)
+        ]
+        t_start = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        wall = time.perf_counter() - t_start
+        daemon_lag = users.freshness_lag_s()
+        daemon.stop()
+
+        after = {r: lookups(r) for r in ("hit", "miss", "expired", "error")}
+        delta = {r: after[r] - base[r] for r in after}
+        total = sum(delta.values())
+        lat_ms = np.asarray(join_lat) * 1e3
+        materialized = REGISTRY.counter(
+            "hops_tpu_online_materialized_rows_total", labels=("store",)
+        ).value(store="bench_users_1")
+        users.close()
+        items.close()
+        return {
+            "lookup_qps": total / wall,
+            "join_p50_ms": round(float(np.percentile(lat_ms, 50)), 3) if len(lat_ms) else 0.0,
+            "join_p99_ms": round(float(np.percentile(lat_ms, 99)), 3) if len(lat_ms) else 0.0,
+            "hit_rate": round(delta["hit"] / max(total, 1), 4),
+            "freshness_lag_s": round(daemon_lag, 4),
+            "materialized_rows": int(materialized),
+            "requests": len(join_lat),
+            "entities": entities,
+            "shards": shards,
+            "readers": readers,
+            "batch": batch,
+            "write_rps": write_rps,
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_fault_overhead_bench(calls: int = 1_000_000) -> dict:
     """Disarmed fault-injection overhead: the zero-cost claim, measured.
 
@@ -977,6 +1149,14 @@ def main() -> None:
         "relay lock)",
     )
     parser.add_argument(
+        "--online-store", action="store_true",
+        help="online feature-store tier: batched entity-ID joins "
+        "against the sharded store while a pubsub write-through "
+        "materializer streams updates; reports lookup QPS, join "
+        "p50/p99 latency, hit rate, and freshness lag; host-only "
+        "(no accelerator, no relay lock)",
+    )
+    parser.add_argument(
         "--fault-overhead", action="store_true",
         help="measure the DISARMED faultinject.fire() cost on the hot "
         "paths (ns/call vs an empty loop); host-only, guards the "
@@ -1030,6 +1210,23 @@ def main() -> None:
         print(json.dumps({"metric": "faultinject_disarmed_ns_per_call",
                           "value": result["ns_per_disarmed_fire"],
                           "unit": "ns", **result}))
+        return
+
+    if args.online_store:
+        # Entirely host-side, like --input-pipeline: no accelerator
+        # touch, no relay lock, no TPU probe.
+        _note("online-store bench: sharded joins under write-through load")
+        result = run_online_store_bench(smoke=args.smoke)
+        print(json.dumps({
+            "metric": "online_store_lookup_qps",
+            "value": round(result["lookup_qps"], 1),
+            "unit": "lookups/s",
+            **{k: result[k] for k in (
+                "join_p50_ms", "join_p99_ms", "hit_rate", "freshness_lag_s",
+                "materialized_rows", "entities", "shards", "readers",
+                "write_rps",
+            )},
+        }))
         return
 
     if args.input_pipeline:

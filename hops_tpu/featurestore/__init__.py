@@ -34,6 +34,12 @@ from hops_tpu.featurestore.loader import (  # noqa: F401
     RecordIOSource,
     Source,
 )
+from hops_tpu.featurestore.online_serving import (  # noqa: F401
+    FeatureJoinPredictor,
+    Materializer,
+    ShardedOnlineStore,
+    open_sharded_store,
+)
 from hops_tpu.featurestore.query import Query  # noqa: F401
 from hops_tpu.featurestore.statistics import StatisticsConfig  # noqa: F401
 from hops_tpu.featurestore.training_dataset import TrainingDataset  # noqa: F401
@@ -51,6 +57,10 @@ __all__ = [
     "Filter",
     "Logic",
     "FeatureGroup",
+    "FeatureJoinPredictor",
+    "Materializer",
+    "ShardedOnlineStore",
+    "open_sharded_store",
     "Query",
     "StatisticsConfig",
     "TrainingDataset",

@@ -21,6 +21,9 @@ from typing import Any, Iterator
 import pandas as pd
 
 from hops_tpu.featurestore import storage
+from hops_tpu.runtime.logging import get_logger
+
+log = get_logger(__name__)
 
 
 def _key_of(pk_values: list[Any]) -> str:
@@ -28,7 +31,17 @@ def _key_of(pk_values: list[Any]) -> str:
 
 
 class OnlineStore:
-    """One KV namespace per (feature group, version)."""
+    """One KV namespace per (feature group, version).
+
+    Concurrency contract: ``self._lock`` is the WRITER lock — it
+    serializes the batched put/delete/flush cycles. Reads take a
+    backend-dependent path (:meth:`_read`): the sqlite backend is
+    reader-safe without any lock (each reader thread gets its own WAL
+    snapshot connection, seeing the last committed batch and never a
+    half-flushed one), so serving-rate point lookups never queue behind
+    a materialization flush; the native mmap log is NOT reader-safe
+    mid-compact, so its reads briefly take the writer lock.
+    """
 
     def __init__(self, path: Path):
         self.path = path
@@ -54,16 +67,49 @@ class OnlineStore:
             self._impl.flush()
 
     # -- read path (prepared-statement lookups) ------------------------------
+    #
+    # Reads used to hit self._impl directly with no lock at all, racing
+    # put_dataframe's batched flush on both backends (the sqlite
+    # connection was shared across threads mid-commit; the native mmap
+    # log is not reader-safe mid-compact). The fix keeps reads off the
+    # writer lock where the backend can prove a consistent snapshot
+    # (sqlite WAL reader connections) and takes the lock where it
+    # can't (native).
+
+    def _read(self, fn):
+        """Run a read on the backend's reader-safe path, or under the
+        writer lock when the backend has none (see class docstring)."""
+        if getattr(self._impl, "reader_safe", False):
+            return fn()
+        with self._lock:
+            return fn()
 
     def get(self, pk_values: list[Any]) -> dict | None:
-        raw = self._impl.get(_key_of(pk_values))
+        raw = self._read(lambda: self._impl.get(_key_of(pk_values)))
         return json.loads(raw) if raw is not None else None
 
+    def get_many(self, pk_values_list: list[list[Any]]) -> list[dict | None]:
+        """Batched point lookup, results in input order (the serving
+        multi-get path: one backend round trip per batch where the
+        backend supports it, instead of one per key)."""
+        keys = [_key_of(pk) for pk in pk_values_list]
+        impl = self._impl
+        if hasattr(impl, "get_many"):
+            raws = self._read(lambda: impl.get_many(keys))
+        else:
+            raws = self._read(lambda: [impl.get(k) for k in keys])
+        return [json.loads(r) if r is not None else None for r in raws]
+
     def scan(self) -> Iterator[dict]:
-        yield from (json.loads(v) for v in self._impl.scan())
+        # Materialized under _read, not yielded lazily: a generator
+        # must not hold the writer lock across the caller's loop body —
+        # and on the locked path the underlying cursor would otherwise
+        # run outside the lock entirely.
+        rows = self._read(lambda: [json.loads(v) for v in self._impl.scan()])
+        yield from rows
 
     def count(self) -> int:
-        return self._impl.count()
+        return self._read(self._impl.count)
 
     def close(self) -> None:
         self._impl.close()
@@ -84,30 +130,67 @@ def _open_backend(path: Path):
 
 
 class _SqliteKV:
-    """Fallback backend when the native engine isn't built."""
+    """Fallback backend when the native engine isn't built.
+
+    ``self._db`` is the writer connection (callers serialize writes with
+    the store's writer lock). Reads run on per-thread READER connections
+    against the same WAL database: a WAL reader sees the last committed
+    state for the lifetime of its cursor — never a half-flushed batch,
+    never blocked by the writer — which is what makes this backend
+    ``reader_safe`` (see ``OnlineStore._read``).
+    """
+
+    #: Reads need no lock: WAL snapshot isolation on reader connections.
+    reader_safe = True
 
     def __init__(self, path: str):
+        self._path = path
         self._db = sqlite3.connect(path, check_same_thread=False)
         self._db.execute("CREATE TABLE IF NOT EXISTS kv (k TEXT PRIMARY KEY, v TEXT)")
         # Prepared-statement spirit of the reference: sqlite caches the
         # compiled statement; WAL keeps point reads fast under writes.
         self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.commit()  # table + WAL mode durable before any reader opens
+        self._local = threading.local()
+        self._readers_lock = threading.Lock()
+        self._readers: list[sqlite3.Connection] = []  # guarded by: self._readers_lock
+
+    def _reader(self) -> sqlite3.Connection:
+        db = getattr(self._local, "db", None)
+        if db is None:
+            db = self._local.db = sqlite3.connect(self._path, check_same_thread=False)
+            with self._readers_lock:
+                self._readers.append(db)
+        return db
 
     def put(self, key: str, value: str) -> None:
         self._db.execute("INSERT OR REPLACE INTO kv (k, v) VALUES (?, ?)", (key, value))
 
     def get(self, key: str) -> str | None:
-        row = self._db.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        row = self._reader().execute(
+            "SELECT v FROM kv WHERE k = ?", (key,)
+        ).fetchone()
         return row[0] if row else None
+
+    def get_many(self, keys: list[str]) -> list[str | None]:
+        found: dict[str, str] = {}
+        db = self._reader()
+        # 500-key chunks: sqlite's bound-parameter limit is 999 on
+        # older builds.
+        for i in range(0, len(keys), 500):
+            chunk = keys[i:i + 500]
+            q = f"SELECT k, v FROM kv WHERE k IN ({','.join('?' * len(chunk))})"
+            found.update(db.execute(q, chunk).fetchall())
+        return [found.get(k) for k in keys]
 
     def delete(self, key: str) -> None:
         self._db.execute("DELETE FROM kv WHERE k = ?", (key,))
 
     def scan(self):
-        yield from (v for (v,) in self._db.execute("SELECT v FROM kv"))
+        yield from (v for (v,) in self._reader().execute("SELECT v FROM kv"))
 
     def count(self) -> int:
-        return self._db.execute("SELECT COUNT(*) FROM kv").fetchone()[0]
+        return self._reader().execute("SELECT COUNT(*) FROM kv").fetchone()[0]
 
     def flush(self) -> None:
         self._db.commit()
@@ -115,3 +198,14 @@ class _SqliteKV:
     def close(self) -> None:
         self._db.commit()
         self._db.close()
+        # Reader connections are per-thread but live on this object too:
+        # without closing them here a serving process leaks one open
+        # .db/WAL handle per (reader thread, shard) past store close.
+        # Callers stop reading before close() — the concurrency contract.
+        with self._readers_lock:
+            readers, self._readers = list(self._readers), []
+        for db in readers:
+            try:
+                db.close()
+            except sqlite3.Error:
+                log.debug("closing sqlite reader connection failed", exc_info=True)

@@ -1,0 +1,890 @@
+"""Online feature serving: sharded stores, write-through, request-time joins.
+
+The reference platform served features at request time from MySQL NDB
+behind hsfs (``td.get_serving_vector`` over JDBC prepared statements,
+PAPER.md L0) and kept the online values consistent with training-time
+feature groups via Kafka-fed materialization jobs. This module is that
+layer for the TPU build, in three pieces:
+
+- :class:`ShardedOnlineStore` — N :class:`~hops_tpu.featurestore.online.
+  OnlineStore` shards keyed by ``crc32(primary key) % N``. Point reads
+  ride each backend's reader-safe path (never the writer lock), rows
+  carry an event-time stamp for TTL eviction and idempotent upserts,
+  and :meth:`~ShardedOnlineStore.snapshot` / :meth:`~ShardedOnlineStore.
+  restore_snapshot` write/verify checkpoint-layer integrity manifests
+  (sizes + SHA-256) so a serving replica can warm-start from a known-
+  good snapshot instead of replaying the topic from zero.
+- :class:`Materializer` — the write-through daemon: one consumer thread
+  tails a ``messaging.pubsub`` topic and upserts each record's row into
+  the store. At-least-once (offsets commit *after* the batch flush) with
+  idempotent, event-time-guarded upserts, so replays and duplicates
+  converge to the same state; the max materialized event time is the
+  store's freshness watermark.
+- :class:`FeatureJoinPredictor` — the serving-time join step: requests
+  carry only entity IDs; the predictor batch-multi-gets across every
+  configured feature group's shards, joins the rows into model-ready
+  vectors (missing-key policy: ``default`` | ``reject`` |
+  ``passthrough``) and hands them to the wrapped predictor. Wired into
+  ``modelrepo.serving`` via ``create_or_update(..., feature_config=)``,
+  upstream of the existing ``DynamicBatcher`` (coalesced entity batches
+  become one multi-get).
+
+Failure semantics: lookups run under the ``online.lookup`` fault point
+with an optional per-shard-batch deadline and a circuit breaker per
+shard — a dead shard degrades to missing keys (the policy decides what
+that means), it never fails the request. The daemon runs under
+``online.materialize`` and outlives transient broker/store faults with
+computed backoff; while it is down the freshness-lag gauge keeps rising
+because lag is re-derived from the stalled watermark at every lookup.
+
+Metrics (docs/operations.md "Online feature serving"):
+``hops_tpu_online_lookup_seconds`` / ``hops_tpu_online_join_seconds`` /
+``hops_tpu_online_request_seconds`` per-stage latency histograms,
+``hops_tpu_online_lookup_total{store,result}`` hit/miss/expired/error,
+``hops_tpu_online_freshness_lag_seconds``,
+``hops_tpu_online_materialized_rows_total``,
+``hops_tpu_online_evicted_rows_total``,
+``hops_tpu_online_missing_keys_total{model,policy}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+from pathlib import Path
+from typing import Any, Callable, Iterator
+
+import pandas as pd
+
+from hops_tpu.featurestore import storage
+from hops_tpu.featurestore.online import OnlineStore, _key_of
+from hops_tpu.messaging import pubsub
+from hops_tpu.runtime import faultinject
+from hops_tpu.runtime.checkpoint import CheckpointCorruptError, _file_sha256
+from hops_tpu.runtime.logging import get_logger
+from hops_tpu.runtime.resilience import CircuitBreaker, with_deadline
+from hops_tpu.telemetry.metrics import REGISTRY
+
+log = get_logger(__name__)
+
+#: Reserved event-time column (epoch seconds) stamped onto every stored
+#: row — the TTL clock and the idempotent-upsert staleness guard. Rows
+#: handed back to callers have it stripped.
+EVENT_TS_COL = "_hops_event_ts"
+
+MISSING_POLICIES = ("default", "reject", "passthrough")
+
+_m_lookup_seconds = REGISTRY.histogram(
+    "hops_tpu_online_lookup_seconds",
+    "Online-store point-lookup latency per shard batch",
+    labels=("store",),
+)
+_m_lookup_total = REGISTRY.counter(
+    "hops_tpu_online_lookup_total",
+    "Online-store key lookups by result (hit | miss | expired | error)",
+    labels=("store", "result"),
+)
+_m_join_seconds = REGISTRY.histogram(
+    "hops_tpu_online_join_seconds",
+    "Feature-join latency (all group lookups + vector assembly) per "
+    "request batch",
+    labels=("model",),
+)
+_m_request_seconds = REGISTRY.histogram(
+    "hops_tpu_online_request_seconds",
+    "End-to-end feature-joined predict latency (lookup + join + model)",
+    labels=("model",),
+)
+_m_missing_keys = REGISTRY.counter(
+    "hops_tpu_online_missing_keys_total",
+    "Features absent from the online store at join time, by the policy "
+    "that handled them",
+    labels=("model", "policy"),
+)
+_m_freshness = REGISTRY.gauge(
+    "hops_tpu_online_freshness_lag_seconds",
+    "Now minus the store's last materialized event-time watermark "
+    "(re-derived at every lookup, so it rises while the daemon is down)",
+    labels=("store",),
+)
+_m_materialized = REGISTRY.counter(
+    "hops_tpu_online_materialized_rows_total",
+    "Rows upserted by write-through materialization, per store",
+    labels=("store",),
+)
+_m_evicted = REGISTRY.counter(
+    "hops_tpu_online_evicted_rows_total",
+    "Rows deleted by a TTL eviction sweep, per store",
+    labels=("store",),
+)
+
+
+def _shard_of(key: str, n: int) -> int:
+    # crc32, not hash(): stable across processes and PYTHONHASHSEED, so
+    # a writer daemon and a serving replica agree on every row's shard.
+    return zlib.crc32(key.encode()) % n
+
+
+class ShardedOnlineStore:
+    """N ``OnlineStore`` shards keyed by ``crc32(primary key) % N``.
+
+    One instance per (feature group, version). Writers route each row to
+    its shard and take only that shard's writer lock; point reads use
+    the backends' reader-safe path (sqlite WAL snapshot connections —
+    see ``online.OnlineStore``), so serving lookups never queue behind a
+    materialization flush. ``ttl_s`` bounds row age: expired rows read
+    as misses immediately and :meth:`evict_expired` reclaims them.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        version: int = 1,
+        *,
+        primary_key: list[str],
+        shards: int = 4,
+        ttl_s: float | None = None,
+        root: str | Path | None = None,
+        breaker_failures: int = 5,
+        breaker_reset_s: float = 5.0,
+    ):
+        if not primary_key:
+            raise ValueError("ShardedOnlineStore needs a primary_key")
+        if shards < 1:
+            raise ValueError(f"shards must be >= 1, got {shards}")
+        self.name = name
+        self.version = int(version)
+        self.label = f"{name}_{version}"
+        self.primary_key = [k.lower() for k in primary_key]
+        self.ttl_s = ttl_s
+        d = Path(root) if root is not None else storage.feature_store_root() / "online"
+        d.mkdir(parents=True, exist_ok=True)
+        self._dir = d
+        # The shard layout is part of the data: crc32(key) % N only
+        # finds a row under the N it was written with. The first opener
+        # persists its layout; later openers (serving replicas, other
+        # processes) ADOPT it — a differing ``shards=`` argument would
+        # otherwise silently read misses for most keys.
+        meta_path = d / f"{self.label}.meta.json"
+        if meta_path.exists():
+            meta = json.loads(meta_path.read_text())
+            if [k.lower() for k in meta.get("primary_key", [])] != self.primary_key:
+                raise ValueError(
+                    f"online store {self.label} was created with primary key "
+                    f"{meta.get('primary_key')}, not {self.primary_key}"
+                )
+            if int(meta["shards"]) != int(shards):
+                log.info(
+                    "online store %s: adopting persisted shard count %d "
+                    "(requested %d)", self.label, meta["shards"], shards,
+                )
+            shards = int(meta["shards"])
+        else:
+            tmp = meta_path.with_suffix(".meta.tmp")
+            tmp.write_text(json.dumps(
+                {"shards": int(shards), "primary_key": self.primary_key}
+            ))
+            os.replace(tmp, meta_path)
+        self._shards = [
+            OnlineStore(d / f"{self.label}.shard{i}") for i in range(int(shards))
+        ]
+        # One breaker per shard: a dead shard fails fast (its keys read
+        # as missing) instead of stalling every request that hashes into
+        # it; the half-open probe heals it when the backend recovers.
+        self._breakers = [
+            CircuitBreaker(
+                name=f"online-{self.label}-shard{i}",
+                failure_threshold=breaker_failures,
+                reset_timeout_s=breaker_reset_s,
+            )
+            for i in range(int(shards))
+        ]
+        # One per shard: serializes upsert_rows' read-check-merge-write
+        # cycle (the shard's own writer lock covers only each put).
+        self._upsert_locks = [threading.Lock() for _ in range(int(shards))]
+        self._meta_lock = threading.Lock()
+        self._watermark: float | None = None  # guarded by: self._meta_lock
+        # (file value, monotonic read time): the persisted watermark is
+        # re-read at most every 50 ms — freshness lag is a seconds-scale
+        # signal and an uncached read_text per lookup was ~15% of the
+        # join path on the CPU tier.
+        self._wm_cache: tuple[float | None, float] | None = None  # guarded by: self._meta_lock
+        self._m_lookup = _m_lookup_seconds.labels(store=self.label)
+        self._m_hit = _m_lookup_total.labels(store=self.label, result="hit")
+        self._m_miss = _m_lookup_total.labels(store=self.label, result="miss")
+        self._m_expired = _m_lookup_total.labels(store=self.label, result="expired")
+        self._m_error = _m_lookup_total.labels(store=self.label, result="error")
+        self._m_fresh = _m_freshness.labels(store=self.label)
+        self._m_evict = _m_evicted.labels(store=self.label)
+
+    # -- keys -----------------------------------------------------------------
+
+    @property
+    def n_shards(self) -> int:
+        return len(self._shards)
+
+    def _pk_values(self, entry: Any) -> list[Any]:
+        if isinstance(entry, dict):
+            lowered = {str(k).lower(): v for k, v in entry.items()}
+            try:
+                return [lowered[k] for k in self.primary_key]
+            except KeyError as e:
+                raise ValueError(
+                    f"entity entry {entry!r} is missing primary key "
+                    f"{e.args[0]!r} of store {self.label}"
+                ) from None
+        return list(entry)  # positional, in primary_key order
+
+    def shard_index(self, entry: Any) -> int:
+        return _shard_of(_key_of(self._pk_values(entry)), self.n_shards)
+
+    # -- write path -----------------------------------------------------------
+
+    def put_dataframe(self, df: pd.DataFrame, event_ts: str | None = None) -> int:
+        """Route a frame's rows to their shards and upsert (see
+        :meth:`upsert_rows`)."""
+        return self.upsert_rows(df.to_dict(orient="records"), event_ts=event_ts)
+
+    def upsert_rows(self, rows: list[dict], event_ts: str | None = None) -> int:
+        """Idempotent keyed upsert-merge; returns rows applied.
+
+        ``event_ts`` names the column carrying each row's event time
+        (epoch seconds); absent, rows are stamped with now. A row whose
+        event time is OLDER than the stored row's is skipped, and
+        duplicates WITHIN the batch fold newest-last before the write —
+        so at-least-once delivery, replays, and out-of-order topics
+        (across and inside poll batches) all converge to
+        last-event-time-wins, and re-running a drained materializer is
+        a no-op. A partial row (a subset of columns) merges into the
+        stored row rather than replacing it: absent features stay
+        served instead of silently turning into misses — and never into
+        NaN padding.
+        """
+        now = time.time()
+        # Fold the batch per key in ascending (event time, batch order)
+        # before touching any shard: an older duplicate BEHIND a newer
+        # row in the same batch must not win just because it was
+        # applied later.
+        folded: dict[str, dict] = {}
+        order: list[str] = []
+        max_ts: float | None = None
+        for row in rows:
+            rec = {str(k).lower(): v for k, v in row.items()}
+            ts = now
+            if event_ts is not None and rec.get(event_ts.lower()) is not None:
+                ts = float(rec[event_ts.lower()])
+            rec[EVENT_TS_COL] = ts
+            key = _key_of(self._pk_values(rec))
+            cur = folded.get(key)
+            if cur is None:
+                folded[key] = rec
+                order.append(key)
+            elif ts >= cur[EVENT_TS_COL]:
+                folded[key] = {**cur, **rec}
+            else:
+                folded[key] = {**rec, **cur}
+            max_ts = ts if max_ts is None else max(max_ts, ts)
+        buckets: dict[int, list[dict]] = {}
+        for key in order:
+            buckets.setdefault(_shard_of(key, self.n_shards), []).append(folded[key])
+        applied = 0
+        for idx in sorted(buckets):
+            shard = self._shards[idx]
+            # The read-check-merge-write cycle must be atomic per shard:
+            # without this lock two concurrent upserters (the daemon and
+            # a snapshot restore, say) can both read the old row, both
+            # pass the staleness guard, and the LAST writer — possibly
+            # the older one — wins.
+            with self._upsert_locks[idx]:
+                currents = shard.get_many(
+                    [self._pk_values(rec) for rec in buckets[idx]]
+                )
+                fresh = []
+                for rec, current in zip(buckets[idx], currents):
+                    if current is not None:
+                        if current.get(EVENT_TS_COL, 0.0) > rec[EVENT_TS_COL]:
+                            continue  # stale replay: the store already moved past it
+                        rec = {**current, **rec}  # partial update merges
+                    fresh.append(rec)
+                # Group by column signature: one put per homogeneous
+                # slice, so a mixed batch never NaN-pads missing columns
+                # into stored rows (NaN would read back as a HIT and
+                # bypass the missing-key policy).
+                by_cols: dict[frozenset, list[dict]] = {}
+                for rec in fresh:
+                    by_cols.setdefault(frozenset(rec), []).append(rec)
+                for recs in by_cols.values():
+                    applied += shard.put_dataframe(
+                        pd.DataFrame(recs), self.primary_key
+                    )
+        if max_ts is not None:
+            self.set_watermark(max_ts)
+        return applied
+
+    def delete_keys(self, df: pd.DataFrame) -> None:
+        buckets: dict[int, list[dict]] = {}
+        for row in df.to_dict(orient="records"):
+            rec = {str(k).lower(): v for k, v in row.items()}
+            key = _key_of(self._pk_values(rec))
+            buckets.setdefault(_shard_of(key, self.n_shards), []).append(rec)
+        for idx, recs in buckets.items():
+            self._shards[idx].delete_keys(pd.DataFrame(recs), self.primary_key)
+
+    # -- read path ------------------------------------------------------------
+
+    @staticmethod
+    def _strip(row: dict) -> dict:
+        return {k: v for k, v in row.items() if k != EVENT_TS_COL}
+
+    def _expired(self, row: dict, now: float) -> bool:
+        if self.ttl_s is None:
+            return False
+        return now - float(row.get(EVENT_TS_COL, now)) > self.ttl_s
+
+    @staticmethod
+    def _shard_lookup(shard: OnlineStore, pk_lists: list[list[Any]]) -> list[dict | None]:
+        return shard.get_many(pk_lists)
+
+    def get(self, entry: Any) -> dict | None:
+        """Point lookup; None on miss/expiry/shard failure (the caller's
+        missing-key policy decides what None means)."""
+        return self.multi_get([entry])[0]
+
+    def multi_get(
+        self, entries: list[Any], deadline_s: float | None = None
+    ) -> list[dict | None]:
+        """Batched point lookup across shards, results in entry order.
+
+        Never raises for a failing shard: a lookup error, a
+        ``deadline_s`` overrun, or an open breaker turns that shard's
+        keys into misses (``result="error"`` on the lookup counter) —
+        serving degrades to the missing-key policy instead of failing
+        the request.
+        """
+        out: list[dict | None] = [None] * len(entries)
+        buckets: dict[int, list[tuple[int, list[Any]]]] = {}
+        for pos, entry in enumerate(entries):
+            pk = self._pk_values(entry)
+            buckets.setdefault(_shard_of(_key_of(pk), self.n_shards), []).append(
+                (pos, pk)
+            )
+        now = time.time()
+        for idx in sorted(buckets):
+            items = buckets[idx]
+            shard, breaker = self._shards[idx], self._breakers[idx]
+            if not breaker.allow():
+                self._m_error.inc(len(items))
+                continue
+            t0 = time.perf_counter()
+            try:
+                # Chaos point: a lookup error/latency here must surface
+                # as missing keys + breaker pressure, never a 5xx.
+                faultinject.fire("online.lookup")
+                pk_lists = [pk for _, pk in items]
+                if deadline_s is not None:
+                    rows = with_deadline(
+                        self._shard_lookup, deadline_s, shard, pk_lists,
+                        op="online.lookup",
+                    )
+                else:
+                    rows = self._shard_lookup(shard, pk_lists)
+            except Exception as e:  # noqa: BLE001 — a dead shard degrades, never raises
+                breaker.record_failure()
+                self._m_error.inc(len(items))
+                log.warning(
+                    "online store %s shard %d lookup failed: %s: %s",
+                    self.label, idx, type(e).__name__, e,
+                )
+                continue
+            breaker.record_success()
+            self._m_lookup.observe(time.perf_counter() - t0)
+            for (pos, _), row in zip(items, rows):
+                if row is None:
+                    self._m_miss.inc()
+                elif self._expired(row, now):
+                    self._m_expired.inc()
+                else:
+                    self._m_hit.inc()
+                    out[pos] = self._strip(row)
+        self._observe_freshness()
+        return out
+
+    def scan(self) -> Iterator[dict]:
+        """Every live (non-expired) row across all shards."""
+        now = time.time()
+        for shard in self._shards:
+            for row in shard.scan():
+                if not self._expired(row, now):
+                    yield self._strip(row)
+
+    def count(self) -> int:
+        """Stored rows across all shards (including TTL-expired rows
+        not yet swept — :meth:`evict_expired` reclaims those)."""
+        return sum(shard.count() for shard in self._shards)
+
+    def evict_expired(self) -> int:
+        """TTL sweep: delete expired rows (each shard's delete runs
+        under that shard's writer lock). Returns rows evicted."""
+        if self.ttl_s is None:
+            return 0
+        now = time.time()
+        evicted = 0
+        for shard in self._shards:
+            doomed = [row for row in shard.scan() if self._expired(row, now)]
+            if doomed:
+                shard.delete_keys(pd.DataFrame(doomed), self.primary_key)
+                evicted += len(doomed)
+        if evicted:
+            self._m_evict.inc(evicted)
+        return evicted
+
+    # -- freshness watermark --------------------------------------------------
+    #
+    # The watermark is persisted beside the shard files (not memory-only)
+    # because the writer and the readers are usually DIFFERENT store
+    # instances — the materializer daemon advances it, serving replicas
+    # (their own ShardedOnlineStore objects, possibly other processes)
+    # re-derive lag from it at every lookup. That is also what makes the
+    # freshness gauge rise while the daemon is dead: the file stalls,
+    # now keeps moving.
+
+    def _watermark_path(self) -> Path:
+        return self._dir / f"{self.label}.watermark"
+
+    def _file_watermark(self) -> float | None:
+        now = time.monotonic()
+        with self._meta_lock:
+            cached = self._wm_cache
+        if cached is not None and now - cached[1] < 0.05:
+            return cached[0]
+        try:
+            file_wm = float(self._watermark_path().read_text())
+        except (OSError, ValueError):
+            file_wm = None
+        with self._meta_lock:
+            self._wm_cache = (file_wm, now)
+        return file_wm
+
+    @property
+    def watermark(self) -> float | None:
+        """Max event time materialized into this store (epoch seconds):
+        the newer of this instance's own writes and the persisted file
+        (another instance's writes, cached for at most 50 ms)."""
+        with self._meta_lock:
+            wm = self._watermark
+        file_wm = self._file_watermark()
+        if file_wm is None:
+            return wm
+        return file_wm if wm is None else max(wm, file_wm)
+
+    def set_watermark(self, ts: float) -> None:
+        ts = float(ts)
+        with self._meta_lock:
+            try:
+                file_wm = float(self._watermark_path().read_text())
+            except (OSError, ValueError):
+                file_wm = None
+            known = max(
+                (v for v in (self._watermark, file_wm) if v is not None),
+                default=None,
+            )
+            if known is None or ts > known:
+                self._watermark = ts
+                tmp = self._watermark_path().with_suffix(".watermark.tmp")
+                tmp.write_text(repr(ts))
+                os.replace(tmp, self._watermark_path())
+                self._wm_cache = (ts, time.monotonic())
+            elif self._watermark is None or ts > self._watermark:
+                self._watermark = ts  # file already newer; cache ours anyway
+        self._observe_freshness()
+
+    def freshness_lag_s(self) -> float:
+        """Seconds between now and the watermark — how stale the online
+        view is. 0.0 before anything has been materialized."""
+        wm = self.watermark
+        return max(0.0, time.time() - wm) if wm is not None else 0.0
+
+    def _observe_freshness(self) -> None:
+        self._m_fresh.set(self.freshness_lag_s())
+
+    # -- snapshot / warm-start ------------------------------------------------
+
+    def snapshot(self, directory: str | Path) -> Path:
+        """Write a warm-start snapshot: one JSONL file per shard plus a
+        ``manifest.json`` with per-file sizes and SHA-256 checksums —
+        the checkpoint layer's integrity contract (same streaming
+        digest, same verify-before-trust restore), so a replica can
+        prove a snapshot healthy before serving from it."""
+        d = Path(directory)
+        d.mkdir(parents=True, exist_ok=True)
+        # Captured BEFORE the scans: under concurrent write-through the
+        # manifest watermark must be a LOWER bound on what the files
+        # hold — claiming event times whose rows were scanned past
+        # would make a restored replica report freshness it doesn't have.
+        wm = self.watermark
+        files: dict[str, dict[str, Any]] = {}
+        for i, shard in enumerate(self._shards):
+            p = d / f"shard{i}.jsonl"
+            tmp = p.with_suffix(".jsonl.tmp")
+            with tmp.open("w") as f:
+                for row in shard.scan():
+                    f.write(json.dumps(row, default=str) + "\n")
+            os.replace(tmp, p)
+            files[p.name] = {"size": p.stat().st_size, "sha256": _file_sha256(p)}
+        manifest = {
+            "name": self.name,
+            "version": self.version,
+            "primary_key": self.primary_key,
+            "shards": self.n_shards,
+            "watermark": wm,
+            "files": files,
+        }
+        tmp = d / "manifest.json.tmp"
+        tmp.write_text(json.dumps(manifest, indent=2))
+        os.replace(tmp, d / "manifest.json")
+        return d
+
+    def restore_snapshot(self, directory: str | Path) -> int:
+        """Verify and load a :meth:`snapshot` into this store (warm
+        start). Rows load through the idempotent upsert with their
+        snapshotted event times, so restoring on top of newer data never
+        rolls a row back; the watermark is restored too. Raises
+        :class:`~hops_tpu.runtime.checkpoint.CheckpointCorruptError`
+        when a file fails its manifest check. Returns rows applied."""
+        d = Path(directory)
+        manifest = json.loads((d / "manifest.json").read_text())
+        for fname, meta in manifest.get("files", {}).items():
+            p = d / fname
+            try:
+                size = p.stat().st_size
+            except OSError as e:
+                raise CheckpointCorruptError(
+                    f"online snapshot {d}: {fname} unreadable "
+                    f"({type(e).__name__}: {e})"
+                ) from None
+            if size != meta["size"]:
+                raise CheckpointCorruptError(
+                    f"online snapshot {d}: {fname} size {size} != "
+                    f"manifest {meta['size']}"
+                )
+            if _file_sha256(p) != meta["sha256"]:
+                raise CheckpointCorruptError(
+                    f"online snapshot {d}: {fname} checksum mismatch"
+                )
+        rows: list[dict] = []
+        for fname in manifest.get("files", {}):
+            with (d / fname).open() as f:
+                rows.extend(json.loads(line) for line in f if line.strip())
+        applied = self.upsert_rows(rows, event_ts=EVENT_TS_COL) if rows else 0
+        if manifest.get("watermark") is not None:
+            self.set_watermark(float(manifest["watermark"]))
+        return applied
+
+    def close(self) -> None:
+        for shard in self._shards:
+            shard.close()
+
+
+def open_sharded_store(
+    name: str, version: int = 1, *, primary_key: list[str], **kwargs: Any
+) -> ShardedOnlineStore:
+    """Open (or create) the sharded online store of a (feature group,
+    version) under the workspace's ``FeatureStore/online`` root."""
+    return ShardedOnlineStore(name, version, primary_key=primary_key, **kwargs)
+
+
+# -- write-through materialization --------------------------------------------
+
+
+class Materializer:
+    """Write-through materialization daemon for one (topic, store) pair.
+
+    A consumer thread tails the pubsub topic with a durable consumer
+    group and upserts each record's ``value`` row into the store in
+    batched flushes. Delivery is at-least-once — the group offset
+    commits only AFTER a batch is flushed — and convergence comes from
+    the store's idempotent event-time-guarded upserts, so a crash
+    between flush and commit merely replays rows into a no-op.
+
+    ``event_time`` names the row column carrying event time; absent (or
+    missing on a row), the producer's ``ts`` stamp is used. The max
+    event time applied becomes the store's freshness watermark; rows
+    without a usable primary key are skipped with a warning (a poison
+    record must not wedge the offset forever — the same contract as the
+    consumer's unparsable-record skip).
+
+    ``from_beginning=True`` (the default) makes a NEW group catch up on
+    the topic's history; a restarted daemon with a committed offset
+    resumes from the commit either way (the consumer's durable-group
+    contract), so restarts cost O(uncommitted tail), not O(topic).
+    """
+
+    def __init__(
+        self,
+        store: ShardedOnlineStore,
+        topic: str,
+        group: str = "online-materializer",
+        *,
+        event_time: str | None = None,
+        batch_size: int = 256,
+        poll_interval_s: float = 0.05,
+        from_beginning: bool = True,
+    ):
+        self._store = store
+        self._topic = topic
+        self._consumer = pubsub.Consumer(topic, group=group, from_beginning=from_beginning)
+        self._event_time = event_time.lower() if event_time else None
+        self._batch_size = int(batch_size)
+        self._poll_s = float(poll_interval_s)
+        self._stop = threading.Event()
+        self._state_lock = threading.Lock()
+        self._busy = False  # guarded by: self._state_lock
+        self._errors = 0
+        self._m_rows = _m_materialized.labels(store=store.label)
+        self._thread = threading.Thread(
+            target=self._loop, name=f"materializer-{store.label}", daemon=True
+        )
+
+    def start(self) -> "Materializer":
+        self._thread.start()
+        return self
+
+    def stop(self, timeout_s: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout_s)
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def lag_bytes(self) -> int:
+        """Topic bytes not yet consumed (0 = caught up)."""
+        return self._consumer.lag()
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Block until the consumer has caught up to the topic end AND
+        the last batch is flushed; False on timeout or a dead daemon.
+        Meaningful only while producers are quiet (a live producer can
+        re-raise the lag right after the check)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if not self.alive:
+                return False
+            with self._state_lock:
+                busy = self._busy
+            if not busy and self._consumer.lag() == 0:
+                return True
+            time.sleep(min(self._poll_s, 0.02))
+        return False
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                with self._state_lock:
+                    self._busy = True
+                try:
+                    # Chaos point: an injected error/latency here must be
+                    # survived (logged + retried with backoff), never kill
+                    # the daemon — while it stalls, the freshness gauge
+                    # rises and serving keeps answering from stale rows.
+                    faultinject.fire("online.materialize")
+                    records = self._consumer.poll(max_records=self._batch_size)
+                    if records:
+                        self._apply(records)
+                        self._consumer.commit()  # at-least-once: AFTER the flush
+                finally:
+                    with self._state_lock:
+                        self._busy = False
+            except Exception as e:  # noqa: BLE001 — the daemon outlives transient faults
+                self._errors += 1
+                log.warning(
+                    "materializer %s -> %s: %s: %s (attempt %d, backing off)",
+                    self._topic, self._store.label, type(e).__name__, e,
+                    self._errors,
+                )
+                # Computed exponential backoff (capped), interruptible
+                # by stop() — not a naked retry loop.
+                self._stop.wait(min(self._poll_s * (2 ** min(self._errors, 6)), 2.0))
+                continue
+            self._errors = 0
+            if not records:
+                self._stop.wait(self._poll_s)
+
+    def _apply(self, records: list[dict]) -> None:
+        rows: list[dict] = []
+        for rec in records:
+            value = rec.get("value")
+            if not isinstance(value, dict):
+                log.warning(
+                    "materializer %s: skipping non-row record (%s)",
+                    self._topic, type(value).__name__,
+                )
+                continue
+            row = {str(k).lower(): v for k, v in value.items()}
+            if any(row.get(k) is None for k in self._store.primary_key):
+                log.warning(
+                    "materializer %s: skipping row without primary key %s",
+                    self._topic, self._store.primary_key,
+                )
+                continue
+            ts = None
+            if self._event_time is not None:
+                ts = row.get(self._event_time)
+            if ts is None:
+                ts = rec.get("ts", time.time())
+            row[EVENT_TS_COL] = float(ts)
+            rows.append(row)
+        if rows:
+            applied = self._store.upsert_rows(rows, event_ts=EVENT_TS_COL)
+            self._m_rows.inc(applied)
+
+
+# -- serving-time feature joins ------------------------------------------------
+
+
+def validate_feature_config(cfg: dict[str, Any]) -> dict[str, Any]:
+    """Normalize and validate a ``feature_config`` dict at definition
+    time (``serving.create_or_update``), so a typo'd policy or a group
+    without a primary key fails at create, not at the first request."""
+    cfg = dict(cfg)
+    missing = cfg.get("missing", "default")
+    if missing not in MISSING_POLICIES:
+        raise ValueError(
+            f"feature_config missing-key policy must be one of "
+            f"{MISSING_POLICIES}, got {missing!r}"
+        )
+    groups = cfg.get("groups")
+    if not groups:
+        raise ValueError("feature_config needs a non-empty 'groups' list")
+    for g in groups:
+        if not g.get("name"):
+            raise ValueError(f"feature_config group without a name: {g!r}")
+        if not g.get("primary_key"):
+            raise ValueError(
+                f"feature_config group {g['name']!r} needs a primary_key"
+            )
+    if not cfg.get("order") and not all(g.get("features") for g in groups):
+        raise ValueError(
+            "feature_config needs an explicit 'order' (output feature "
+            "order) or per-group 'features' lists to derive it from"
+        )
+    return cfg
+
+
+class FeatureJoinPredictor:
+    """Request-time feature joins in front of any predictor.
+
+    Instances are entity-key dicts (``{"user_id": 7}``); the predictor
+    multi-gets every configured group's rows (one batched lookup per
+    group, fanned per shard), merges them per entity, assembles the
+    model-ready vector in ``order``, and calls the wrapped predictor on
+    the vectors. Composes with the ``DynamicBatcher`` upstream —
+    coalesced requests arrive here as one instances list and become one
+    join pass.
+
+    ``feature_config`` keys: ``groups`` (list of ``{"name", "version",
+    "primary_key", "features", "shards", "ttl_s"}``), ``order`` (output
+    feature order; default: concatenation of the groups' ``features``),
+    ``missing`` (``default`` — substitute ``defaults[f]`` or
+    ``default_value``; ``reject`` — fail the request; ``passthrough`` —
+    emit None), ``defaults`` / ``default_value``, ``lookup_deadline_s``
+    (per-shard-batch budget; overruns degrade to the missing policy),
+    ``shards`` / ``ttl_s`` / ``root`` (store defaults).
+    """
+
+    def __init__(
+        self,
+        inner: Any,
+        feature_config: dict[str, Any],
+        model: str = "",
+        stores: dict[str, ShardedOnlineStore] | None = None,
+    ):
+        cfg = validate_feature_config(feature_config)
+        self._inner = inner
+        self._model = model
+        self._missing = cfg.get("missing", "default")
+        self._defaults = {
+            str(k).lower(): v for k, v in (cfg.get("defaults") or {}).items()
+        }
+        self._default_value = cfg.get("default_value", 0.0)
+        self._deadline_s = cfg.get("lookup_deadline_s")
+        self._groups: list[tuple[ShardedOnlineStore, list[str]]] = []
+        for g in cfg["groups"]:
+            store = (stores or {}).get(g["name"])
+            if store is None:
+                store = ShardedOnlineStore(
+                    g["name"],
+                    g.get("version", 1),
+                    primary_key=g["primary_key"],
+                    shards=int(g.get("shards", cfg.get("shards", 4))),
+                    ttl_s=g.get("ttl_s", cfg.get("ttl_s")),
+                    root=cfg.get("root"),
+                )
+            feats = [str(f).lower() for f in (g.get("features") or [])]
+            self._groups.append((store, feats))
+        order = [str(f).lower() for f in (cfg.get("order") or [])]
+        if not order:
+            order = [f for _, feats in self._groups for f in feats]
+        self._order = order
+        self._m_join = _m_join_seconds.labels(model=model)
+        self._m_request = _m_request_seconds.labels(model=model)
+        self._m_missing = _m_missing_keys.labels(model=model, policy=self._missing)
+
+    @property
+    def order(self) -> list[str]:
+        """The model-ready vector's feature order."""
+        return list(self._order)
+
+    def join(self, entries: list[Any]) -> list[list[Any]]:
+        """Joined model-ready vectors for a batch of entity entries."""
+        t0 = time.perf_counter()
+        merged: list[dict[str, Any]] = [{} for _ in entries]
+        for store, feats in self._groups:
+            rows = store.multi_get(entries, deadline_s=self._deadline_s)
+            for m, row in zip(merged, rows):
+                if row is None:
+                    continue
+                m.update(
+                    {k: v for k, v in row.items() if not feats or k in feats}
+                )
+        vectors: list[list[Any]] = []
+        for entry, m in zip(entries, merged):
+            vec: list[Any] = []
+            for fname in self._order:
+                if fname in m:
+                    vec.append(m[fname])
+                    continue
+                self._m_missing.inc()
+                if self._missing == "reject":
+                    raise ValueError(
+                        f"online feature {fname!r} missing for entity "
+                        f"{entry!r} (missing-key policy: reject)"
+                    )
+                if self._missing == "default":
+                    vec.append(self._defaults.get(fname, self._default_value))
+                else:  # passthrough
+                    vec.append(None)
+            vectors.append(vec)
+        self._m_join.observe(time.perf_counter() - t0)
+        return vectors
+
+    def predict(self, instances: list[Any]) -> list[Any]:
+        t0 = time.perf_counter()
+        vectors = self.join(instances)
+        inner: Callable[[list[list[Any]]], list[Any]]
+        inner = self._inner.predict if hasattr(self._inner, "predict") else self._inner
+        preds = inner(vectors)
+        self._m_request.observe(time.perf_counter() - t0)
+        return preds
+
+    def stop(self) -> None:
+        """Close the stores and forward stop() to the wrapped predictor
+        (the serving teardown path)."""
+        for store, _ in self._groups:
+            store.close()
+        if hasattr(self._inner, "stop"):
+            self._inner.stop()

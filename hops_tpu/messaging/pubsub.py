@@ -93,17 +93,25 @@ class Producer:
 
 
 class Consumer:
-    """Tailing consumer with a durable per-group offset."""
+    """Tailing consumer with a durable per-group offset.
+
+    A committed group offset always resumes (that is what makes the
+    group durable); ``from_beginning`` only chooses where a group
+    WITHOUT a commit starts — byte 0 (catch up on history) or the
+    current end (new records only). Kafka's ``auto.offset.reset``
+    contract: a restarted write-through materializer must not replay
+    the whole topic just because it was constructed replay-capable.
+    """
 
     def __init__(self, topic: str, group: str = "default", from_beginning: bool = False):
         if not topic_exists(topic):
             create_topic(topic)
         self._log = _topic_dir(topic) / "log.jsonl"
         self._offset_file = _topic_dir(topic) / f"offset.{group}"
-        if from_beginning or not self._offset_file.exists():
-            self._offset = 0 if from_beginning else self._current_end()
-        else:
+        if self._offset_file.exists():
             self._offset = int(self._offset_file.read_text() or 0)
+        else:
+            self._offset = 0 if from_beginning else self._current_end()
 
     def _current_end(self) -> int:
         return self._log.stat().st_size
@@ -117,6 +125,16 @@ class Consumer:
     @offset.setter
     def offset(self, value: int) -> None:
         self._offset = int(value)
+
+    def end_offset(self) -> int:
+        """Current end of the topic log (bytes)."""
+        return self._current_end()
+
+    def lag(self) -> int:
+        """Bytes between this group's offset and the topic end — 0 when
+        caught up. The watermark check write-through materializers and
+        streaming runners gate their drain on."""
+        return max(0, self._current_end() - self._offset)
 
     def poll(self, max_records: int | None = None) -> list[dict[str, Any]]:
         with self._log.open("rb") as f:

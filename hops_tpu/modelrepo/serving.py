@@ -502,6 +502,17 @@ class _RunningServing:
     def __init__(self, cfg: dict[str, Any]):
         self.cfg = cfg
         self.predictor = _build_predictor(cfg)
+        if cfg.get("feature_config"):
+            # Serving-time feature joins: requests carry entity IDs
+            # only; the wrapper multi-gets the configured feature
+            # groups' online rows, assembles model-ready vectors, and
+            # feeds the real predictor. Sits UNDER the DynamicBatcher,
+            # so coalesced entity batches become one batched join.
+            from hops_tpu.featurestore.online_serving import FeatureJoinPredictor
+
+            self.predictor = FeatureJoinPredictor(
+                self.predictor, cfg["feature_config"], model=cfg["name"]
+            )
         self.producer = pubsub.Producer(cfg["topic"])
         name = cfg["name"]
         # Overload protection + failure gating (docs/operations.md
@@ -769,6 +780,7 @@ def create_or_update(
     batching_config: dict[str, Any] | None = None,
     lm_config: dict[str, Any] | None = None,
     resilience_config: dict[str, Any] | None = None,
+    feature_config: dict[str, Any] | None = None,
 ) -> dict[str, Any]:
     """Create/update a serving endpoint definition (reference:
     ``serving.create_or_update``; ``batching_enabled`` mirrors the
@@ -798,12 +810,34 @@ def create_or_update(
     ``breaker_failures`` / ``breaker_reset_s`` — consecutive predictor
     failures that open the circuit, and how long it stays open before
     a half-open probe (defaults 5 / 30 s). ``GET /healthz`` reports
-    readiness and flips 503 while the breaker is open."""
+    readiness and flips 503 while the breaker is open.
+
+    ``feature_config`` turns the endpoint into a feature-joining one
+    (docs/featurestore.md "Online store & serving-time joins"):
+    requests carry only entity-key dicts in ``instances``; the serving
+    looks the entities up in the configured feature groups' sharded
+    online stores, joins the rows into model-ready vectors (missing-key
+    policy ``default`` | ``reject`` | ``passthrough``), and feeds the
+    predictor those vectors — composing with ``batching_enabled``
+    (coalesced entity batches become one batched multi-get join)."""
     if model_server.upper() == LM and batching_enabled:
         raise ValueError(
             "model_server='LM' schedules requests itself (continuous "
             "batching) — batching_enabled would double-batch; leave it off"
         )
+    if feature_config:
+        if model_server.upper() == LM:
+            raise ValueError(
+                "feature_config joins entity IDs into feature vectors — "
+                "that is not a token stream; model_server='LM' cannot "
+                "take it"
+            )
+        # Validate at definition time: a typo'd missing-key policy or a
+        # group without a primary key must fail here, not at the first
+        # request of a started endpoint.
+        from hops_tpu.featurestore.online_serving import validate_feature_config
+
+        feature_config = validate_feature_config(feature_config)
     if lm_config:
         # The registry round-trips through JSON with default=str: a
         # numpy/jnp array anywhere in lm_config would be silently
@@ -856,6 +890,7 @@ def create_or_update(
         "batching_config": batching_config or {},
         "lm_config": lm_config or {},
         "resilience_config": resilience_config or {},
+        "feature_config": feature_config or {},
         "status": reg.get(name, {}).get("status", "Stopped"),
         "topic": f"serving-{name}-inference",
     }

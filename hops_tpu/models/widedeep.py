@@ -53,6 +53,27 @@ class WideAndDeep(nn.Module):
         return (wide_logits + deep_logits).astype(jnp.float32)
 
 
+def batch_from_vectors(vectors, num_dense: int):
+    """Model-ready ``WideAndDeep`` batch from serving-time feature
+    vectors (the contract between ``FeatureJoinPredictor``'s ``order``
+    and this model's inputs): the first ``num_dense`` entries of each
+    vector are the dense floats, the rest the hashed/bucketized
+    categorical ids. Accepts plain Python lists (the serving JSON
+    path) or arrays."""
+    import numpy as np
+
+    arr = np.asarray(vectors, dtype=np.float64)
+    if arr.ndim != 2 or arr.shape[1] <= num_dense:
+        raise ValueError(
+            f"expected [batch, >{num_dense}] feature vectors, got "
+            f"shape {arr.shape}"
+        )
+    return {
+        "dense": arr[:, :num_dense].astype(np.float32),
+        "categorical": arr[:, num_dense:].astype(np.int32),
+    }
+
+
 def make_taxi_batch(rng: jax.Array, batch_size: int, vocab_sizes: Sequence[int], num_dense: int = 5):
     """Synthetic Chicago-Taxi-shaped batch (tips classification twin)."""
     d_rng, c_rng, l_rng = jax.random.split(rng, 3)

@@ -7,6 +7,7 @@ Exposes the same backend protocol as ``online._SqliteKV`` so
 from __future__ import annotations
 
 import ctypes
+import threading
 from typing import Iterator
 
 from hops_tpu import native
@@ -44,19 +45,25 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     return lib
 
 
-_bound: ctypes.CDLL | None = None
+_bind_lock = threading.Lock()
+_bound: ctypes.CDLL | None = None  # guarded by: _bind_lock
 
 
 def _lib() -> ctypes.CDLL:
+    # Two threads opening stores concurrently (sharded online store
+    # startup) must not race the check-then-bind: an unguarded double
+    # _bind would hand one of them a CDLL whose restype/argtypes are
+    # being mutated mid-flight.
     global _bound
-    if _bound is None:
-        raw = native.load()
-        if raw is None:
-            raise RuntimeError(
-                "native library not built; run `make -C hops_tpu/native`"
-            )
-        _bound = _bind(raw)
-    return _bound
+    with _bind_lock:
+        if _bound is None:
+            raw = native.load()
+            if raw is None:
+                raise RuntimeError(
+                    "native library not built; run `make -C hops_tpu/native`"
+                )
+            _bound = _bind(raw)
+        return _bound
 
 
 def available() -> bool:
@@ -64,6 +71,11 @@ def available() -> bool:
 
 
 class NativeKV:
+    #: The mmap'd log + open-addressing index are NOT reader-safe while
+    #: a put grows the log or a compact rewrites it: readers must hold
+    #: the owning store's writer lock (see ``OnlineStore._read``).
+    reader_safe = False
+
     def __init__(self, path: str):
         self._lib = _lib()
         self._h = self._lib.kv_open(path.encode())

@@ -45,6 +45,12 @@ docs/operations.md "Failure handling & fault injection"):
 ``lm_engine.dispatch``  ``LMEngine.step``, before the iteration's device
                         dispatch wave (an error fails only the in-flight
                         requests; the scheduler keeps serving)
+``online.lookup``       ``ShardedOnlineStore.multi_get``, per shard batch
+                        (an error degrades those keys to the missing-key
+                        policy and feeds the shard's breaker)
+``online.materialize``  the write-through ``Materializer`` poll/flush
+                        cycle (survived with backoff; freshness lag
+                        rises while it stalls)
 ==================  ========================================================
 """
 
@@ -76,6 +82,8 @@ POINTS = (
     "search.trial",
     "pubsub.publish",
     "lm_engine.dispatch",
+    "online.lookup",
+    "online.materialize",
 )
 
 _MODES = ("error", "latency", "corrupt")

@@ -99,6 +99,18 @@ def test_loader_registered_in_drift_guard():
     assert "hops_tpu.featurestore.loader" in _module_names()
 
 
+def test_online_serving_registered_in_drift_guard():
+    """The online feature-serving layer sits on the native kvstore
+    binding, the pubsub consumer contract, and the checkpoint layer's
+    integrity helpers; pin the modules so a move or rename surfaces as
+    one named failure instead of a silent drop from the sweep."""
+    names = _module_names()
+    assert "hops_tpu.featurestore.online_serving" in names
+    assert "hops_tpu.featurestore.online" in names
+    assert "hops_tpu.native.kvstore" in names
+    assert "hops_tpu.messaging.pubsub" in names
+
+
 def test_resilience_registered_in_drift_guard():
     """The resilience layer and fault-injection registry are compiled
     into every hot path (checkpoint save/restore, loader production,
