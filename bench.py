@@ -757,6 +757,186 @@ def run_online_store_bench(
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_serving_fleet_bench(
+    smoke: bool = False,
+    *,
+    replicas: int = 3,
+    clients: int = 8,
+    work_ms: float = 60.0,
+    baseline_s: float = 3.0,
+    steady_s: float = 4.0,
+) -> dict:
+    """The ``--serving-fleet`` tier: N replicas behind the fleet router
+    vs one, under closed-loop client load, with a mid-load rollout.
+
+    Host-only (no accelerator, no relay lock). The predictor stands in
+    for a single-accelerator model: each replica serializes its
+    requests behind its own lock for ``work_ms`` (sleep releases the
+    GIL, so in-process replicas genuinely run concurrently). Phases:
+
+    1. **baseline** — a 1-replica fleet, ``clients`` closed-loop
+       threads: the single-endpoint ceiling (~1000/work_ms rps).
+    2. **scale-up** — a fresh fleet starting at 1 replica with an
+       aggressive autoscaler (max = ``replicas``): the load drives it
+       to the ceiling and the scale events land on the counter.
+    3. **steady state** — requests/s, p50/p99 latency, and per-replica
+       forward balance over ``steady_s`` at full size.
+    4. **rollout** — ``roll_out`` to an identical v2 mid-load; the
+       blip is the longest gap between consecutive successful
+       completions while the rollout ran (zero-downtime means it stays
+       at request scale, not drain scale).
+
+    Every client records errors; the tier asserts none in its JSON.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from hops_tpu.modelrepo import fleet, registry, serving
+    from hops_tpu.modelrepo.fleet.autoscale import AutoscalePolicy
+    from hops_tpu.runtime import config as rtconfig
+    from hops_tpu.telemetry.metrics import REGISTRY
+
+    if smoke:
+        replicas, clients, work_ms = 2, 4, 3.0
+        baseline_s, steady_s = 0.8, 1.0
+
+    tmp = Path(tempfile.mkdtemp(prefix="hops_tpu_fleetbench_"))
+    rtconfig.configure(workspace=str(tmp / "ws"), project="bench")
+    try:
+        art = tmp / "art"
+        art.mkdir()
+        (art / "p.py").write_text(
+            "import threading, time\n"
+            "class Predict:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def predict(self, instances):\n"
+            "        with self._lock:\n"
+            f"            time.sleep({work_ms / 1e3})\n"
+            "        return [[v[0]] for v in instances]\n"
+        )
+        registry.export(art, "fleetbench", metrics={"v": 1.0})
+        v2 = registry.export(art, "fleetbench", metrics={"v": 2.0})["version"]
+        serving.create_or_update("fleetbench", model_name="fleetbench",
+                                 model_version=1, model_server="PYTHON")
+
+        class _Load:
+            """Closed-loop clients; thread-safe completion log."""
+
+            def __init__(self, f, n):
+                self.f = f
+                self.errors = 0
+                self.lock = threading.Lock()
+                self.done: list[tuple[float, float]] = []  # (t_done, latency)
+                self.stop = threading.Event()
+                self.threads = [
+                    threading.Thread(target=self._run, daemon=True)
+                    for _ in range(n)
+                ]
+                for t in self.threads:
+                    t.start()
+
+            def _run(self):
+                while not self.stop.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        self.f.predict([[1]], timeout_s=30.0)
+                        t1 = time.perf_counter()
+                        with self.lock:
+                            self.done.append((t1, t1 - t0))
+                    except Exception:  # noqa: BLE001 — counted, asserted on
+                        with self.lock:
+                            self.errors += 1
+
+            def halt(self):
+                self.stop.set()
+                for t in self.threads:
+                    t.join(timeout=10)
+
+            def window(self, t_from, t_to):
+                with self.lock:
+                    return [(t, lat) for t, lat in self.done
+                            if t_from <= t <= t_to]
+
+        # -- phase 1: single-replica baseline --------------------------------
+        with fleet.start_fleet("fleetbench", 1, inprocess=True,
+                               scrape_interval_s=0.05) as f1:
+            load = _Load(f1, clients)
+            time.sleep(baseline_s)
+            t_to = time.perf_counter()
+            load.halt()
+            base_done = load.window(t_to - baseline_s * 0.7, t_to)
+            single_rps = len(base_done) / (baseline_s * 0.7)
+            base_errors = load.errors
+
+        # -- phases 2-4: autoscaled fleet, steady state, rollout -------------
+        policy = AutoscalePolicy(
+            min_replicas=1, max_replicas=replicas, target_load=2.0,
+            breaches_to_scale=2, up_cooldown_s=0.2, down_cooldown_s=60.0,
+        )
+        scale_counter = REGISTRY.counter(
+            "hops_tpu_fleet_scale_events_total", labels=("model", "direction"))
+        ups0 = scale_counter.value(model="fleetbench", direction="up")
+        forwards = REGISTRY.counter(
+            "hops_tpu_fleet_forwards_total", labels=("model", "replica"))
+        with fleet.start_fleet("fleetbench", 1, inprocess=True,
+                               scrape_interval_s=0.05, autoscale=policy,
+                               autoscale_interval_s=0.05) as f:
+            load = _Load(f, clients)
+            # Wait for the autoscaler to reach full size under load.
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if len(f.manager.ready()) >= replicas:
+                    break
+                time.sleep(0.05)
+            scaled_to = len(f.manager.ready())
+            # Steady-state window.
+            rids = [r.rid for r in f.manager.ready()]
+            fwd0 = {rid: forwards.value(model="fleetbench", replica=rid)
+                    for rid in rids}
+            t_from = time.perf_counter()
+            time.sleep(steady_s)
+            t_to = time.perf_counter()
+            fwd1 = {rid: forwards.value(model="fleetbench", replica=rid)
+                    for rid in rids}
+            steady = load.window(t_from, t_to)
+            lat_ms = np.asarray([lat for _, lat in steady]) * 1e3
+            shares = [fwd1[r] - fwd0[r] for r in rids]
+            balance = (min(shares) / max(shares)) if min(shares) >= 0 and max(shares) > 0 else 0.0
+            # Mid-load rollout to v2.
+            t_roll0 = time.perf_counter()
+            summary = f.roll_out(v2, canary_requests=4, canary_window_s=20)
+            t_roll1 = time.perf_counter()
+            time.sleep(0.2)
+            load.halt()
+            roll_done = sorted(t for t, _ in load.window(t_roll0, t_roll1 + 0.2))
+            blip_ms = 0.0
+            if len(roll_done) >= 2:
+                blip_ms = max(b - a for a, b in zip(roll_done, roll_done[1:])) * 1e3
+            errors = load.errors + base_errors
+        ups = scale_counter.value(model="fleetbench", direction="up") - ups0
+        fleet_rps = len(steady) / (t_to - t_from)
+        return {
+            "requests_per_sec": round(fleet_rps, 1),
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 2) if len(lat_ms) else 0.0,
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 2) if len(lat_ms) else 0.0,
+            "replicas": scaled_to,
+            "clients": clients,
+            "work_ms": work_ms,
+            "balance_min_over_max": round(balance, 3),
+            "scale_events_up": int(ups),
+            "rollout_outcome": summary["outcome"],
+            "rollout_duration_s": summary["duration_s"],
+            "rollout_blip_ms": round(blip_ms, 1),
+            "errors": int(errors),
+            "single_replica_rps": round(single_rps, 1),
+            "speedup_vs_single": round(fleet_rps / max(single_rps, 1e-9), 2),
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_fault_overhead_bench(calls: int = 1_000_000) -> dict:
     """Disarmed fault-injection overhead: the zero-cost claim, measured.
 
@@ -1157,6 +1337,14 @@ def main() -> None:
         "(no accelerator, no relay lock)",
     )
     parser.add_argument(
+        "--serving-fleet", action="store_true",
+        help="serving-fleet tier: N replicas behind the least-loaded "
+        "router vs a single replica, under closed-loop client load "
+        "with autoscale-up and a mid-load rollout; reports requests/s, "
+        "p50/p99 latency, per-replica balance, scale events, and the "
+        "rollout blip; host-only (no accelerator, no relay lock)",
+    )
+    parser.add_argument(
         "--fault-overhead", action="store_true",
         help="measure the DISARMED faultinject.fire() cost on the hot "
         "paths (ns/call vs an empty loop); host-only, guards the "
@@ -1210,6 +1398,24 @@ def main() -> None:
         print(json.dumps({"metric": "faultinject_disarmed_ns_per_call",
                           "value": result["ns_per_disarmed_fire"],
                           "unit": "ns", **result}))
+        return
+
+    if args.serving_fleet:
+        # Entirely host-side, like --online-store: no accelerator
+        # touch, no relay lock, no TPU probe.
+        _note("serving-fleet bench: routed replicas vs one, rollout mid-load")
+        result = run_serving_fleet_bench(smoke=args.smoke)
+        print(json.dumps({
+            "metric": "serving_fleet_requests_per_sec",
+            "value": result["requests_per_sec"],
+            "unit": "req/s",
+            **{k: result[k] for k in (
+                "p50_ms", "p99_ms", "replicas", "clients", "work_ms",
+                "balance_min_over_max", "scale_events_up",
+                "rollout_outcome", "rollout_blip_ms", "errors",
+                "single_replica_rps", "speedup_vs_single",
+            )},
+        }))
         return
 
     if args.online_store:

@@ -90,6 +90,20 @@ class ParsedFile:
         self.guard_comments: dict[int, str] = {}
         self._scan_comments()
         self._symbol_index: list[tuple[int, int, str]] | None = None
+        self._parent_map: dict[int, ast.AST] | None = None
+
+    def parents(self) -> dict[int, ast.AST]:
+        """``id(child) -> parent`` for every node in the tree, built
+        once per file and shared by every rule that walks ancestor
+        chains (keyed by ``id`` because AST nodes are unhashable-by-
+        value and identity is what an ancestor walk needs)."""
+        if self._parent_map is None:
+            pm: dict[int, ast.AST] = {}
+            for n in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(n):
+                    pm[id(child)] = n
+            self._parent_map = pm
+        return self._parent_map
 
     def _scan_comments(self) -> None:
         try:

@@ -9,9 +9,11 @@ Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 - :mod:`.metric_consistency` — ``metric-name-consistency``
 - :mod:`.swallowed_exception` — ``swallowed-exception``
 - :mod:`.naked_retry` — ``naked-retry-loop``
+- :mod:`.blocking_call` — ``blocking-call-no-deadline``
 """
 
 from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effects
+    blocking_call,
     donation,
     host_sync,
     jit_purity,

@@ -108,10 +108,9 @@ class UseAfterDonationRule(Rule):
 
     def _check_scope(self, pf: ParsedFile, scope: ast.AST) -> list[Finding]:
         nodes = list(_scope_walk(scope))
-        parents: dict[int, ast.AST] = {}
-        for n in [scope] + nodes:
-            for child in ast.iter_child_nodes(n):
-                parents[id(child)] = n
+        # The whole-file map works scope-bounded too: every ancestor
+        # walk below terminates at `scope` explicitly.
+        parents = pf.parents()
 
         donors: dict[str, tuple[int, ...]] = {}
         donation_calls: list[tuple[ast.Call, tuple[int, ...]]] = []

@@ -50,10 +50,7 @@ class LockDisciplineRule(Rule):
     def check_file(self, pf: ParsedFile, ctx: Context) -> list[Finding]:
         if not pf.guard_comments:
             return []
-        parents: dict[int, ast.AST] = {}
-        for n in ast.walk(pf.tree):
-            for child in ast.iter_child_nodes(n):
-                parents[id(child)] = n
+        parents = pf.parents()
 
         # -- collect declarations --------------------------------------------
         class_guards: dict[int, dict[str, str]] = {}  # id(ClassDef) -> attr -> lock

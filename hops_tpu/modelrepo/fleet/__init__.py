@@ -1,0 +1,138 @@
+"""Horizontal serving fleet: N replicas behind one routed endpoint.
+
+PAPER.md's L4 layer (Hopsworks model serving on Docker/K8s) is a fleet
+of serving containers behind a platform endpoint. This package is that
+layer natively: a least-loaded front router
+(:mod:`~hops_tpu.modelrepo.fleet.router`), a replica manager spawning
+``serving_host --fleet-worker`` processes
+(:mod:`~hops_tpu.modelrepo.fleet.replicas`), telemetry-driven
+autoscaling (:mod:`~hops_tpu.modelrepo.fleet.autoscale`) and
+zero-downtime versioned rollouts
+(:mod:`~hops_tpu.modelrepo.fleet.rollout`). One call stands it up::
+
+    from hops_tpu.modelrepo import fleet, serving
+
+    serving.create_or_update("mnist", model_name="mnist")
+    f = fleet.start_fleet(
+        "mnist", replicas=3,
+        autoscale=fleet.AutoscalePolicy(min_replicas=2, max_replicas=6),
+        rate_limits={"default": {"rate_rps": 200, "burst": 50}},
+    )
+    f.predict([[...]])            # POST {endpoint}/predict
+    f.roll_out(version=2)         # warm → canary → shift → drain
+    f.stop()
+
+See docs/operations.md "Serving fleet" for the routing policy, the
+autoscaler knobs, the rollout/rollback runbook and every
+``hops_tpu_fleet_*`` metric.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from typing import Any
+
+from hops_tpu.modelrepo.fleet.autoscale import Autoscaler, AutoscalePolicy
+from hops_tpu.modelrepo.fleet.replicas import (
+    FleetSpawnError,
+    Replica,
+    ReplicaManager,
+)
+from hops_tpu.modelrepo.fleet.rollout import RolloutError, roll_out
+from hops_tpu.modelrepo.fleet.router import Router, TenantRateLimiter, TokenBucket
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalePolicy",
+    "FleetSpawnError",
+    "Replica",
+    "ReplicaManager",
+    "RolloutError",
+    "Router",
+    "ServingFleet",
+    "TenantRateLimiter",
+    "TokenBucket",
+    "roll_out",
+    "start_fleet",
+]
+
+
+class ServingFleet:
+    """Manager + router + (optional) autoscaler as one handle."""
+
+    def __init__(
+        self,
+        name: str,
+        replicas: int = 2,
+        *,
+        inprocess: bool = False,
+        autoscale: AutoscalePolicy | None = None,
+        autoscale_interval_s: float = 1.0,
+        rate_limits: dict[str, dict[str, float]] | None = None,
+        spawn_timeout_s: float = 60.0,
+        **router_kwargs: Any,
+    ):
+        self.manager = ReplicaManager(
+            name, inprocess=inprocess, spawn_timeout_s=spawn_timeout_s)
+        self.router = None
+        self.autoscaler = None
+        try:
+            for _ in range(replicas):
+                self.manager.spawn()
+            self.router = Router(
+                self.manager, rate_limits=rate_limits, **router_kwargs)
+            if autoscale is not None:
+                self.autoscaler = Autoscaler(
+                    self.manager, self.router, autoscale,
+                ).start(autoscale_interval_s)
+        except BaseException:
+            # A failed startup must not leak already-spawned workers:
+            # the caller never gets a handle to stop() them.
+            if self.router is not None:
+                self.router.stop()
+            self.manager.stop()
+            raise
+
+    @property
+    def endpoint(self) -> str:
+        return self.router.endpoint
+
+    def predict(self, instances: list[Any], *, tenant: str | None = None,
+                timeout_s: float = 30.0) -> dict[str, Any]:
+        """POST ``/predict`` through the router (convenience client)."""
+        headers = {"Content-Type": "application/json"}
+        if tenant is not None:
+            headers["X-Tenant"] = tenant
+        req = urllib.request.Request(
+            f"{self.endpoint}/predict",
+            data=json.dumps({"instances": instances}).encode(),
+            headers=headers,
+        )
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def roll_out(self, version: int | None, **kwargs: Any) -> dict[str, Any]:
+        return roll_out(self.manager, self.router, version, **kwargs)
+
+    def describe(self) -> dict[str, Any]:
+        return self.router.describe()
+
+    def stop(self) -> None:
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
+        self.router.stop()
+        self.manager.stop()
+
+    def __enter__(self) -> "ServingFleet":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+
+def start_fleet(name: str, replicas: int = 2, **kwargs: Any) -> ServingFleet:
+    """Stand up a fleet for an existing ``serving.create_or_update``
+    endpoint definition: spawn ``replicas`` workers, start the router
+    (and the autoscaler when a policy is given)."""
+    return ServingFleet(name, replicas, **kwargs)

@@ -1,0 +1,610 @@
+"""Front router: one endpoint over N serving replicas, least-loaded.
+
+The thin request-routing tier the TF-paper systems framing calls for:
+capacity (replica count) and versions (rollouts) change UNDER this
+server without clients noticing. Design:
+
+- **Least-loaded selection.** A scraper thread polls every replica's
+  ``/metrics.json`` (its own port — the per-process registry) every
+  ``scrape_interval_s`` and reads the serving gauges: queue depth
+  (``hops_tpu_serving_batch_queue_depth``), in-flight executions
+  (``hops_tpu_serving_inflight``) and the shed counter
+  (``hops_tpu_serving_shed_total`` — its delta per scrape is the shed
+  *rate*). The routing score adds the router's OWN per-replica
+  in-flight count (exact and instant, where scrapes are stale by up to
+  one interval — without it a burst between scrapes dogpiles the
+  replica that looked idle last time). Lowest score wins; ties
+  round-robin.
+- **Routing around failure.** Each replica gets a
+  ``resilience.CircuitBreaker``; a forward that fails at the transport
+  (connect refused/reset/timeout) or with a replica-side 5xx records a
+  failure and the request RETRIES on the next-best replica (predict is
+  idempotent), so a dead or dying replica costs latency, not errors. A
+  replica-side 503 (shedding, draining) retries elsewhere WITHOUT
+  feeding the breaker — overload is load, not failure. 4xx is the
+  client's problem and relays verbatim.
+- **Per-tenant token buckets** (the layer above PR 5's per-replica
+  load shedder): requests carry ``X-Tenant``; an empty bucket answers
+  429 + ``Retry-After`` before any replica is touched.
+
+Every forward passes through the ``router.forward`` fault point and an
+explicit timeout (the ``blocking-call-no-deadline`` lint rule holds
+this module to that).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+from hops_tpu.runtime import faultinject
+from hops_tpu.runtime.logging import get_logger
+from hops_tpu.runtime.resilience import CircuitBreaker
+from hops_tpu.telemetry import export as telemetry_export
+from hops_tpu.telemetry.metrics import REGISTRY
+from hops_tpu.telemetry.spans import span
+
+log = get_logger(__name__)
+
+_m_requests = REGISTRY.counter(
+    "hops_tpu_fleet_requests_total",
+    "Requests received by the fleet router, per endpoint",
+    labels=("model",),
+)
+_m_forwards = REGISTRY.counter(
+    "hops_tpu_fleet_forwards_total",
+    "Forwards per endpoint and replica (the balance to watch)",
+    labels=("model", "replica"),
+)
+_m_retries = REGISTRY.counter(
+    "hops_tpu_fleet_retries_total",
+    "Forwards retried on another replica, per endpoint and reason "
+    "(connect | error | shed)",
+    labels=("model", "reason"),
+)
+_m_rate_limited = REGISTRY.counter(
+    "hops_tpu_fleet_rate_limited_total",
+    "Requests answered 429 by the per-tenant token bucket",
+    labels=("tenant",),
+)
+_m_unrouted = REGISTRY.counter(
+    "hops_tpu_fleet_unrouted_total",
+    "Requests that exhausted every replica (503/5xx to the client)",
+    labels=("model",),
+)
+
+
+#: Headers never relayed from a replica response: ``_reply`` frames the
+#: re-serialized body itself, so passing the replica's framing through
+#: would send two (possibly conflicting) Content-Lengths and truncate
+#: or hang clients.
+_NO_RELAY_HEADERS = frozenset({
+    "content-length", "content-type", "transfer-encoding", "connection",
+    "keep-alive", "server", "date",
+})
+
+
+def _relay_headers(headers: Any) -> dict[str, str]:
+    return {k: v for k, v in dict(headers).items()
+            if k.lower() not in _NO_RELAY_HEADERS}
+
+
+class TokenBucket:
+    """Per-tenant rate limit: ``rate_rps`` tokens/s, ``burst`` deep.
+
+    ``acquire()`` returns 0.0 when admitted (one token consumed) or the
+    seconds until a token will exist — the 429's ``Retry-After``.
+    Injectable clock for deterministic refill tests.
+    """
+
+    def __init__(self, rate_rps: float, burst: float,
+                 clock=time.monotonic):
+        if rate_rps <= 0 or burst <= 0:
+            raise ValueError("rate_rps and burst must be > 0")
+        self.rate_rps = float(rate_rps)
+        self.burst = float(burst)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = float(burst)  # guarded by: self._lock
+        self._last = clock()  # guarded by: self._lock
+
+    def acquire(self, n: float = 1.0) -> float:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._last) * self.rate_rps)
+            self._last = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return 0.0
+            return (n - self._tokens) / self.rate_rps
+
+    @property
+    def tokens(self) -> float:
+        with self._lock:
+            now = self._clock()
+            return min(
+                self.burst, self._tokens + (now - self._last) * self.rate_rps)
+
+    @property
+    def last_used(self) -> float:
+        """Clock time of the last ``acquire`` — the LRU key the
+        limiter's bucket-map eviction sorts on."""
+        with self._lock:
+            return self._last
+
+
+class TenantRateLimiter:
+    """``{tenant: {"rate_rps": r, "burst": b}}`` with an optional
+    ``"default"`` entry covering unnamed tenants; no entry = unlimited.
+
+    ``X-Tenant`` is untrusted client input, so the bucket map is
+    HARD-bounded at ``max_buckets``: buckets that have refilled to
+    full burst are pruned first (a full bucket admits exactly like a
+    fresh one, so that eviction never changes an answer), and when a
+    spray of unique tenants leaves nothing refilled, the
+    least-recently-used bucket is evicted anyway. An evicted mid-limit
+    tenant returns later at full burst — under attack, bounded memory
+    beats exact answers; real tenants keep acquiring, stay recent, and
+    survive the LRU pass.
+    """
+
+    def __init__(self, limits: dict[str, dict[str, float]] | None,
+                 clock=time.monotonic, max_buckets: int = 4096):
+        self._clock = clock
+        self._limits = dict(limits or {})
+        self.max_buckets = max_buckets
+        self._lock = threading.Lock()
+        self._buckets: dict[str, TokenBucket] = {}  # guarded by: self._lock
+
+    def acquire(self, tenant: str) -> float:
+        """0.0 = admitted, else seconds until this tenant has a token."""
+        spec = self._limits.get(tenant, self._limits.get("default"))
+        if spec is None:
+            return 0.0
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                if len(self._buckets) >= self.max_buckets:
+                    for name in [t for t, b in self._buckets.items()
+                                 if b.tokens >= b.burst]:
+                        del self._buckets[name]
+                while len(self._buckets) >= self.max_buckets:
+                    # Unique-tenant spray: nothing has refilled, but
+                    # the cap is a hard bound — evict the coldest.
+                    lru = min(self._buckets,
+                              key=lambda t: self._buckets[t].last_used)
+                    del self._buckets[lru]
+                bucket = self._buckets[tenant] = TokenBucket(
+                    spec["rate_rps"], spec.get("burst", spec["rate_rps"]),
+                    clock=self._clock,
+                )
+        return bucket.acquire()
+
+    def label_for(self, tenant: str) -> str:
+        """Metric-safe tenant label: the tenant's own name only when
+        it has an explicitly configured limit; everyone admitted under
+        the ``"default"`` spec collapses to ``default`` — an untrusted
+        ``X-Tenant`` spray must not mint unbounded counter children in
+        the registry the router itself exports."""
+        return tenant if tenant in self._limits else "default"
+
+
+class _ReplicaView:
+    """The router's read model of one replica: breaker, local inflight,
+    last scraped load."""
+
+    def __init__(self, rid: str, breaker_failures: int, breaker_reset_s: float):
+        self.rid = rid
+        self.breaker = CircuitBreaker(
+            name=f"fleet-{rid}",
+            failure_threshold=breaker_failures,
+            reset_timeout_s=breaker_reset_s,
+        )
+        # += on an attribute is load/add/store bytecodes, NOT atomic:
+        # two handler threads can lose an increment while both
+        # decrements land, driving the count negative and permanently
+        # skewing least-loaded selection toward this replica.
+        self._count_lock = threading.Lock()
+        self.inflight = 0  # guarded by: self._count_lock
+        self.queue_depth = 0.0
+        self.scraped_inflight = 0.0
+        self.shed_rate = 0.0
+        self._last_shed_total: float | None = None
+        self.scrape_ok = True
+
+    def inflight_inc(self) -> None:
+        with self._count_lock:
+            self.inflight += 1
+
+    def inflight_dec(self) -> None:
+        with self._count_lock:
+            self.inflight -= 1
+
+    def score(self) -> float:
+        with self._count_lock:
+            inflight = self.inflight
+        s = inflight + self.queue_depth + self.scraped_inflight \
+            + self.shed_rate
+        if not self.scrape_ok:
+            s += 1.0  # deprioritize a replica we cannot see into
+        return s
+
+
+class Router:
+    """The fleet's front HTTP server (``POST /predict``).
+
+    ``manager`` needs only ``.name`` and ``.replicas()`` returning
+    objects with ``rid`` / ``port`` / ``state`` — the real
+    :class:`~hops_tpu.modelrepo.fleet.replicas.ReplicaManager` in
+    production, a stub in router unit tests.
+    """
+
+    def __init__(
+        self,
+        manager: Any,
+        *,
+        rate_limits: dict[str, dict[str, float]] | None = None,
+        scrape_interval_s: float = 0.25,
+        forward_timeout_s: float = 30.0,
+        max_attempts: int | None = None,
+        breaker_failures: int = 3,
+        breaker_reset_s: float = 5.0,
+        port: int = 0,
+        clock=time.monotonic,
+    ):
+        self.manager = manager
+        self.name = manager.name
+        self.scrape_interval_s = scrape_interval_s
+        self.forward_timeout_s = forward_timeout_s
+        self.max_attempts = max_attempts
+        self.breaker_failures = breaker_failures
+        self.breaker_reset_s = breaker_reset_s
+        self.limiter = TenantRateLimiter(rate_limits, clock=clock)
+        self._views_lock = threading.Lock()
+        self._views: dict[str, _ReplicaView] = {}  # guarded by: self._views_lock
+        self._rr = 0  # guarded by: self._views_lock
+        self._lat_lock = threading.Lock()
+        self._latencies: list[float] = []  # guarded by: self._lat_lock
+        self._stop = threading.Event()
+        name = self.name
+        router = self
+
+        m_requests = _m_requests.labels(model=name)
+        m_unrouted = _m_unrouted.labels(model=name)
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args: Any) -> None:  # silence stderr spam
+                pass
+
+            def do_GET(self) -> None:
+                try:
+                    if telemetry_export.handle_metrics_path(self):
+                        return
+                    path = self.path.rstrip("/")
+                    if path == "/healthz":
+                        ready = router.routable()
+                        if ready:
+                            self._reply(200, {"status": "ok",
+                                              "ready_replicas": len(ready)})
+                        else:
+                            self._reply(503, {"status": "unready",
+                                              "ready_replicas": 0},
+                                        headers={"Retry-After": "1"})
+                        return
+                    if path == "/fleet":
+                        self._reply(200, router.describe())
+                        return
+                    self._reply(404, {"error": f"unknown path {self.path}"})
+                except Exception as e:  # noqa: BLE001 — server must stay up
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def do_POST(self) -> None:
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(length) or b"{}"
+                    path = self.path.rstrip("/")
+                    if path not in ("/predict", f"/v1/models/{name}:predict"):
+                        self._reply(404, {"error": f"unknown path {self.path}"})
+                        return
+                    m_requests.inc()
+                    tenant = self.headers.get("X-Tenant", "default")
+                    wait = router.limiter.acquire(tenant)
+                    if wait > 0:
+                        _m_rate_limited.inc(
+                            tenant=router.limiter.label_for(tenant))
+                        self._reply(
+                            429,
+                            {"error": f"tenant {tenant!r} rate limited"},
+                            headers={"Retry-After": f"{math.ceil(wait)}"},
+                        )
+                        return
+                    t0 = time.perf_counter()
+                    with span("hops_tpu_fleet_request", model=name):
+                        code, payload, headers = router.route(body)
+                    # Rolling window behind recent_p99_ms(): the
+                    # autoscaler's latency trigger reads this, the
+                    # histogram above is for dashboards.
+                    router.observe_latency(time.perf_counter() - t0)
+                    if code >= 500:
+                        m_unrouted.inc()
+                    self._reply(code, payload, headers=headers)
+                except Exception as e:  # noqa: BLE001 — server must stay up
+                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+            def _reply(self, code: int, body: dict[str, Any],
+                       headers: dict[str, str] | None = None) -> None:
+                data = json.dumps(body).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"fleet-router-{name}",
+        )
+        self._thread.start()
+        self._scraper = threading.Thread(
+            target=self._scrape_loop, daemon=True,
+            name=f"fleet-scraper-{name}",
+        )
+        self._scraper.start()
+        log.info("fleet router for %s listening on 127.0.0.1:%d",
+                 name, self.port)
+
+    # -- views / telemetry scrape ---------------------------------------------
+
+    def _view(self, rid: str) -> _ReplicaView:
+        with self._views_lock:
+            view = self._views.get(rid)
+            if view is None:
+                view = self._views[rid] = _ReplicaView(
+                    rid, self.breaker_failures, self.breaker_reset_s)
+            return view
+
+    def _scrape_loop(self) -> None:
+        interval = self.scrape_interval_s
+        while not self._stop.wait(interval):
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001 — the scraper must survive
+                log.exception("fleet %s: scrape cycle failed", self.name)
+
+    def scrape_once(self) -> None:
+        """One pass over every routable replica's ``/metrics.json``.
+
+        Also prunes views whose replica no longer exists (reaped,
+        killed, or failed): every rollout and autoscale churn mints
+        fresh rids, so without this the ``_views`` dict — a breaker and
+        counters per rid ever seen — grows for the router's lifetime.
+        """
+        reps = self.manager.replicas()
+        live = {rep.rid for rep in reps}
+        with self._views_lock:
+            for rid in [r for r in self._views if r not in live]:
+                del self._views[rid]
+        for rep in reps:
+            if rep.state not in ("ready", "starting") or rep.port is None:
+                continue
+            view = self._view(rep.rid)
+            snap = self._scrape_replica(rep.port)
+            if snap is None:
+                view.scrape_ok = False
+                continue
+            view.scrape_ok = True
+            view.queue_depth = snap["queue_depth"]
+            view.scraped_inflight = snap["inflight"]
+            shed = snap["shed_total"]
+            if view._last_shed_total is not None:
+                view.shed_rate = max(0.0, shed - view._last_shed_total)
+            view._last_shed_total = shed
+
+    def _scrape_replica(self, port: int) -> dict[str, float] | None:
+        timeout = max(0.5, self.scrape_interval_s * 2)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics.json", timeout=timeout
+            ) as resp:
+                families = json.loads(resp.read()).get("metrics", {})
+        except (OSError, ValueError):
+            return None
+
+        def gauge(family: str) -> float:
+            rows = families.get(family, {}).get("samples", [])
+            return float(sum(
+                r["value"] for r in rows
+                if r["labels"].get("model", self.name) == self.name
+                and not r.get("suffix")
+            ))
+
+        def counter(family: str) -> float:
+            rows = families.get(family, {}).get("samples", [])
+            return float(sum(
+                r["value"] for r in rows
+                if r["labels"].get("model", self.name) == self.name
+            ))
+
+        return {
+            "queue_depth": gauge("hops_tpu_serving_batch_queue_depth"),
+            "inflight": gauge("hops_tpu_serving_inflight"),
+            "shed_total": counter("hops_tpu_serving_shed_total"),
+        }
+
+    # -- selection / forwarding -----------------------------------------------
+
+    def routable(self) -> list[Any]:
+        """Replicas a request may go to right now: ready, with a port,
+        breaker not open."""
+        out = []
+        for rep in self.manager.replicas():
+            if rep.state != "ready" or rep.port is None:
+                continue
+            if self._view(rep.rid).breaker.state == "open":
+                continue
+            out.append(rep)
+        return out
+
+    def pick(self, exclude: set[str] = frozenset()) -> Any | None:
+        """Least-loaded routable replica not in ``exclude``."""
+        candidates = [r for r in self.routable() if r.rid not in exclude]
+        if not candidates:
+            return None
+        with self._views_lock:
+            self._rr += 1
+            rr = self._rr
+        scored = sorted(
+            (self._view(r.rid).score(), (rr + i) % len(candidates), i)
+            for i, r in enumerate(candidates)
+        )
+        return candidates[scored[0][2]]
+
+    def route(self, body: bytes) -> tuple[int, dict[str, Any], dict[str, str]]:
+        """Forward ``body`` to the best replica, retrying the next-best
+        on transport failure / replica 5xx / shed-503 until attempts or
+        replicas run out. Returns ``(status, payload, headers)``."""
+        attempts = self.max_attempts or max(3, len(self.manager.replicas()) + 1)
+        tried: set[str] = set()
+        last: tuple[int, dict[str, Any], dict[str, str]] | None = None
+        for _ in range(attempts):
+            rep = self.pick(exclude=tried)
+            if rep is None:
+                break
+            tried.add(rep.rid)
+            view = self._view(rep.rid)
+            if not view.breaker.allow():
+                continue  # raced open, or half-open probe budget spent
+            _m_forwards.inc(model=self.name, replica=rep.rid)
+            view.inflight_inc()
+            try:
+                try:
+                    # Chaos point. ANY armed error class models a
+                    # transport failure on this hop (the catalog
+                    # promises a retry, and the fault grammar defaults
+                    # to RuntimeError) — only the real forward below
+                    # narrows to transport exception types.
+                    faultinject.fire("router.forward")
+                except Exception as e:
+                    raise urllib.error.URLError(e) from e
+                code, payload, headers = self._forward(rep.port, body)
+            except (OSError, urllib.error.URLError):
+                # Transport failure: the replica is gone or wedged —
+                # breaker strike, retry elsewhere. The request has NOT
+                # been answered, so this retry is invisible to the
+                # client beyond latency.
+                view.breaker.record_failure()
+                _m_retries.inc(model=self.name, reason="connect")
+                continue
+            finally:
+                view.inflight_dec()
+            if code < 400:
+                view.breaker.record_success()
+                return code, payload, {}
+            if code in (429, 503):
+                # Shedding/draining: load, not failure. Don't strike
+                # the breaker; try a less-loaded replica.
+                _m_retries.inc(model=self.name, reason="shed")
+                last = (code, payload, headers)
+                continue
+            if code >= 500:
+                view.breaker.record_failure()
+                _m_retries.inc(model=self.name, reason="error")
+                last = (code, payload, headers)
+                continue
+            # 4xx: the client's request is bad everywhere — relay as-is.
+            return code, payload, headers
+        if last is not None:
+            return last
+        return (
+            503,
+            {"error": f"no routable replicas for {self.name!r}"},
+            {"Retry-After": "1"},
+        )
+
+    def _forward(
+        self, port: int, body: bytes
+    ) -> tuple[int, dict[str, Any], dict[str, str]]:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/models/{self.name}:predict",
+            data=body, headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(
+                req, timeout=self.forward_timeout_s
+            ) as resp:
+                return (resp.status, json.loads(resp.read()),
+                        _relay_headers(resp.headers))
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except ValueError:
+                payload = {"error": f"replica answered {e.code}"}
+            return e.code, payload, _relay_headers(e.headers)
+
+    # -- surface --------------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def breaker_state(self, rid: str) -> str:
+        return self._view(rid).breaker.state
+
+    def observe_latency(self, seconds: float) -> None:
+        with self._lat_lock:
+            self._latencies.append(seconds)
+            if len(self._latencies) > 2048:
+                del self._latencies[:1024]
+
+    def recent_p99_ms(self) -> float | None:
+        """p99 of the most recent window of router-observed latencies
+        (the autoscaler's optional latency trigger)."""
+        with self._lat_lock:
+            window = list(self._latencies[-512:])
+        if not window:
+            return None
+        window.sort()
+        return window[min(len(window) - 1, int(len(window) * 0.99))] * 1e3
+
+    def fleet_load(self) -> float | None:
+        """Mean routing score per routable replica — the autoscaler's
+        primary signal (None when nothing is routable)."""
+        routable = self.routable()
+        if not routable:
+            return None
+        return sum(self._view(r.rid).score() for r in routable) / len(routable)
+
+    def describe(self) -> dict[str, Any]:
+        reps = []
+        for rep in self.manager.replicas():
+            view = self._view(rep.rid)
+            reps.append({
+                "rid": rep.rid,
+                "state": rep.state,
+                "port": rep.port,
+                "version": getattr(rep, "version", None),
+                "score": round(view.score(), 3),
+                "breaker": view.breaker.state,
+            })
+        return {"model": self.name, "replicas": reps,
+                "ready": sum(1 for r in reps if r["state"] == "ready")}
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.shutdown()
+        self._server.server_close()
+        self._scraper.join(timeout=5)

@@ -363,7 +363,8 @@ class DynamicBatcher:
         self.max_batch_size = max_batch_size
         self.timeout_s = timeout_ms / 1e3
         self._queue: "queue.Queue" = queue.Queue()
-        self._stopped = False
+        self._stop_lock = threading.Lock()
+        self._stopped = False  # guarded by: self._stop_lock
         self.batches_run = 0
         self.rows_run = 0
         self._m_queue_depth = REGISTRY.gauge(
@@ -382,20 +383,32 @@ class DynamicBatcher:
     def predict(self, instances: list[Any]) -> list[Any]:
         from concurrent.futures import Future
 
-        if self._stopped:
-            raise RuntimeError("serving stopped")
         fut: Future = Future()
-        self._queue.put((list(instances), fut))
+        # Check-and-enqueue is atomic with stop()'s flag-and-sentinel:
+        # every item the queue ever holds precedes the sentinel, so the
+        # loop (or its stop-time drain) resolves every future — no
+        # handler can block forever on a straggler enqueued after it.
+        with self._stop_lock:
+            if self._stopped:
+                raise RuntimeError("serving stopped")
+            self._queue.put((list(instances), fut))
         self._m_queue_depth.set(self._queue.qsize())
         return fut.result()
 
     def stop(self) -> None:
-        self._stopped = True
-        self._queue.put(None)
-        self._thread.join(timeout=5)
-        # In-flight handler threads that raced past the _stopped check
-        # may have enqueued after the sentinel: fail them rather than
-        # leave their futures unresolved forever.
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._queue.put(None)
+        self._thread.join(timeout=30)
+        # The enqueue lock means nothing lands after the sentinel: once
+        # the loop thread exits, every queued future has been resolved.
+        # _drain_and_fail is belt-and-braces for the timeout path only.
+        if self._thread.is_alive():
+            log.warning("dynamic batcher stop: drain still running after "
+                        "30s; leaving it to finish")
+            return
         self._drain_and_fail()
 
     def _loop(self) -> None:
@@ -407,7 +420,7 @@ class DynamicBatcher:
             item = carry if carry is not None else self._queue.get()
             carry = None
             if item is None:
-                self._drain_and_fail()
+                self._run_remaining()
                 return
             pending = [item]
             rows = len(item[0])
@@ -422,7 +435,7 @@ class DynamicBatcher:
                     break
                 if nxt is None:
                     self._run(pending)
-                    self._drain_and_fail()
+                    self._run_remaining()
                     return
                 if rows + len(nxt[0]) > self.max_batch_size:
                     carry = nxt  # seed of the NEXT batch; cap respected
@@ -430,6 +443,32 @@ class DynamicBatcher:
                 pending.append(nxt)
                 rows += len(nxt[0])
             self._run(pending)
+
+    def _run_remaining(self) -> None:
+        """Stop-time drain: work that was already QUEUED when the stop
+        sentinel landed still gets its answer (replica drains complete
+        queued requests before the predictor is torn down — the fleet
+        rollout's zero-downtime contract); only stragglers that raced
+        in after the drain are failed."""
+        import queue
+
+        pending: list = []
+        rows = 0
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            if pending and rows + len(item[0]) > self.max_batch_size:
+                self._run(pending)
+                pending, rows = [], 0
+            pending.append(item)
+            rows += len(item[0])
+        if pending:
+            self._run(pending)
+        self._drain_and_fail()
 
     def _drain_and_fail(self) -> None:
         import queue
@@ -531,6 +570,14 @@ class _RunningServing:
         )
         self._inflight_lock = threading.Lock()
         self._inflight = 0  # guarded by: self._inflight_lock
+        self._draining = False  # guarded by: self._inflight_lock
+        # The fleet router's least-loaded signal: live predictor
+        # executions on THIS endpoint, scraped from /metrics.json.
+        self._m_inflight = REGISTRY.gauge(
+            "hops_tpu_serving_inflight",
+            "Concurrent predictor executions in flight, per endpoint",
+            labels=("model",),
+        ).labels(model=name)
         self.batcher = None
         if cfg.get("batching_enabled"):
             bc = cfg.get("batching_config") or {}
@@ -564,7 +611,7 @@ class _RunningServing:
         m_shed = REGISTRY.counter(
             "hops_tpu_serving_shed_total",
             "Requests shed with 503, per serving endpoint and reason "
-            "(overload | breaker)",
+            "(overload | breaker | draining)",
             labels=("model", "reason"),
         )
         running = self
@@ -589,9 +636,20 @@ class _RunningServing:
                     # Readiness: load balancers and supervisors poll
                     # this; an open breaker = the predictor is down,
                     # stop routing here until the half-open probe heals.
+                    # A DRAINING endpoint is also unready (503 +
+                    # Retry-After) and reports its in-flight count, so
+                    # a rollout can gate the reap on inflight == 0 off
+                    # the same probe the router stops routing on.
                     if self.path.rstrip("/") == "/healthz":
                         bstate = breaker.state
-                        if bstate == "open":
+                        if running.draining:
+                            self._reply(
+                                503,
+                                {"status": "draining", "breaker": bstate,
+                                 "inflight": running.inflight},
+                                headers={"Retry-After": "1"},
+                            )
+                        elif bstate == "open":
                             retry = max(1.0, breaker.retry_after_s())
                             self._reply(
                                 503,
@@ -632,6 +690,15 @@ class _RunningServing:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length) or b"{}")
+                    # Fleet control plane: flip this endpoint into the
+                    # draining state (rollouts, scale-downs). Replies
+                    # with the in-flight count the caller will poll to
+                    # zero on /healthz before reaping.
+                    if self.path.rstrip("/") == "/admin/drain":
+                        inflight = running.drain()
+                        self._reply(200, {"status": "draining",
+                                          "inflight": inflight})
+                        return
                     # Exact route, like do_GET: a suffix match would
                     # accept /junk/v1/models/<name>:predict.
                     if self.path.rstrip("/") != f"/v1/models/{name}:predict":
@@ -642,19 +709,33 @@ class _RunningServing:
                         self._reply(400, {"error": "payload must carry 'instances'"})
                         return
                     m_requests.inc()
-                    # Load shedding BEFORE any model work: under a
-                    # burst past max_inflight the cheapest correct
-                    # answer is an immediate 503 + Retry-After — the
-                    # alternative (queueing) collapses every request's
-                    # latency, not just the excess.
+                    # Shedding BEFORE any model work — draining (stop
+                    # ADMITTING, keep finishing; the admission check is
+                    # atomic with the in-flight count inside _enter, so
+                    # /healthz can never report inflight==0 while a
+                    # checked-but-not-yet-admitted request sneaks in)
+                    # and overload (under a burst past max_inflight the
+                    # cheapest correct answer is an immediate 503 +
+                    # Retry-After — queueing collapses every request's
+                    # latency, not just the excess). One 503 shape for
+                    # both: clients and the fleet router share a single
+                    # retry path.
                     slot = running._enter()
                     if slot is None:
-                        m_shed.inc(model=name, reason="overload")
-                        self._reply(
-                            503,
-                            {"error": "overloaded; retry later"},
-                            headers={"Retry-After": "1"},
-                        )
+                        if running.draining:
+                            m_shed.inc(model=name, reason="draining")
+                            self._reply(
+                                503,
+                                {"error": "draining; endpoint is going away"},
+                                headers={"Retry-After": "1"},
+                            )
+                        else:
+                            m_shed.inc(model=name, reason="overload")
+                            self._reply(
+                                503,
+                                {"error": "overloaded; retry later"},
+                                headers={"Retry-After": "1"},
+                            )
                         return
                     try:
                         self._predict_and_reply(payload, instances, slot)
@@ -738,19 +819,47 @@ class _RunningServing:
         self.thread.start()
 
     def _enter(self) -> "_InflightSlot | None":
-        """Admit a request unless ``max_inflight`` concurrent predictor
-        executions are already in flight (None = no cap). Returns a
-        one-shot slot the caller must release."""
+        """Admit a request unless the endpoint is draining or
+        ``max_inflight`` concurrent predictor executions are already in
+        flight (None = no cap). The draining check lives HERE, under
+        the same lock as the count, so ``drain()``'s returned inflight
+        (and ``/healthz``'s) can never miss a request that had passed
+        an earlier check but not yet been admitted. Returns a one-shot
+        slot the caller must release."""
         with self._inflight_lock:
+            if self._draining:
+                return None
             if (self.max_inflight is not None
                     and self._inflight >= self.max_inflight):
                 return None
             self._inflight += 1
+            self._m_inflight.set(self._inflight)
         return _InflightSlot(self)
 
     def _exit(self) -> None:
         with self._inflight_lock:
             self._inflight -= 1
+            self._m_inflight.set(self._inflight)
+
+    def drain(self) -> int:
+        """Stop admitting new requests (they shed 503 ``draining`` with
+        ``Retry-After``); in-flight work runs to completion. Returns the
+        current in-flight count. ``/healthz`` reports ``draining`` from
+        here on — the one readiness contract the fleet router and the
+        rollout drain both key off. Idempotent."""
+        with self._inflight_lock:
+            self._draining = True
+            return self._inflight
+
+    @property
+    def draining(self) -> bool:
+        with self._inflight_lock:
+            return self._draining
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
 
     @property
     def port(self) -> int:
@@ -881,6 +990,11 @@ def create_or_update(
             model_version = int(p.name) if p.name.isdigit() else 1
     cfg = {
         "name": name,
+        # The registry model backing this endpoint: version-pinned
+        # consumers (the fleet's rollouts and heals) resolve artifacts
+        # through this, NOT the endpoint name — they differ whenever
+        # one model serves under several endpoint names.
+        "model_name": model_name or name,
         "artifact_path": artifact_path,
         "model_version": model_version,
         "model_server": model_server.upper(),

@@ -11,6 +11,12 @@ here the equivalent is this resident process:
   every serving recorded Running whose server died with its process,
   stay resident hosting them, and (with ``--watch``) re-check liveness
   every N seconds, reviving again as needed.
+- ``serving_host --fleet-worker DIR`` — one fleet replica: host the
+  serving config at ``DIR/cfg.json`` (written by
+  ``modelrepo.fleet.replicas.ReplicaManager``) WITHOUT touching the
+  shared servings registry — N replicas of one endpoint each own a
+  private port, announced via ``DIR/state.json``. The replica manager
+  owns the lifecycle (drain via ``POST /admin/drain``, then SIGTERM).
 
 Termination does NOT mark hosted servings Stopped: a record's Running
 status is its owner's *intent*, which is what lets the next
@@ -40,9 +46,14 @@ def main(argv: list[str] | None = None) -> None:
         "--watch", type=float, default=0.0,
         help="with --restore: re-check liveness every N seconds",
     )
+    parser.add_argument(
+        "--fleet-worker", metavar="DIR", default=None,
+        help="host one fleet replica from DIR/cfg.json (registry untouched; "
+        "port announced in DIR/state.json)",
+    )
     args = parser.parse_args(argv)
-    if bool(args.name) == bool(args.restore):
-        parser.error("provide a serving name or --restore")
+    if sum(map(bool, (args.name, args.restore, args.fleet_worker))) != 1:
+        parser.error("provide a serving name, --restore, or --fleet-worker")
 
     from hops_tpu.modelrepo import serving
 
@@ -54,6 +65,23 @@ def main(argv: list[str] | None = None) -> None:
     # wait, deferring the Python handler until that wait times out.)
     sigs = {signal.SIGTERM, signal.SIGINT}
     signal.pthread_sigmask(signal.SIG_BLOCK, sigs)
+
+    if args.fleet_worker:
+        from pathlib import Path
+
+        rdir = Path(args.fleet_worker)
+        cfg = json.loads((rdir / "cfg.json").read_text())
+        running = serving._RunningServing(cfg)
+        # Atomic announce: the replica manager polls for this file and
+        # must never read a partial write.
+        state = {"name": cfg["name"], "port": running.port, "pid": os.getpid(),
+                 "version": cfg.get("model_version")}
+        tmp = rdir / f".state.json.tmp{os.getpid()}"
+        tmp.write_text(json.dumps(state))
+        os.replace(tmp, rdir / "state.json")
+        print(json.dumps(state), flush=True)
+        signal.sigwait(sigs)
+        os._exit(0)
 
     if args.restore:
         names = serving.restore()

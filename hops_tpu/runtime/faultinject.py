@@ -51,6 +51,14 @@ docs/operations.md "Failure handling & fault injection"):
 ``online.materialize``  the write-through ``Materializer`` poll/flush
                         cycle (survived with backoff; freshness lag
                         rises while it stalls)
+``router.forward``      the fleet router, before forwarding a request
+                        to its chosen replica (latency delays the hop;
+                        an error is treated as a replica failure and
+                        the request retries on another replica)
+``fleet.spawn``         ``ReplicaManager.spawn``, before a replica
+                        worker is created (an error fails that spawn
+                        attempt; autoscaler/rollout retry policies own
+                        the recovery)
 ==================  ========================================================
 """
 
@@ -84,6 +92,8 @@ POINTS = (
     "lm_engine.dispatch",
     "online.lookup",
     "online.materialize",
+    "router.forward",
+    "fleet.spawn",
 )
 
 _MODES = ("error", "latency", "corrupt")

@@ -565,6 +565,102 @@ def test_naked_retry_loop_sanctions_resilience_module(tmp_path):
     assert sanctioned == []
 
 
+# -- blocking-call-no-deadline ------------------------------------------------
+
+
+FLEET_FILE = "hops_tpu/modelrepo/fleet/snip.py"
+
+
+def test_blocking_call_flags_deadlineless_urlopen_in_fleet_code(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        import socket
+        import urllib.request
+
+        def probe(port):
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz") as r:
+                return r.status
+
+        def connect(port):
+            return socket.create_connection(("127.0.0.1", port))
+        """,
+        rule="blocking-call-no-deadline",
+        filename=FLEET_FILE,
+    )
+    assert len(findings) == 2
+    assert all(f.rule == "blocking-call-no-deadline" for f in findings)
+    assert "urllib.request.urlopen" in findings[0].message
+    assert "timeout=" in findings[0].message
+
+
+def test_blocking_call_not_flagged_with_timeout_or_deadline_wrapper(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        import socket
+        import urllib.request
+
+        from hops_tpu.runtime.resilience import with_deadline
+
+        def probe(port, budget):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=budget
+            ) as r:
+                return r.status
+
+        def connect(port):
+            return socket.create_connection(("127.0.0.1", port), 0.5)
+
+        def probe_positional(url, body):
+            return urllib.request.urlopen(url, body, 2.0)  # 3rd positional = timeout
+
+        def forward(url, body):
+            return with_deadline(
+                lambda: urllib.request.urlopen(url, data=body), 2.0)
+
+        def not_a_network_get(d):
+            return d.get("key")  # dict idiom, not requests.get
+        """,
+        rule="blocking-call-no-deadline",
+        filename=FLEET_FILE,
+    )
+    assert findings == []
+
+
+def test_blocking_call_scoped_to_fleet_files_only(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    code = """
+    import urllib.request
+
+    def fetch(url):
+        return urllib.request.urlopen(url)
+    """
+    # The identical call outside fleet/ is some other module's business
+    # (serving clients pass explicit timeouts by convention, not rule).
+    outside = lint_code(tmp_path, code, rule="blocking-call-no-deadline",
+                        filename="hops_tpu/modelrepo/client.py")
+    assert outside == []
+    inside = lint_code(tmp_path, code, rule="blocking-call-no-deadline",
+                       filename=FLEET_FILE)
+    assert len(inside) == 1
+
+
+def test_blocking_call_tree_is_clean():
+    """The fleet control plane itself must hold the budget discipline
+    the rule enforces — zero findings, no baseline entries."""
+    import hops_tpu
+
+    fleet_dir = Path(hops_tpu.__file__).parent / "modelrepo" / "fleet"
+    rules = [r for r in engine.all_rules()
+             if r.name == "blocking-call-no-deadline"]
+    findings = engine.run([fleet_dir], root=fleet_dir.parent.parent.parent,
+                          rules=rules)
+    assert findings == []
+
+
 # -- suppression --------------------------------------------------------------
 
 

@@ -73,6 +73,7 @@ def test_analysis_registered_in_drift_guard():
         "hops_tpu.analysis.rules.metric_consistency",
         "hops_tpu.analysis.rules.naked_retry",
         "hops_tpu.analysis.rules.swallowed_exception",
+        "hops_tpu.analysis.rules.blocking_call",
     ):
         assert mod in names
 
@@ -109,6 +110,24 @@ def test_online_serving_registered_in_drift_guard():
     assert "hops_tpu.featurestore.online" in names
     assert "hops_tpu.native.kvstore" in names
     assert "hops_tpu.messaging.pubsub" in names
+
+
+def test_fleet_registered_in_drift_guard():
+    """The serving-fleet tier is the platform's front door (router,
+    replica manager, autoscaler, rollouts) and leans on the serving
+    module's internal surface (_RunningServing, registry files); pin
+    the package so a move or rename surfaces as one named failure
+    instead of a silent drop from the parametrized sweep."""
+    names = _module_names()
+    for mod in (
+        "hops_tpu.modelrepo.fleet",
+        "hops_tpu.modelrepo.fleet.router",
+        "hops_tpu.modelrepo.fleet.replicas",
+        "hops_tpu.modelrepo.fleet.autoscale",
+        "hops_tpu.modelrepo.fleet.rollout",
+        "hops_tpu.modelrepo.serving_host",
+    ):
+        assert mod in names
 
 
 def test_resilience_registered_in_drift_guard():

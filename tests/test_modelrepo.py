@@ -218,6 +218,74 @@ class TestServing:
         serving.delete("temp")
         assert not serving.exists("temp")
 
+    def test_drain_contract_healthz_and_shed(self, tmp_path):
+        """The fleet/rollout readiness contract: POST /admin/drain stops
+        admissions (503 + Retry-After, shed reason `draining`), flips
+        /healthz to 503 {"status": "draining", "inflight": N}, and
+        in-flight work runs to completion — the probe a router stops
+        routing on is the same one a reaper polls to zero."""
+        import threading as th
+        import time
+        import urllib.error
+        import urllib.request
+
+        from hops_tpu.telemetry.metrics import REGISTRY
+
+        script = tmp_path / "p.py"
+        script.write_text(
+            "import time\n"
+            "class Predict:\n"
+            "    def predict(self, instances):\n"
+            "        time.sleep(0.4)\n"
+            "        return [[v[0] * 2] for v in instances]\n"
+        )
+        serving.create_or_update("drainer", model_path=str(tmp_path),
+                                 model_server="PYTHON")
+        serving.start("drainer")
+        try:
+            base = serving._endpoint("drainer")
+
+            def get_healthz():
+                try:
+                    with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+                        return r.status, json.loads(r.read()), dict(r.headers)
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read()), dict(e.headers)
+
+            assert get_healthz()[0] == 200
+            results = {}
+
+            def slow_request():
+                results["r"] = serving.make_inference_request(
+                    "drainer", {"instances": [[7]]})
+
+            t = th.Thread(target=slow_request)
+            t.start()
+            time.sleep(0.15)  # request is inside the 0.4s predict
+            req = urllib.request.Request(
+                base + "/admin/drain", data=b"{}",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=10) as r:
+                drain = json.loads(r.read())
+            assert drain == {"status": "draining", "inflight": 1}
+            code, body, headers = get_healthz()
+            assert code == 503 and body["status"] == "draining"
+            assert body["inflight"] == 1 and headers["Retry-After"]
+            # New admissions shed 503 with the draining reason...
+            with pytest.raises(urllib.error.HTTPError) as e:
+                serving.make_inference_request("drainer", {"instances": [[1]]})
+            assert e.value.code == 503 and e.value.headers["Retry-After"]
+            shed = REGISTRY.counter(
+                "hops_tpu_serving_shed_total", labels=("model", "reason"))
+            assert shed.value(model="drainer", reason="draining") == 1
+            # ...while the in-flight request finishes normally.
+            t.join(timeout=10)
+            assert results["r"]["predictions"] == [[14]]
+            code, body, _ = get_healthz()
+            assert code == 503 and body["inflight"] == 0  # reap gate open
+        finally:
+            serving.stop("drainer")
+
 
 class TestBatchInference:
     def test_batch_predict_pads_tail(self, trained_ffn):
@@ -412,6 +480,83 @@ class TestStandaloneServing:
             reg["phoenix2"].pop("port", None)
             serving._save_registry(reg)
 
+    @pytest.mark.slow  # two subprocess interpreters (host + supervisor)
+    def test_watch_revives_dead_server_and_honors_deliberate_stop(
+            self, tmp_path, workspace):
+        """The --watch revive path, end to end: a hosted serving's
+        server dies mid-watch (SIGKILL on its dedicated host) and the
+        resident supervisor revives it with the record still Running —
+        while a deliberate serving.stop() is honored (reconciled down,
+        NOT revived)."""
+        import os
+        import signal as sig
+        import subprocess
+        import sys
+        import time
+
+        self._make(tmp_path, "watched")
+        serving.start("watched", standalone=True)
+        host_pid = serving._load_registry()["watched"]["pid"]
+        env = dict(os.environ)
+        env["HOPS_TPU_WORKSPACE"] = str(serving.fs.workspace_root())
+        env["HOPS_TPU_PROJECT"] = serving.fs.project_name()
+        sup = subprocess.Popen(
+            [sys.executable, "-m", "hops_tpu.modelrepo.serving_host",
+             "--restore", "--watch", "0.3"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        try:
+            # Let the supervisor finish its initial restore pass (the
+            # serving is alive, so it restores nothing and watches).
+            deadline = time.monotonic() + 90
+            while time.monotonic() < deadline and sup.poll() is None:
+                if serving.get_status("watched") == "Running":
+                    break
+                time.sleep(0.1)
+            # Kill the server MID-WATCH: SIGKILL the dedicated host —
+            # record still says Running (owner intent), port now dead.
+            # The host is OUR child: reap it, or the zombie keeps
+            # answering kill(pid, 0) and "dead" never becomes true.
+            os.kill(host_pid, sig.SIGKILL)
+            try:
+                os.waitpid(host_pid, 0)
+            except ChildProcessError:
+                pass  # already reaped by subprocess housekeeping
+            # The next watch tick must revive it inside the supervisor.
+            deadline = time.monotonic() + 90
+            revived = False
+            while time.monotonic() < deadline:
+                reg = serving._load_registry()["watched"]
+                if (reg.get("pid") == sup.pid
+                        and serving._port_alive(reg.get("port"))):
+                    revived = True
+                    break
+                time.sleep(0.1)
+            assert revived, "supervisor did not revive the killed serving"
+            assert serving._load_registry()["watched"]["status"] == "Running"
+            out = serving.make_inference_request("watched", {"instances": [[4]]})
+            assert out["predictions"] == [[8]]
+            # A DELIBERATE stop flips the record; the supervisor must
+            # reconcile its hosted server down and NOT revive it.
+            serving.stop("watched")
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if serving.get_status("watched") == "Stopped":
+                    break
+                time.sleep(0.1)
+            time.sleep(1.0)  # a few more watch periods: stays stopped
+            assert serving.get_status("watched") == "Stopped"
+            assert serving._load_registry()["watched"].get("port") is None
+        finally:
+            sup.send_signal(sig.SIGTERM)
+            sup.wait(timeout=30)
+            reg = serving._load_registry()
+            if "watched" in reg:
+                reg["watched"]["status"] = "Stopped"
+                reg["watched"].pop("port", None)
+                serving._save_registry(reg)
+
     def test_reconcile_honors_external_stop(self, tmp_path, workspace):
         """A stop() issued from another process can only flip the record;
         the hosting supervisor's reconcile() must shut the server down."""
@@ -581,3 +726,49 @@ class TestDynamicBatching:
         b.stop()
         with pytest.raises(RuntimeError, match="stopped"):
             b.predict([[1]])
+
+    def test_batcher_stop_completes_queued_work(self):
+        """Drain ordering: requests already QUEUED when stop() lands
+        still get their answers (the fleet drain completes queued work
+        before the predictor is torn down) — they used to be failed
+        with 'serving stopped'."""
+        import threading as th
+        import time as _t
+
+        gate = th.Event()
+        calls = []
+
+        def predict(instances):
+            gate.wait(5)
+            calls.append(len(instances))
+            return list(instances)
+
+        b = serving.DynamicBatcher(predict, max_batch_size=2, timeout_ms=5)
+        results, errors = {}, {}
+
+        def req(i):
+            try:
+                results[i] = b.predict([[i]])
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errors[i] = e
+
+        threads = [th.Thread(target=req, args=(i,)) for i in range(6)]
+        for t in threads:
+            t.start()
+        # Wait until the first batch is gated in predict and the rest
+        # are queued behind it.
+        deadline = _t.monotonic() + 5
+        while b._queue.qsize() < 4 and _t.monotonic() < deadline:
+            _t.sleep(0.01)
+        assert b._queue.qsize() >= 4
+        stopper = th.Thread(target=b.stop)  # stop() blocks on the drain
+        stopper.start()
+        gate.set()
+        for t in threads:
+            t.join(timeout=10)
+        stopper.join(timeout=10)
+        assert errors == {}
+        assert sorted(results) == list(range(6))
+        assert all(results[i] == [[i]] for i in range(6))
+        assert sum(calls) == 6
+        assert max(calls) <= 2  # the drain still respects the cap
