@@ -978,6 +978,79 @@ def run_fault_overhead_bench(calls: int = 1_000_000) -> dict:
     }
 
 
+def run_tracing_overhead_bench(calls: int = 200_000) -> dict:
+    """Tracing-plumbing overhead on the serving hot path, measured.
+
+    Every request handler now calls into ``telemetry/tracing.py``
+    (``start_trace`` / ``child_span`` / ``current_trace_id``); the
+    contract mirrors faultinject's: with tracing DISABLED each entry
+    point is one module-flag test, and with tracing on but the request
+    untraced, one extra contextvar read. This smoke times tight loops
+    of the three hot-path shapes against an empty same-shape loop:
+
+    - ``disabled``: ``child_span`` + ``current_trace_id`` with tracing
+      off — the cost every request pays when an operator disables
+      tracing (test-bounded, like the disarmed-fire bound);
+    - ``untraced``: the same with tracing ON but no active trace — the
+      cost of instrumented-but-unsampled paths;
+    - ``sampled``: a full ``start_trace`` + entered ``child_span`` per
+      iteration — the per-request cost of a 100%-sampled trace with
+      ring recording.
+
+    Host-only: no accelerator, no relay.
+    """
+    from hops_tpu.telemetry import tracing
+
+    prev_enabled = tracing.enabled()
+    prev_rate = tracing.TRACER.sample_rate
+
+    def timed_loop(fn, n):
+        fn(5_000)  # warm caches / specialize
+
+        def empty(k):
+            for _ in range(k):
+                pass
+
+        empty(5_000)
+        t0 = time.perf_counter()
+        fn(n)
+        body_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        empty(n)
+        empty_s = time.perf_counter() - t0
+        return max(0.0, (body_s - empty_s) / n * 1e9)
+
+    child_span = tracing.child_span
+    current_trace_id = tracing.current_trace_id
+
+    def hot_path(n):
+        for _ in range(n):
+            with child_span("bench.hop"):
+                pass
+            current_trace_id()
+
+    def sampled(n):
+        for _ in range(n):
+            with tracing.start_trace("bench.request"):
+                with child_span("bench.hop"):
+                    pass
+
+    try:
+        tracing.configure(enabled=False)
+        disabled_ns = timed_loop(hot_path, calls)
+        tracing.configure(enabled=True, sample_rate=1.0)
+        untraced_ns = timed_loop(hot_path, calls)
+        sampled_ns = timed_loop(sampled, max(1, calls // 10))
+    finally:
+        tracing.configure(enabled=prev_enabled, sample_rate=prev_rate)
+    return {
+        "calls": calls,
+        "ns_per_disabled_span": round(disabled_ns, 1),
+        "ns_per_untraced_span": round(untraced_ns, 1),
+        "us_per_sampled_trace": round(sampled_ns / 1e3, 3),
+    }
+
+
 def _lm_serving_workload(requests: int, seed: int, rate_rps: float, *,
                          short, long, long_frac, budget):
     """Seeded Poisson arrival process with a mixed prompt-length
@@ -1351,6 +1424,13 @@ def main() -> None:
         "zero-overhead-when-disarmed contract",
     )
     parser.add_argument(
+        "--tracing-overhead", action="store_true",
+        help="measure the request-tracing plumbing cost on the serving "
+        "hot path: disabled (ns/span), enabled-but-untraced (ns/span), "
+        "and fully sampled (us/trace); host-only, guards the "
+        "tracing-disabled-is-free contract",
+    )
+    parser.add_argument(
         "--lm", action="store_true",
         help="LM training headline instead of ResNet-50: ~180M-param "
         "TransformerLM (d_head 128, flash attention, chunked LM-head "
@@ -1397,6 +1477,13 @@ def main() -> None:
         result = run_fault_overhead_bench()
         print(json.dumps({"metric": "faultinject_disarmed_ns_per_call",
                           "value": result["ns_per_disarmed_fire"],
+                          "unit": "ns", **result}))
+        return
+
+    if args.tracing_overhead:
+        result = run_tracing_overhead_bench()
+        print(json.dumps({"metric": "tracing_disabled_ns_per_span",
+                          "value": result["ns_per_disabled_span"],
                           "unit": "ns", **result}))
         return
 

@@ -7,6 +7,7 @@ Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 - :mod:`.host_sync` — ``host-sync-in-loop``
 - :mod:`.lock_discipline` — ``lock-discipline``
 - :mod:`.metric_consistency` — ``metric-name-consistency``
+- :mod:`.debug_surfaces` — ``debug-surface-docs``
 - :mod:`.swallowed_exception` — ``swallowed-exception``
 - :mod:`.naked_retry` — ``naked-retry-loop``
 - :mod:`.blocking_call` — ``blocking-call-no-deadline``
@@ -14,6 +15,7 @@ Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 
 from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effects
     blocking_call,
+    debug_surfaces,
     donation,
     host_sync,
     jit_purity,

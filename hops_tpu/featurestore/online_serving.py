@@ -66,6 +66,7 @@ from hops_tpu.runtime import faultinject
 from hops_tpu.runtime.checkpoint import CheckpointCorruptError, _file_sha256
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.runtime.resilience import CircuitBreaker, with_deadline
+from hops_tpu.telemetry import tracing
 from hops_tpu.telemetry.metrics import REGISTRY
 
 log = get_logger(__name__)
@@ -843,14 +844,22 @@ class FeatureJoinPredictor:
         """Joined model-ready vectors for a batch of entity entries."""
         t0 = time.perf_counter()
         merged: list[dict[str, Any]] = [{} for _ in entries]
-        for store, feats in self._groups:
-            rows = store.multi_get(entries, deadline_s=self._deadline_s)
-            for m, row in zip(merged, rows):
-                if row is None:
-                    continue
-                m.update(
-                    {k: v for k, v in row.items() if not feats or k in feats}
-                )
+        # Child of the request trace when one is active (the batcher
+        # runs the coalesced join under the carrier request's context);
+        # a no-op outside one.
+        with tracing.child_span(
+            "featurestore.join",
+            entities=len(entries), groups=len(self._groups),
+        ):
+            for store, feats in self._groups:
+                rows = store.multi_get(entries, deadline_s=self._deadline_s)
+                for m, row in zip(merged, rows):
+                    if row is None:
+                        continue
+                    m.update(
+                        {k: v for k, v in row.items()
+                         if not feats or k in feats}
+                    )
         vectors: list[list[Any]] = []
         for entry, m in zip(entries, merged):
             vec: list[Any] = []

@@ -40,7 +40,7 @@ from pathlib import Path
 from typing import Any
 
 from hops_tpu.modelrepo import serving
-from hops_tpu.runtime import faultinject, fs
+from hops_tpu.runtime import faultinject, flight, fs
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.telemetry.metrics import REGISTRY
 
@@ -280,6 +280,8 @@ class ReplicaManager:
         while time.monotonic() < deadline:
             if self._probe(rep)[0] == "ok":
                 rep.state = "ready"
+                flight.record("replica_state", model=self.name,
+                              rid=rep.rid, state="ready")
                 self._publish_states()
                 return rep
             if rep.proc is not None and rep.proc.poll() is not None:
@@ -290,6 +292,8 @@ class ReplicaManager:
         # sweep skips "failed", so nothing else ever would.
         self._teardown(rep)
         rep.state = "failed"
+        flight.record("replica_state", model=self.name,
+                      rid=rep.rid, state="failed")
         self._forget(rep.rid)
         self._publish_states()
         raise FleetSpawnError(
@@ -366,6 +370,8 @@ class ReplicaManager:
                             "(already dead?); treating as draining",
                             self.name, rid)
         rep.state = "draining"
+        flight.record("replica_state", model=self.name,
+                      rid=rep.rid, state="draining")
         self._publish_states()
 
     def drained(self, rid: str) -> bool:
@@ -402,6 +408,8 @@ class ReplicaManager:
             return
         self._teardown(rep, grace_s=grace_s)
         rep.state = "stopped"
+        flight.record("replica_state", model=self.name,
+                      rid=rid, state="stopped", how="reap")
         self._forget(rid)
         self._publish_states()
         log.info("fleet %s: replica %s reaped", self.name, rid)
@@ -419,6 +427,8 @@ class ReplicaManager:
             rep.server.stop()
             rep.server = None
         rep.state = "stopped"
+        flight.record("replica_state", model=self.name,
+                      rid=rid, state="stopped", how="kill")
         self._forget(rid)
         self._publish_states()
         log.warning("fleet %s: replica %s KILLED (chaos)", self.name, rid)

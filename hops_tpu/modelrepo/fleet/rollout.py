@@ -33,6 +33,7 @@ import time
 from typing import Any
 
 from hops_tpu.modelrepo.fleet.replicas import FleetSpawnError
+from hops_tpu.runtime import flight
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.telemetry.metrics import REGISTRY
 
@@ -81,6 +82,7 @@ def roll_out(
         canary = manager.spawn(version)
     except FleetSpawnError as e:
         _m_rollouts.inc(model=name, outcome="spawn_failed")
+        flight.record("rollout", model=name, outcome="spawn_failed")
         raise RolloutError(
             f"fleet {name!r}: version {version} failed to warm a canary: {e}"
         ) from e
@@ -115,6 +117,7 @@ def roll_out(
                     "rolling back", name, canary.rid, version)
         _drain_and_reap(manager, canary.rid, drain_timeout_s, poll_interval_s)
         _m_rollouts.inc(model=name, outcome="rolled_back")
+        flight.record("rollout", model=name, outcome="rolled_back")
         return {
             "outcome": "rolled_back",
             "version": version,
@@ -150,6 +153,7 @@ def roll_out(
                             "(%s); aborting with %d/%d replaced",
                             name, e, len(replaced), len(olds))
                 _m_rollouts.inc(model=name, outcome="rolled_back")
+                flight.record("rollout", model=name, outcome="rolled_back")
                 return {
                     "outcome": "rolled_back",
                     "version": version,
@@ -190,6 +194,7 @@ def roll_out(
             if not stragglers:
                 time.sleep(poll_interval_s)
     _m_rollouts.inc(model=name, outcome="completed")
+    flight.record("rollout", model=name, outcome="completed")
     log.info("fleet %s: rollout to version %s complete (%d replaced, %.2fs)",
              name, version, len(replaced), time.monotonic() - t0)
     return {

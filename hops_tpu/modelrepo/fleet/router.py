@@ -43,10 +43,11 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
-from hops_tpu.runtime import faultinject
+from hops_tpu.runtime import faultinject, flight
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.runtime.resilience import CircuitBreaker
 from hops_tpu.telemetry import export as telemetry_export
+from hops_tpu.telemetry import tracing
 from hops_tpu.telemetry.metrics import REGISTRY
 from hops_tpu.telemetry.spans import span
 
@@ -218,6 +219,11 @@ class _ReplicaView:
         self.shed_rate = 0.0
         self._last_shed_total: float | None = None
         self.scrape_ok = True
+        # Monotonic time of the last SUCCESSFUL scrape: `GET /fleet`
+        # serves its age so a stale scrape (wedged or unreachable
+        # replica) is distinguishable from a healthy idle one whose
+        # numbers just happen to sit at zero.
+        self.last_scrape_mono: float | None = None
 
     def inflight_inc(self) -> None:
         with self._count_lock:
@@ -287,6 +293,11 @@ class Router:
                 try:
                     if telemetry_export.handle_metrics_path(self):
                         return
+                    # Debug surfaces on the router's own port: ITS span
+                    # ring (for in-process fleets this includes replica
+                    # spans — one shared ring) and flight recorder.
+                    if telemetry_export.handle_debug_path(self):
+                        return
                     path = self.path.rstrip("/")
                     if path == "/healthz":
                         ready = router.routable()
@@ -326,8 +337,27 @@ class Router:
                         )
                         return
                     t0 = time.perf_counter()
-                    with span("hops_tpu_fleet_request", model=name):
-                        code, payload, headers = router.route(body)
+                    # The trace starts (or, with an incoming
+                    # `traceparent`, extends) at the fleet's front
+                    # door; every forward hop below becomes a child,
+                    # and the chosen sampling decision rides the
+                    # injected header to the replicas.
+                    debug = (self.headers.get(tracing.DEBUG_HEADER) or "")
+                    relay_headers = (
+                        {tracing.DEBUG_HEADER: debug} if debug else None)
+                    # An explicit timeline ask force-samples: the
+                    # operator debugging a request must get the
+                    # breakdown whatever the ambient sample rate.
+                    tspan = tracing.start_trace(
+                        "fleet.request", headers=self.headers, model=name,
+                        force_sample=debug.strip().lower() == "timeline")
+                    with tspan:
+                        with span("hops_tpu_fleet_request", model=name):
+                            code, payload, headers = router.route(
+                                body, extra_headers=relay_headers)
+                        if (debug.strip().lower() == "timeline"
+                                and isinstance(payload, dict)):
+                            router._merge_debug(payload, tspan)
                     # Rolling window behind recent_p99_ms(): the
                     # autoscaler's latency trigger reads this, the
                     # histogram above is for dashboards.
@@ -403,6 +433,7 @@ class Router:
                 view.scrape_ok = False
                 continue
             view.scrape_ok = True
+            view.last_scrape_mono = time.monotonic()
             view.queue_depth = snap["queue_depth"]
             view.scraped_inflight = snap["inflight"]
             shed = snap["shed_total"]
@@ -469,14 +500,24 @@ class Router:
         )
         return candidates[scored[0][2]]
 
-    def route(self, body: bytes) -> tuple[int, dict[str, Any], dict[str, str]]:
+    def route(
+        self, body: bytes, extra_headers: dict[str, str] | None = None
+    ) -> tuple[int, dict[str, Any], dict[str, str]]:
         """Forward ``body`` to the best replica, retrying the next-best
         on transport failure / replica 5xx / shed-503 until attempts or
-        replicas run out. Returns ``(status, payload, headers)``."""
+        replicas run out. Returns ``(status, payload, headers)``.
+
+        Tracing: each forward attempt is a ``fleet.forward`` child span
+        of the caller's active trace, tagged with the replica id, the
+        attempt index, and the replica breaker's state at selection
+        time — so retries read as SIBLING hops under one request, and
+        the ``traceparent`` injected on the wire makes the replica's
+        own ``serving.request`` span a child of the hop that reached
+        it."""
         attempts = self.max_attempts or max(3, len(self.manager.replicas()) + 1)
         tried: set[str] = set()
         last: tuple[int, dict[str, Any], dict[str, str]] | None = None
-        for _ in range(attempts):
+        for attempt in range(attempts):
             rep = self.pick(exclude=tried)
             if rep is None:
                 break
@@ -486,24 +527,35 @@ class Router:
                 continue  # raced open, or half-open probe budget spent
             _m_forwards.inc(model=self.name, replica=rep.rid)
             view.inflight_inc()
+            fspan = tracing.child_span(
+                "fleet.forward", replica=rep.rid, attempt=attempt,
+                breaker=view.breaker.state,
+            )
             try:
-                try:
-                    # Chaos point. ANY armed error class models a
-                    # transport failure on this hop (the catalog
-                    # promises a retry, and the fault grammar defaults
-                    # to RuntimeError) — only the real forward below
-                    # narrows to transport exception types.
-                    faultinject.fire("router.forward")
-                except Exception as e:
-                    raise urllib.error.URLError(e) from e
-                code, payload, headers = self._forward(rep.port, body)
-            except (OSError, urllib.error.URLError):
+                with fspan:
+                    try:
+                        # Chaos point. ANY armed error class models a
+                        # transport failure on this hop (the catalog
+                        # promises a retry, and the fault grammar defaults
+                        # to RuntimeError) — only the real forward below
+                        # narrows to transport exception types.
+                        faultinject.fire("router.forward")
+                    except Exception as e:
+                        raise urllib.error.URLError(e) from e
+                    code, payload, headers = self._forward(
+                        rep.port, body, extra_headers)
+                    fspan.annotate(status=code)
+            except (OSError, urllib.error.URLError) as e:
                 # Transport failure: the replica is gone or wedged —
                 # breaker strike, retry elsewhere. The request has NOT
                 # been answered, so this retry is invisible to the
                 # client beyond latency.
                 view.breaker.record_failure()
                 _m_retries.inc(model=self.name, reason="connect")
+                flight.record("retry", op="router.forward",
+                              reason="connect", replica=rep.rid,
+                              model=self.name,
+                              error=type(getattr(e, "reason", e)).__name__)
                 continue
             finally:
                 view.inflight_dec()
@@ -514,11 +566,15 @@ class Router:
                 # Shedding/draining: load, not failure. Don't strike
                 # the breaker; try a less-loaded replica.
                 _m_retries.inc(model=self.name, reason="shed")
+                flight.record("retry", op="router.forward", reason="shed",
+                              replica=rep.rid, model=self.name)
                 last = (code, payload, headers)
                 continue
             if code >= 500:
                 view.breaker.record_failure()
                 _m_retries.inc(model=self.name, reason="error")
+                flight.record("retry", op="router.forward", reason="error",
+                              replica=rep.rid, model=self.name, status=code)
                 last = (code, payload, headers)
                 continue
             # 4xx: the client's request is bad everywhere — relay as-is.
@@ -532,11 +588,17 @@ class Router:
         )
 
     def _forward(
-        self, port: int, body: bytes
+        self, port: int, body: bytes,
+        extra_headers: dict[str, str] | None = None,
     ) -> tuple[int, dict[str, Any], dict[str, str]]:
+        headers = {"Content-Type": "application/json", **(extra_headers or {})}
+        # Propagate the trace across the process boundary: the active
+        # span here is this hop's fleet.forward, so the replica's
+        # serving.request parents to exactly the hop that reached it.
+        tracing.inject_headers(headers)
         req = urllib.request.Request(
             f"http://127.0.0.1:{port}/v1/models/{self.name}:predict",
-            data=body, headers={"Content-Type": "application/json"},
+            data=body, headers=headers,
         )
         try:
             with urllib.request.urlopen(
@@ -550,6 +612,21 @@ class Router:
             except ValueError:
                 payload = {"error": f"replica answered {e.code}"}
             return e.code, payload, _relay_headers(e.headers)
+
+    def _merge_debug(self, payload: dict[str, Any], tspan: Any) -> None:
+        """Fold the router's own spans for this trace into the inline
+        timeline a replica returned under ``X-Hops-Debug: timeline``
+        (dedup by span id: with in-process replicas the shared ring
+        already holds the replica's spans)."""
+        dbg = payload.setdefault("debug", {})
+        rows = {r["span_id"]: r for r in dbg.get("timeline", [])
+                if isinstance(r, dict) and "span_id" in r}
+        for r in tracing.timeline(tspan):
+            rows.setdefault(r["span_id"], r)
+        merged = sorted(rows.values(), key=lambda r: r.get("start", 0.0))
+        if merged:
+            dbg["timeline"] = merged
+            dbg.setdefault("trace_id", merged[0].get("trace_id"))
 
     # -- surface --------------------------------------------------------------
 
@@ -590,6 +667,7 @@ class Router:
 
     def describe(self) -> dict[str, Any]:
         reps = []
+        now = time.monotonic()
         for rep in self.manager.replicas():
             view = self._view(rep.rid)
             reps.append({
@@ -599,6 +677,16 @@ class Router:
                 "version": getattr(rep, "version", None),
                 "score": round(view.score(), 3),
                 "breaker": view.breaker.state,
+                # How long the breaker has sat in that state, and how
+                # stale the scraped load numbers are (None = never
+                # scraped): without the ages a wedged replica whose
+                # last scrape said "idle" is indistinguishable from a
+                # healthy idle one.
+                "breaker_state_age_s": round(view.breaker.state_age_s(), 3),
+                "last_scrape_age_s": (
+                    round(now - view.last_scrape_mono, 3)
+                    if view.last_scrape_mono is not None else None
+                ),
             })
         return {"model": self.name, "replicas": reps,
                 "ready": sum(1 for r in reps if r["state"] == "ready")}

@@ -48,7 +48,7 @@ from jax.sharding import PartitionSpec as P
 
 from hops_tpu.models.generation import top_p_mask
 from hops_tpu.modelrepo.paged import BlockPool
-from hops_tpu.runtime import faultinject
+from hops_tpu.runtime import faultinject, flight
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.telemetry.metrics import REGISTRY
 
@@ -2130,6 +2130,8 @@ class LMEngine:
             "lm_engine dispatch failed; %d in-flight request(s) failed "
             "(%s: %s)", len(failed), type(exc).__name__, exc,
         )
+        flight.record("dispatch_failure", failed=len(failed),
+                      error=f"{type(exc).__name__}: {exc}")
         return []
 
     def _bucket(self, n: int) -> int:

@@ -35,7 +35,7 @@ import numpy as np
 
 from hops_tpu.messaging import pubsub
 from hops_tpu.modelrepo import registry
-from hops_tpu.runtime import faultinject, fs
+from hops_tpu.runtime import faultinject, flight, fs
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.runtime.resilience import (
     CircuitBreaker,
@@ -43,6 +43,7 @@ from hops_tpu.runtime.resilience import (
     with_deadline,
 )
 from hops_tpu.telemetry import export as telemetry_export
+from hops_tpu.telemetry import tracing
 from hops_tpu.telemetry.metrics import RATIO_BUCKETS, REGISTRY
 from hops_tpu.telemetry.spans import span
 
@@ -262,6 +263,12 @@ class LMEnginePredictor:
 
     def predict(self, instances: list[Any]) -> list[Any]:
         parsed = [self._parse(i) for i in instances]
+        # The engine steps on ITS driver thread; attribute each
+        # ticket's submit→finish window back to this request's trace
+        # retroactively (with per-ticket TTFT, the queue/prefill vs
+        # decode split) once the results are in.
+        trace_ctx = tracing.current_context()
+        t_submit = time.time()
         with self._cv:
             if self._stopping:
                 raise RuntimeError("serving stopped")
@@ -298,8 +305,23 @@ class LMEnginePredictor:
             # fault point, real backend error) failed only the affected
             # tickets; surface it as this request's 5xx while other
             # callers keep streaming.
+            ttfts = {t: self._engine.ttft_s.get(t) for t in tickets}
             errors = [self._engine.take_error(t) for t in tickets]
             results = [self._engine.take_result(t) for t in tickets]
+            if trace_ctx is not None:
+                dur = time.time() - t_submit
+                for t, res, err in zip(tickets, results, errors):
+                    attrs: dict[str, Any] = {
+                        "ticket": t,
+                        "tokens": len(res) if res is not None else 0,
+                    }
+                    if ttfts.get(t) is not None:
+                        attrs["ttft_ms"] = round(ttfts[t] * 1e3, 3)
+                    if err is not None:
+                        attrs["error"] = type(err).__name__
+                    tracing.record_span(
+                        "lm_engine.dispatch", trace_ctx, t_submit, dur,
+                        **attrs)
             first = next((e for e in errors if e is not None), None)
             if first is not None:
                 raise RuntimeError(
@@ -384,6 +406,11 @@ class DynamicBatcher:
         from concurrent.futures import Future
 
         fut: Future = Future()
+        # The handler thread's trace context rides along so the batcher
+        # thread can attribute queue-wait and the shared batch-compute
+        # time back to THIS request's trace (queue vs compute split).
+        item = (list(instances), fut, tracing.current_context(),
+                time.monotonic(), time.time())
         # Check-and-enqueue is atomic with stop()'s flag-and-sentinel:
         # every item the queue ever holds precedes the sentinel, so the
         # loop (or its stop-time drain) resolves every future — no
@@ -391,7 +418,7 @@ class DynamicBatcher:
         with self._stop_lock:
             if self._stopped:
                 raise RuntimeError("serving stopped")
-            self._queue.put((list(instances), fut))
+            self._queue.put(item)
         self._m_queue_depth.set(self._queue.qsize())
         return fut.result()
 
@@ -482,21 +509,62 @@ class DynamicBatcher:
                 item[1].set_exception(RuntimeError("serving stopped"))
 
     def _run(self, pending) -> None:
-        flat = [row for instances, _ in pending for row in instances]
+        flat = [row for instances, *_ in pending for row in instances]
         self._m_queue_depth.set(self._queue.qsize())
         # An over-cap single request runs alone, unsplit — clamp so the
         # ratio histogram stays in [0, 1].
         self._m_fill.observe(min(len(flat) / self.max_batch_size, 1.0))
-        try:
-            preds = self._predict(flat)
-        except Exception as e:  # noqa: BLE001 — fail THIS batch only
-            for _, fut in pending:
-                fut.set_exception(e)
+        # Trace attribution for the coalesced batch: the predict runs
+        # ONCE for every queued request, under the first traced
+        # request's context (its trace carries the real compute span
+        # and any children the predictor emits, e.g. the feature
+        # join); every other traced request gets the same compute
+        # window recorded retroactively, all linked by `batch`, and
+        # every traced request gets its own queue-wait span — the
+        # queue-wait vs compute split, per request.
+        carrier = next(
+            (it[2] for it in pending if it[2] is not None and it[2].sampled),
+            None,
+        )
+        t_run_mono, t_run_wall = time.monotonic(), time.time()
+        error: Exception | None = None
+        preds = None
+        with tracing.use_context(carrier):
+            cspan = tracing.child_span(
+                "serving.batch.compute",
+                rows=len(flat), requests=len(pending), shared=True,
+            )
+            try:
+                with cspan:
+                    preds = self._predict(flat)
+            except Exception as e:  # noqa: BLE001 — fail THIS batch only
+                error = e
+        batch_id = cspan.span_id or None
+        compute_s = time.monotonic() - t_run_mono
+        for instances, fut, ctx, enq_mono, enq_wall in pending:
+            if ctx is None:
+                continue
+            tracing.record_span(
+                "serving.batch.queue_wait", ctx, enq_wall,
+                max(0.0, t_run_mono - enq_mono), batch=batch_id,
+            )
+            if ctx is not carrier:
+                attrs = {"batch": batch_id, "rows": len(flat),
+                         "requests": len(pending), "shared": True}
+                if error is not None:
+                    attrs["error"] = f"{type(error).__name__}: {error}"
+                tracing.record_span(
+                    "serving.batch.compute", ctx, t_run_wall, compute_s,
+                    **attrs,
+                )
+        if error is not None:
+            for _, fut, *_rest in pending:
+                fut.set_exception(error)
             return
         self.batches_run += 1
         self.rows_run += len(flat)
         start = 0
-        for instances, fut in pending:
+        for instances, fut, *_rest in pending:
             fut.set_result(preds[start:start + len(instances)])
             start += len(instances)
 
@@ -630,8 +698,13 @@ class _RunningServing:
                 try:
                     # Prometheus scrape rides the serving's own port
                     # (GET /metrics, GET /metrics.json) — the whole
-                    # process's registry, not just this endpoint.
+                    # process's registry, not just this endpoint. The
+                    # debug surfaces (/debug/traces, /debug/flight)
+                    # ride the same port: this process's span ring and
+                    # flight recorder.
                     if telemetry_export.handle_metrics_path(self):
+                        return
+                    if telemetry_export.handle_debug_path(self):
                         return
                     # Readiness: load balancers and supervisors poll
                     # this; an open breaker = the predictor is down,
@@ -709,51 +782,83 @@ class _RunningServing:
                         self._reply(400, {"error": "payload must carry 'instances'"})
                         return
                     m_requests.inc()
-                    # Shedding BEFORE any model work — draining (stop
-                    # ADMITTING, keep finishing; the admission check is
-                    # atomic with the in-flight count inside _enter, so
-                    # /healthz can never report inflight==0 while a
-                    # checked-but-not-yet-admitted request sneaks in)
-                    # and overload (under a burst past max_inflight the
-                    # cheapest correct answer is an immediate 503 +
-                    # Retry-After — queueing collapses every request's
-                    # latency, not just the excess). One 503 shape for
-                    # both: clients and the fleet router share a single
-                    # retry path.
-                    slot = running._enter()
-                    if slot is None:
-                        if running.draining:
-                            m_shed.inc(model=name, reason="draining")
-                            self._reply(
-                                503,
-                                {"error": "draining; endpoint is going away"},
-                                headers={"Retry-After": "1"},
-                            )
-                        else:
-                            m_shed.inc(model=name, reason="overload")
-                            self._reply(
-                                503,
-                                {"error": "overloaded; retry later"},
-                                headers={"Retry-After": "1"},
-                            )
-                        return
-                    try:
-                        self._predict_and_reply(payload, instances, slot)
-                    finally:
-                        slot.release()  # no-op once transferred to a worker
+                    # The trace enters (or starts) here: an incoming
+                    # `traceparent` — the fleet router injects one per
+                    # forward hop — makes this request span a child of
+                    # that hop; a bare request starts a fresh trace
+                    # under the tracer's sampling decision.
+                    want_debug = (
+                        self.headers.get(tracing.DEBUG_HEADER) or ""
+                    ).strip().lower() == "timeline"
+                    tspan = tracing.start_trace(
+                        "serving.request", headers=self.headers, model=name,
+                        force_sample=want_debug)
+                    with tspan:
+                        # Shedding BEFORE any model work — draining (stop
+                        # ADMITTING, keep finishing; the admission check is
+                        # atomic with the in-flight count inside _enter, so
+                        # /healthz can never report inflight==0 while a
+                        # checked-but-not-yet-admitted request sneaks in)
+                        # and overload (under a burst past max_inflight the
+                        # cheapest correct answer is an immediate 503 +
+                        # Retry-After — queueing collapses every request's
+                        # latency, not just the excess). One 503 shape for
+                        # both: clients and the fleet router share a single
+                        # retry path.
+                        slot = running._enter()
+                        if slot is None:
+                            if running.draining:
+                                m_shed.inc(model=name, reason="draining")
+                                tspan.annotate(shed="draining")
+                                self._reply(
+                                    503,
+                                    {"error": "draining; endpoint is going away"},
+                                    headers={"Retry-After": "1"},
+                                )
+                            else:
+                                m_shed.inc(model=name, reason="overload")
+                                tspan.annotate(shed="overload")
+                                self._reply(
+                                    503,
+                                    {"error": "overloaded; retry later"},
+                                    headers={"Retry-After": "1"},
+                                )
+                            return
+                        try:
+                            self._predict_and_reply(
+                                payload, instances, slot, tspan)
+                        finally:
+                            slot.release()  # no-op once transferred
                 except Exception as e:  # noqa: BLE001 — server must stay up
                     m_errors.inc()
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
+            def _maybe_debug(self, body: dict[str, Any],
+                             tspan: Any) -> dict[str, Any]:
+                """Attach the inline per-hop timing breakdown when the
+                request asked for it (``X-Hops-Debug: timeline``) and
+                this request is traced — the router merges its own hops
+                into the same list on the way back out."""
+                want = self.headers.get(tracing.DEBUG_HEADER, "")
+                if want.strip().lower() == "timeline":
+                    rows = tracing.timeline(tspan)
+                    if rows:
+                        body["debug"] = {
+                            "trace_id": rows[0]["trace_id"],
+                            "timeline": rows,
+                        }
+                return body
+
             def _predict_and_reply(
                 self, payload: dict[str, Any], instances: list[Any],
-                slot: _InflightSlot,
+                slot: _InflightSlot, tspan: Any,
             ) -> None:
                 # Breaker check after shedding: an open breaker means
                 # the predictor itself is failing — don't waste a
                 # half-open probe on a request we'd shed anyway.
                 if not breaker.allow():
                     m_shed.inc(model=name, reason="breaker")
+                    tspan.annotate(shed="breaker")
                     retry = max(1.0, breaker.retry_after_s())
                     self._reply(
                         503,
@@ -788,12 +893,14 @@ class _RunningServing:
                 except DeadlineExceeded as e:
                     breaker.record_failure()
                     m_errors.inc()
-                    self._reply(504, {"error": f"{type(e).__name__}: {e}"})
+                    self._reply(504, self._maybe_debug(
+                        {"error": f"{type(e).__name__}: {e}"}, tspan))
                     return
                 except Exception as e:  # noqa: BLE001 — fail THIS request
                     breaker.record_failure()
                     m_errors.inc()
-                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    self._reply(500, self._maybe_debug(
+                        {"error": f"{type(e).__name__}: {e}"}, tspan))
                     return
                 breaker.record_success()
                 response = {"predictions": preds}
@@ -801,7 +908,7 @@ class _RunningServing:
                     {"request": payload, "response": response}, key=name
                 )
                 m_logged.inc()
-                self._reply(200, response)
+                self._reply(200, self._maybe_debug(response, tspan))
 
             def _reply(self, code: int, body: dict[str, Any],
                        headers: dict[str, str] | None = None) -> None:
@@ -848,8 +955,12 @@ class _RunningServing:
         here on — the one readiness contract the fleet router and the
         rollout drain both key off. Idempotent."""
         with self._inflight_lock:
+            already = self._draining
             self._draining = True
-            return self._inflight
+            inflight = self._inflight
+        if not already:
+            flight.record("drain", model=self.cfg["name"], inflight=inflight)
+        return inflight
 
     @property
     def draining(self) -> bool:

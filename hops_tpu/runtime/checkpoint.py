@@ -38,7 +38,7 @@ from typing import Any
 import jax
 import orbax.checkpoint as ocp
 
-from hops_tpu.runtime import faultinject, rundir
+from hops_tpu.runtime import faultinject, flight, rundir
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.telemetry.metrics import REGISTRY
 
@@ -286,6 +286,7 @@ class CheckpointManager:
         ``.quarantined`` suffix keeps orbax's step scanner from parsing
         it as a step) and drop its manifest."""
         step = int(step)
+        flight.record("quarantine", step=step, reason=reason)
         step_dir = self._step_dir(step)
         target = self.directory / f"corrupt_{step}.quarantined"
         if target.exists():  # re-quarantine of the same step number

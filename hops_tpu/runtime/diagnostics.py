@@ -15,6 +15,14 @@ tooling**, and no deterministic mode. The TPU equivalents:
 - :func:`deterministic_mode` — one switch for bitwise-reproducible runs
   (XLA deterministic ops + seeded ``jax.random`` keys), the stand-in
   for race detection on a platform where the compiler owns scheduling.
+- :class:`FlightRecorder` / :data:`FLIGHT` — the crash/fault flight
+  recorder: a bounded ring of recent structured events (faults fired,
+  breaker transitions, retries, drains, quarantines, preemptions),
+  dumped to the rundir on unhandled failure and served at
+  ``GET /debug/flight``. Lives in the stdlib-only
+  :mod:`hops_tpu.runtime.flight` (this module imports jax; serving
+  hosts and the fleet router must not) and is re-exported here as the
+  diagnostics surface.
 """
 
 from __future__ import annotations
@@ -30,6 +38,11 @@ from typing import Iterator
 import jax
 
 from hops_tpu.runtime import rundir
+from hops_tpu.runtime.flight import (  # noqa: F401 — diagnostics surface
+    FLIGHT,
+    FlightRecorder,
+    install_crash_handler,
+)
 from hops_tpu.runtime.logging import get_logger
 
 log = get_logger(__name__)

@@ -74,7 +74,9 @@ import time
 from pathlib import Path
 from typing import Any
 
+from hops_tpu.runtime import flight
 from hops_tpu.runtime.logging import get_logger
+from hops_tpu.telemetry import tracing
 from hops_tpu.telemetry.metrics import REGISTRY
 
 log = get_logger(__name__)
@@ -268,6 +270,12 @@ def arm_from_env(environ: dict | None = None) -> FaultPlan | None:
 def _apply(spec: FaultSpec, point: str) -> bool:
     """Execute one fired spec; returns True when it was ``corrupt``."""
     _m_injected.inc(point=point, mode=spec.mode)
+    # The black box + the causal thread: a fired fault lands in the
+    # flight recorder and annotates whatever request trace it fired
+    # under, so post-incident the injected failure, the retry it
+    # provoked, and the breaker it tripped read in one sequence.
+    flight.record("fault_fired", point=point, mode=spec.mode)
+    tracing.add_event("fault_fired", point=point, mode=spec.mode)
     if spec.mode == "latency":
         log.warning("faultinject: %s sleeping %.3fs", point, spec.arg)
         time.sleep(spec.arg)

@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Any
 
+from hops_tpu.runtime import flight
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.telemetry.spans import StepTimer
 
@@ -94,6 +95,11 @@ class PreemptionGuard:
     def _handler(self, signum, frame) -> None:
         log.warning("preemption notice (signal %s): will stop at the next "
                     "step boundary", signum)
+        # Signal-handler context: flight.record is async-signal-unsafe
+        # in theory (it takes a lock) but never blocks on anything that
+        # could be interrupted mid-hold by THIS handler, and by
+        # contract it never raises.
+        flight.record("preemption", signal=int(signum))
         self._flag.set()
         prev = self._previous.get(signum)
         if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
@@ -201,6 +207,9 @@ def run_preemptible(
 
     own_guard = guard is None
     guard = guard or PreemptionGuard()
+    # The crash path of the flight recorder: an unhandled failure in
+    # this (supervised) loop dumps the event ring to the rundir.
+    flight.install_crash_handler()
     if sync is None:
         sync = jax.process_count() > 1
     policy = recovery_policy or RetryPolicy(base_delay_s=0.05, max_delay_s=5.0)
@@ -226,6 +235,9 @@ def run_preemptible(
                     raise
                 recoveries += 1
                 m_recoveries.inc(loop="preemptible")
+                flight.record("recovery", loop="preemptible",
+                              attempt=recoveries,
+                              error=f"{type(e).__name__}: {e}")
                 pause = policy.delay(recoveries - 1, backoff_rng)
                 log.warning(
                     "run_preemptible: transient failure (%s: %s); recovery "

@@ -29,13 +29,16 @@ loops — the ``naked-retry-loop`` lint rule points here.
 
 from __future__ import annotations
 
+import contextvars
 import dataclasses
 import random
 import threading
 import time
 from typing import Any, Callable
 
+from hops_tpu.runtime import flight
 from hops_tpu.runtime.logging import get_logger
+from hops_tpu.telemetry import tracing
 from hops_tpu.telemetry.metrics import REGISTRY
 
 log = get_logger(__name__)
@@ -106,10 +109,14 @@ def with_deadline(
     result: list[Any] = []
     error: list[BaseException] = []
     done = threading.Event()
+    # Threads do NOT inherit contextvars: copy the caller's context so
+    # the worker keeps the active trace span (a deadline-bounded
+    # predict must still attribute its time to the request's trace).
+    caller_ctx = contextvars.copy_context()
 
     def _run() -> None:
         try:
-            result.append(fn(*args, **kwargs))
+            result.append(caller_ctx.run(fn, *args, **kwargs))
         except BaseException as e:  # noqa: BLE001 — transported to the caller
             error.append(e)
         finally:
@@ -119,6 +126,8 @@ def with_deadline(
     t.start()
     if not done.wait(timeout_s):
         _m_deadlines.inc(op=op)
+        flight.record("deadline_exceeded", op=op, timeout_s=timeout_s)
+        tracing.add_event("deadline_exceeded", op=op, timeout_s=timeout_s)
         raise DeadlineExceeded(f"{op} exceeded its {timeout_s:.3f}s deadline")
     if error:
         raise error[0]
@@ -192,11 +201,17 @@ class RetryPolicy:
                 if overall is not None and time.monotonic() + pause > overall:
                     break
                 _m_retries.inc(op=op)
+                flight.record("retry", op=op, attempt=attempt + 1,
+                              error=type(e).__name__)
+                tracing.add_event("retry", op=op, attempt=attempt + 1,
+                                  error=type(e).__name__)
                 log.warning("%s attempt %d/%d failed (%s: %s); retrying in "
                             "%.3fs", op, attempt + 1, self.max_attempts,
                             type(e).__name__, e, pause)
                 time.sleep(pause)
         _m_giveups.inc(op=op)
+        flight.record("giveup", op=op,
+                      error=type(last).__name__ if last else None)
         assert last is not None
         raise last
 
@@ -242,6 +257,7 @@ class CircuitBreaker:
         self._failures = 0  # guarded by: self._lock
         self._opened_at = 0.0  # guarded by: self._lock
         self._probes = 0  # guarded by: self._lock
+        self._changed_at = clock()  # guarded by: self._lock
         self._m_state = _m_breaker_state.labels(breaker=name)
         self._m_state.set(0)
 
@@ -251,7 +267,12 @@ class CircuitBreaker:
         if to == self._state:
             return
         log.warning("circuit %s: %s -> %s", self.name, self._state, to)
+        flight.record("breaker_transition", breaker=self.name,
+                      frm=self._state, to=to)
+        tracing.add_event("breaker_transition", breaker=self.name,
+                          frm=self._state, to=to)
         self._state = to
+        self._changed_at = self._clock()
         self._m_state.set(_STATE_VALUE[to])
         _m_breaker_transitions.inc(breaker=self.name, to=to)
         if to == "open":
@@ -273,6 +294,14 @@ class CircuitBreaker:
         with self._lock:
             self._poll()
             return self._state
+
+    def state_age_s(self) -> float:
+        """Seconds the breaker has been in its current state — the
+        router's ``GET /fleet`` view serves this so a just-opened
+        breaker reads differently from one stuck open for an hour."""
+        with self._lock:
+            self._poll()
+            return max(0.0, self._clock() - self._changed_at)
 
     def retry_after_s(self) -> float:
         """Seconds until the breaker admits a half-open probe (0 when

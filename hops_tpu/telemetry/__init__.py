@@ -15,6 +15,11 @@ a first-class subsystem):
   block timers feeding histograms, nesting inside
   ``diagnostics.trace`` profiler captures; ``StepTimer`` for training
   loops.
+- :mod:`~hops_tpu.telemetry.tracing` — W3C-style distributed request
+  tracing (``traceparent`` in/out, contextvar-carried spans, a
+  sampling ``Tracer`` with a bounded ring) served at
+  ``GET /debug/traces``; ``span(...)`` joins the active trace so the
+  metrics and tracing vocabularies stay one thing.
 
 Instrumented out of the box: serving request/error/latency per model,
 LM engine TTFT / tokens / slot occupancy / prefix-cache hits /
@@ -45,4 +50,16 @@ from hops_tpu.telemetry.spans import (  # noqa: F401
     StepTimer,
     span,
     timed,
+)
+from hops_tpu.telemetry import tracing  # noqa: F401
+from hops_tpu.telemetry.tracing import (  # noqa: F401
+    TRACER,
+    Span,
+    TraceContext,
+    Tracer,
+    child_span,
+    current_context,
+    current_trace_id,
+    parse_traceparent,
+    start_trace,
 )

@@ -24,13 +24,21 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
 from hops_tpu.telemetry import metrics as _metrics
-from hops_tpu.telemetry.metrics import REGISTRY, Registry
+from hops_tpu.telemetry.metrics import REGISTRY, Histogram, Registry
+
+#: Render histogram exemplars (`# {trace_id="..."} value ts` appended
+#: to bucket rows) in the Prometheus exposition. Off by default: the
+#: `# {...}` suffix is OpenMetrics syntax and some 0.0.4-only scrapers
+#: choke on it — flip via env or pass ``exemplars=`` explicitly.
+EXEMPLARS_ENABLED = os.environ.get(
+    "HOPS_TPU_METRIC_EXEMPLARS", "0") not in ("0", "false", "")
 
 
 def _escape(value: str) -> str:
@@ -52,20 +60,46 @@ def _format_value(v: float) -> str:
     return repr(v)
 
 
-def render_prometheus(registry: Registry = REGISTRY) -> str:
-    """Text exposition format 0.0.4 — what ``GET /metrics`` returns."""
+def render_prometheus(registry: Registry = REGISTRY,
+                      exemplars: bool | None = None) -> str:
+    """Text exposition format 0.0.4 — what ``GET /metrics`` returns.
+
+    ``exemplars=True`` (default: :data:`EXEMPLARS_ENABLED`) appends
+    OpenMetrics-style exemplars to histogram bucket rows —
+    ``# {trace_id="..."} value timestamp`` — linking a latency bucket
+    to a concrete trace retrievable from ``GET /debug/traces/<id>``.
+    """
+    if exemplars is None:
+        exemplars = EXEMPLARS_ENABLED
     host = _metrics.hosttag()
     out: list[str] = []
     for metric in registry.collect():
         if metric.help:
             out.append(f"# HELP {metric.name} {_escape(metric.help)}")
         out.append(f"# TYPE {metric.name} {metric.type}")
+        ex_rows = (
+            metric.exemplars()
+            if exemplars and isinstance(metric, Histogram) else {}
+        )
         for suffix, labels, value in metric.samples():
             labeled = {"host": host, **labels}
             body = ",".join(
                 f'{k}="{_escape(str(v))}"' for k, v in labeled.items()
             )
-            out.append(f"{metric.name}{suffix}{{{body}}} {_format_value(value)}")
+            line = f"{metric.name}{suffix}{{{body}}} {_format_value(value)}"
+            if ex_rows and suffix == "_bucket":
+                key = tuple(
+                    str(labels[k]) for k in metric.label_names
+                    if k in labels
+                )
+                ex = ex_rows.get((key, labels.get("le", "")))
+                if ex is not None:
+                    tid, ex_value, ex_time = ex
+                    line += (
+                        f' # {{trace_id="{_escape(tid)}"}} '
+                        f"{_format_value(ex_value)} {ex_time:.3f}"
+                    )
+            out.append(line)
     return "\n".join(out) + "\n"
 
 
@@ -108,11 +142,59 @@ def handle_metrics_path(handler: BaseHTTPRequestHandler,
     return True
 
 
+def handle_debug_path(handler: BaseHTTPRequestHandler) -> bool:
+    """Serve the debug surfaces on an existing handler — mounted beside
+    :func:`handle_metrics_path` on every serving, replica, and router
+    port (and on :class:`MetricsServer`). Routes (docs/operations.md
+    "Tracing & debugging"):
+
+    - ``GET /debug/traces`` — newest-first trace summaries over this
+      process's span ring;
+    - ``GET /debug/traces/<trace_id>`` — every recorded span of one
+      trace (404 when the ring holds none);
+    - ``GET /debug/flight`` — the flight recorder's event ring.
+
+    Returns True if the request path was a debug route (and answered).
+    """
+    # Lazy: flight lives in runtime (which imports this package).
+    from hops_tpu.runtime import flight as _flight
+    from hops_tpu.telemetry import tracing as _tracing
+
+    path = handler.path.split("?", 1)[0].rstrip("/")
+    code = 200
+    if path == "/debug/traces":
+        body: dict[str, Any] = {
+            "enabled": _tracing.enabled(),
+            "sample_rate": _tracing.TRACER.sample_rate,
+            "ring_size": _tracing.TRACER.ring_size,
+            "traces": _tracing.TRACER.traces(),
+        }
+    elif path.startswith("/debug/traces/"):
+        trace_id = path[len("/debug/traces/"):]
+        spans = _tracing.TRACER.get_trace(trace_id)
+        if spans:
+            body = {"trace_id": trace_id, "spans": spans}
+        else:
+            code, body = 404, {"error": f"no spans for trace {trace_id!r} "
+                                        "in this process's ring"}
+    elif path == "/debug/flight":
+        body = _flight.FLIGHT.snapshot()
+    else:
+        return False
+    data = json.dumps(body, default=str).encode()
+    handler.send_response(code)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(data)
+    return True
+
+
 class MetricsServer:
     """Standalone scrape endpoint: a daemon HTTP thread serving
-    ``/metrics`` (Prometheus text) and ``/metrics.json`` — for
-    processes that have no serving port of their own (training jobs,
-    the search driver)."""
+    ``/metrics`` (Prometheus text) and ``/metrics.json`` — plus the
+    ``/debug/*`` surfaces — for processes that have no serving port of
+    their own (training jobs, the search driver)."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Registry = REGISTRY):
@@ -124,7 +206,8 @@ class MetricsServer:
 
             def do_GET(self) -> None:
                 try:
-                    if not handle_metrics_path(self, registry_):
+                    if not (handle_metrics_path(self, registry_)
+                            or handle_debug_path(self)):
                         self.send_response(404)
                         self.end_headers()
                 except Exception:  # noqa: BLE001 — scrape must not kill the thread

@@ -194,7 +194,7 @@ class Gauge(_Metric):
 
 
 class _HistogramChild:
-    __slots__ = ("_lock", "bounds", "counts", "sum", "count")
+    __slots__ = ("_lock", "bounds", "counts", "sum", "count", "exemplars")
 
     def __init__(self, lock: threading.Lock, bounds: tuple[float, ...]):
         self._lock = lock
@@ -202,8 +202,14 @@ class _HistogramChild:
         self.counts = [0] * (len(bounds) + 1)  # last = +Inf overflow
         self.sum = 0.0
         self.count = 0
+        # bucket index -> (trace_id, value, unix time): the newest
+        # traced observation per bucket, so a p99 bucket on a dashboard
+        # links to a concrete trace in the ring (OpenMetrics-style
+        # exemplars; export renders them behind a flag).
+        # guarded by: self._lock
+        self.exemplars: dict[int, tuple[str, float, float]] | None = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, exemplar: str | None = None) -> None:
         value = float(value)
         # NaN compares false against every bound (bisect would file it
         # under the SMALLEST bucket); Prometheus clients count it only
@@ -216,6 +222,10 @@ class _HistogramChild:
             self.counts[i] += 1
             self.sum += value
             self.count += 1
+            if exemplar is not None:
+                if self.exemplars is None:
+                    self.exemplars = {}
+                self.exemplars[i] = (exemplar, value, time.time())
 
 
 class Histogram(_Metric):
@@ -245,8 +255,26 @@ class Histogram(_Metric):
             child = self._children[key] = _HistogramChild(self._lock, self.buckets)
         return child
 
-    def observe(self, value: float, **labels: Any) -> None:
-        self.labels(**labels).observe(value)
+    def observe(self, value: float, exemplar: str | None = None,
+                **labels: Any) -> None:
+        self.labels(**labels).observe(value, exemplar=exemplar)
+
+    def exemplars(self) -> dict[tuple[tuple[str, ...], str], tuple[str, float, float]]:
+        """``(child_key, le) -> (trace_id, value, time)`` — the newest
+        traced observation per bucket, keyed the way the exporter
+        reconstructs bucket rows."""
+        with self._lock:
+            items = [
+                (key, dict(child.exemplars))
+                for key, child in self._children.items()
+                if child.exemplars
+            ]
+        out: dict[tuple[tuple[str, ...], str], tuple[str, float, float]] = {}
+        for key, ex in items:
+            for i, row in ex.items():
+                le = _fmt(self.buckets[i]) if i < len(self.buckets) else "+Inf"
+                out[(key, le)] = row
+        return out
 
     def samples(self) -> list[tuple[str, dict[str, str], float]]:
         with self._lock:

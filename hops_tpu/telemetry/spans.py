@@ -25,6 +25,7 @@ import time
 from typing import Any, Callable, Iterator
 
 from hops_tpu.telemetry.metrics import DEFAULT_BUCKETS, REGISTRY, Registry
+from hops_tpu.telemetry import tracing
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -55,7 +56,14 @@ def span(name: str, registry: Registry = REGISTRY,
     """Time the block into ``<name>_seconds{**labels}``. Label NAMES
     must be consistent across uses of one span name (they declare the
     histogram's label set). Exceptions propagate but the duration is
-    still recorded — error latency is latency."""
+    still recorded — error latency is latency.
+
+    When the calling context carries an active distributed trace
+    (``telemetry/tracing.py``), the block additionally records a child
+    tracing span of the same name — one annotation vocabulary across
+    metrics, XProf timelines, and request traces — and the histogram
+    observation carries the trace id as an exemplar, so a latency
+    bucket links back to a concrete trace."""
     hist = _histogram(name, tuple(sorted(labels)), registry)
     # Nest inside an active profiler trace without importing jax (and
     # dragging a backend up) from processes that never touched it.
@@ -64,12 +72,16 @@ def span(name: str, registry: Registry = REGISTRY,
         jax.profiler.TraceAnnotation(name) if jax is not None
         else contextlib.nullcontext()
     )
+    # Joins the active request trace; a no-op outside one (and the
+    # whole lookup is one bool when tracing is disabled).
+    tspan = tracing.child_span(name, **labels)
     start = time.monotonic()
     try:
-        with annotation:
+        with annotation, tspan:
             yield
     finally:
-        hist.observe(time.monotonic() - start, **labels)
+        hist.observe(time.monotonic() - start,
+                     exemplar=tracing.current_trace_id(), **labels)
 
 
 def timed(name: str | None = None, registry: Registry = REGISTRY,

@@ -403,6 +403,126 @@ def test_metric_consistency_resolves_module_constants(tmp_path):
     assert "hops_tpu_beat_time" in findings[0].message
 
 
+# -- debug-surface-docs -------------------------------------------------------
+
+_DEBUG_SNIPPET = """
+from hops_tpu.runtime import flight
+
+
+def handler(path):
+    if path == "/debug/widgets":
+        flight.record("widget_jam", count=3)
+        return True
+    return False
+"""
+
+
+def test_debug_surfaces_flags_undocumented_route_and_kind(tmp_path):
+    findings = lint_code(
+        tmp_path, _DEBUG_SNIPPET,
+        rule="debug-surface-docs",
+        docs="# Ops\n\nNo debug surfaces documented here.\n",
+    )
+    assert len(findings) == 2
+    messages = " | ".join(f.message for f in findings)
+    assert "/debug/widgets" in messages
+    assert "widget_jam" in messages
+
+
+def test_debug_surfaces_documented_surfaces_are_clean(tmp_path):
+    findings = lint_code(
+        tmp_path, _DEBUG_SNIPPET,
+        rule="debug-surface-docs",
+        docs="# Ops\n\n`GET /debug/widgets` serves the jam report; the "
+             "flight recorder's `widget_jam` kind records each jam.\n",
+    )
+    assert findings == []
+
+
+def test_debug_surfaces_kind_docs_match_is_whole_word(tmp_path):
+    # `widget_jam` embedded in a longer identifier must not count as
+    # documentation (the sibling metric rule holds the same line).
+    findings = lint_code(
+        tmp_path, _DEBUG_SNIPPET,
+        rule="debug-surface-docs",
+        docs="# Ops\n\n`GET /debug/widgets` and `widget_jammed_total`.\n",
+    )
+    assert len(findings) == 1
+    assert "widget_jam" in findings[0].message
+
+
+def test_debug_surfaces_ignores_inflight_lookalike_receivers(tmp_path):
+    # `inflight` trackers are everywhere in the serving stack; a
+    # suffix match on the receiver would demand their record() calls
+    # be documented as flight-recorder kinds.
+    findings = lint_code(
+        tmp_path,
+        """
+        class _Tracker:
+            def record(self, kind, **kw):
+                pass
+
+        inflight = _Tracker()
+        self_inflight = _Tracker()
+        inflight.record("probe_started", port=1)
+        self_inflight.record("slot_taken", n=2)
+        """,
+        rule="debug-surface-docs",
+        docs="# Ops\n\nnothing documented\n",
+    )
+    assert findings == []
+
+
+def test_debug_surfaces_skips_dynamic_kinds_and_bare_prefix(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        from hops_tpu.runtime import flight
+
+        PREFIX = "/debug/"  # a bare prefix, not a route
+        kind = "wid" + "get_jam"  # dynamically built: out of static reach
+        flight.record(kind, count=1)
+        """,
+        rule="debug-surface-docs",
+        docs="# Ops\n\nnothing documented\n",
+    )
+    assert findings == []
+
+
+def test_debug_surfaces_each_surface_reported_once(tmp_path):
+    # The same undocumented route/kind referenced from several sites
+    # (server, client, tests) is one missing doc entry, not N findings.
+    findings = lint_code(
+        tmp_path,
+        """
+        from hops_tpu.runtime import flight
+
+        A = "/debug/widgets"
+        B = "/debug/widgets"
+        flight.record("widget_jam", where="a")
+        flight.record("widget_jam", where="b")
+        """,
+        rule="debug-surface-docs",
+        docs="# Ops\n\nnothing documented\n",
+    )
+    assert len(findings) == 2
+
+
+def test_debug_surfaces_tree_is_clean():
+    """Every /debug/* route and flight-recorder event kind the package
+    ships is documented in docs/operations.md — zero findings, no
+    baseline entries (the docs' catalogs ARE the operator contract)."""
+    from hops_tpu.analysis.cli import default_docs, default_target, lint_root
+
+    pkg = default_target()
+    root = lint_root([pkg])
+    rules = [r for r in engine.all_rules() if r.name == "debug-surface-docs"]
+    findings = engine.run(
+        [pkg], root=root, docs_path=default_docs(root), rules=rules
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 # -- swallowed-exception ------------------------------------------------------
 
 

@@ -291,7 +291,9 @@ class TestServingChaos:
 
     def test_handler_faults_open_breaker_and_flip_healthz(self, tmp_path):
         from hops_tpu.modelrepo import serving
+        from hops_tpu.runtime import flight
 
+        flight_base = flight.FLIGHT.seq
         port = self._start(
             tmp_path, "chaos-brk",
             {"breaker_failures": 2, "breaker_reset_s": 0.3})
@@ -314,6 +316,22 @@ class TestServingChaos:
             code, body, _ = _post(port, "chaos-brk", {"instances": [[7]]})
             assert code == 200 and body["predictions"] == [[7]]
             assert _healthz(port)[0] == 200
+            # The flight recorder kept the causal black-box story: the
+            # injected faults fired, THEN the breaker opened, and the
+            # half-open heal closed it again — in sequence order.
+            events = flight.FLIGHT.events(after_seq=flight_base)
+            fired = [e for e in events if e["kind"] == "fault_fired"
+                     and e["data"]["point"] == "serving.handle"]
+            assert len(fired) == 2
+            opened = next(e for e in events
+                          if e["kind"] == "breaker_transition"
+                          and e["data"]["to"] == "open")
+            closed = next(e for e in events
+                          if e["kind"] == "breaker_transition"
+                          and e["data"]["to"] == "closed"
+                          and e["seq"] > opened["seq"])
+            assert max(e["seq"] for e in fired) < opened["seq"] \
+                < closed["seq"]
         finally:
             serving.stop("chaos-brk")
 

@@ -364,6 +364,74 @@ class TestRouterResilience:
             ).value(model=fleet_model, reason="connect")
             assert retried >= 1
 
+    def test_injected_fault_leaves_causal_flight_story(
+        self, fleet_model, tmp_path
+    ):
+        """Flight-recorder ⇄ fault-injection contract: after an
+        injected-fault run, the recorder's dump holds the fired fault,
+        the breaker transition it caused, and the retry that healed the
+        request — in causal (sequence) order, all stitched to the ONE
+        trace the client request rode."""
+        from hops_tpu.runtime import flight
+        from hops_tpu.telemetry import tracing
+
+        base = flight.FLIGHT.seq
+        client = tracing.TraceContext(
+            tracing.new_trace_id(), tracing.new_span_id())
+        with _start(fleet_model, replicas=2, breaker_failures=1) as f:
+            faultinject.arm("router.forward=error:OSError@times=1")
+            req = urllib.request.Request(
+                f"{f.endpoint}/predict",
+                data=json.dumps({"instances": [[4]]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "traceparent": client.traceparent()},
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assert json.loads(resp.read())["predictions"] == [[8]]
+
+        out = flight.FLIGHT.dump(tmp_path / "flight.json", reason="chaos")
+        body = json.loads(out.read_text())
+        events = [e for e in body["events"] if e["seq"] > base]
+        assert [e["seq"] for e in events] == sorted(e["seq"] for e in events)
+
+        fault = next(e for e in events if e["kind"] == "fault_fired"
+                     and e["data"]["point"] == "router.forward")
+        trip = next(e for e in events if e["kind"] == "breaker_transition"
+                    and e["data"]["to"] == "open")
+        retry = next(e for e in events if e["kind"] == "retry"
+                     and e["data"]["op"] == "router.forward")
+        # Causal order: the fault fired first, then the breaker it
+        # struck opened, then the retry onto the next-best replica.
+        assert fault["seq"] < trip["seq"] < retry["seq"]
+        # All three carry the request's trace id — the dump and
+        # GET /debug/traces tell one story.
+        assert {fault["trace_id"], trip["trace_id"], retry["trace_id"]} \
+            == {client.trace_id}
+
+    def test_fleet_view_serves_scrape_and_breaker_ages(self, fleet_model):
+        """`GET /fleet`: per-replica last-scrape age and breaker state
+        age — a stale scrape must be distinguishable from a healthy
+        idle replica."""
+        with _start(fleet_model, replicas=2) as f:
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with urllib.request.urlopen(
+                    f"{f.endpoint}/fleet", timeout=10
+                ) as resp:
+                    view = json.loads(resp.read())
+                if all(r["last_scrape_age_s"] is not None
+                       for r in view["replicas"]):
+                    break
+                time.sleep(0.05)
+            for rep in view["replicas"]:
+                # Scrapes run every 0.05s here: a live replica's age
+                # stays far under the staleness any operator would
+                # squint at.
+                assert rep["last_scrape_age_s"] is not None
+                assert 0.0 <= rep["last_scrape_age_s"] < 5.0
+                assert rep["breaker"] == "closed"
+                assert rep["breaker_state_age_s"] >= 0.0
+
 
 # -- replica manager ----------------------------------------------------------
 

@@ -74,6 +74,7 @@ def test_analysis_registered_in_drift_guard():
         "hops_tpu.analysis.rules.naked_retry",
         "hops_tpu.analysis.rules.swallowed_exception",
         "hops_tpu.analysis.rules.blocking_call",
+        "hops_tpu.analysis.rules.debug_surfaces",
     ):
         assert mod in names
 
@@ -128,6 +129,17 @@ def test_fleet_registered_in_drift_guard():
         "hops_tpu.modelrepo.serving_host",
     ):
         assert mod in names
+
+
+def test_tracing_registered_in_drift_guard():
+    """The distributed-tracing layer and the flight recorder are
+    compiled into every serving hot path (router forwards, request
+    handlers, the dynamic batcher) and into the resilience layer's
+    event hooks; if either stops importing, the whole /debug surface
+    and the crash black box silently disappear — pin them by name."""
+    names = _module_names()
+    assert "hops_tpu.telemetry.tracing" in names
+    assert "hops_tpu.runtime.flight" in names
 
 
 def test_resilience_registered_in_drift_guard():
