@@ -1051,6 +1051,167 @@ def run_tracing_overhead_bench(calls: int = 200_000) -> dict:
     }
 
 
+def run_capture_overhead_bench(calls: int = 1_000_000) -> dict:
+    """Disabled workload-capture overhead: the zero-cost claim, measured.
+
+    Every serving and router request path now guards its capture tap
+    with ``workload.capturing()``; the contract (the same one disarmed
+    ``faultinject.fire`` and disabled tracing keep) is that with no
+    recorder armed the check is ONE module-global read — no record
+    dict is ever built. This times tight loops of the two disarmed
+    shapes against an empty same-shape loop and reports ns/call, so a
+    regression (someone hoists record construction above the guard)
+    shows up as a number. Host-only: no accelerator, no relay."""
+    from hops_tpu.telemetry import workload
+
+    if workload.capturing():
+        raise RuntimeError("stop workload capture before the overhead bench")
+    capturing = workload.capturing
+    record_request = workload.record_request
+
+    def loop_guard(n: int) -> None:
+        # The real call-site shape: guard, then (disarmed) nothing.
+        for _ in range(n):
+            if capturing():
+                record_request(surface="bench", endpoint="bench")
+
+    def loop_record(n: int) -> None:
+        # The unguarded entry point: record_request's own disarmed
+        # fast path (one global read + return).
+        for _ in range(n):
+            record_request()
+
+    def loop_empty(n: int) -> None:
+        for _ in range(n):
+            pass
+
+    loop_guard(10_000)  # warm caches / specialize
+    loop_record(10_000)
+    loop_empty(10_000)
+    t0 = time.perf_counter()
+    loop_guard(calls)
+    guard_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    loop_record(calls)
+    record_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    loop_empty(calls)
+    empty_s = time.perf_counter() - t0
+    return {
+        "calls": calls,
+        "ns_per_disabled_check": round(
+            max(0.0, (guard_s - empty_s) / calls * 1e9), 1),
+        "ns_per_disabled_record": round(
+            max(0.0, (record_s - empty_s) / calls * 1e9), 1),
+        "guard_loop_s": round(guard_s, 4),
+        "empty_loop_s": round(empty_s, 4),
+    }
+
+
+def run_workload_replay_bench(
+    artifact: str | None = None,
+    scenario: str | None = None,
+    speed: float = 1.0,
+    seed: int = 0,
+    smoke: bool = False,
+    replicas: int = 2,
+) -> dict:
+    """The ``--replay`` tier: re-issue a captured (or synthesized)
+    workload artifact open-loop against an in-process serving fleet.
+
+    The artifact IS the experiment: the same captured stream re-runs
+    against any configuration at ``--replay-speed`` multiples, and the
+    JSON line carries the recorded-vs-replayed comparison (status mix,
+    throughput, latency percentiles) plus arrival fidelity — achieved
+    vs intended inter-arrival error, the number that says whether the
+    replay actually reproduced the arrival process it promised
+    (acceptance: p50 error < 10% of the intended gap at 1x speed).
+
+    ``scenario`` (instead of ``artifact``) synthesizes one of the
+    catalog scenarios (diurnal | herd | hot_key | tenant_spray) into a
+    temp dir first — captured and synthetic workloads replay through
+    one code path. Host-only: no accelerator, no relay lock.
+
+    Replayed per-tenant metrics collapse through the router's
+    ``limiter.label_for``, so replaying a tenant-spray capture cannot
+    mint unbounded metric children in the router's own registry.
+    """
+    import shutil
+    import tempfile
+
+    from hops_tpu.modelrepo import fleet, registry, serving
+    from hops_tpu.runtime import config as rtconfig
+    from hops_tpu.telemetry import workload
+
+    if artifact is None and scenario is None:
+        raise ValueError("replay needs an artifact path or a scenario name")
+
+    tmp = Path(tempfile.mkdtemp(prefix="hops_tpu_replaybench_"))
+    rtconfig.configure(workspace=str(tmp / "ws"), project="bench")
+    try:
+        if artifact is None:
+            synth_kw: dict = {}
+            if smoke:
+                # Shrink every scenario to a ~2s CPU-safe footprint.
+                synth_kw = {
+                    "diurnal": {"duration_s": 2.0, "base_rps": 8.0},
+                    "herd": {"duration_s": 2.0, "base_rps": 6.0,
+                             "burst_size": 12, "burst_window_s": 0.1},
+                    "hot_key": {"duration_s": 2.0, "base_rps": 10.0,
+                                "entities": 64, "batch": 4},
+                    "tenant_spray": {"duration_s": 2.0, "base_rps": 20.0},
+                }.get(scenario, {})
+            artifact = str(workload.synthesize(
+                scenario, tmp / "artifact", seed=seed, **synth_kw))
+            _note(f"synthesized scenario {scenario!r} into {artifact}")
+        loaded = workload.load_artifact(artifact)
+        records = loaded["records"]
+        # A fleet capture records each request at BOTH the router front
+        # door and the replica that served it; replay the front-door
+        # stream (what clients actually sent), not the doubled view.
+        surfaces = {r.get("surface") for r in records}
+        if "router" in surfaces and len(surfaces) > 1:
+            records = [r for r in records if r.get("surface") == "router"]
+        if smoke and len(records) > 64 and scenario is None:
+            records = records[:64]
+        if not records:
+            raise ValueError(f"artifact {artifact} holds no records")
+        _note(f"replaying {len(records)} recorded request(s) at {speed}x")
+
+        if smoke:
+            replicas = 1
+        art = tmp / "art"
+        art.mkdir()
+        # Echo predictor: payload-shape agnostic, so captured dense,
+        # entity-join, and synthetic bodies all replay against it.
+        (art / "p.py").write_text(
+            "class Predict:\n"
+            "    def predict(self, instances):\n"
+            "        return [[1.0] for _ in instances]\n"
+        )
+        registry.export(art, "replaybench", metrics={"v": 1.0})
+        serving.create_or_update(
+            "replaybench", model_name="replaybench", model_version=1,
+            model_server="PYTHON")
+        with fleet.start_fleet("replaybench", replicas, inprocess=True,
+                               scrape_interval_s=0.05) as f:
+            report = workload.replay(
+                records, f.router.endpoint, speed=speed, seed=seed,
+                tenant_label=f.router.limiter.label_for,
+            )
+        meta = loaded["manifest"].get("meta", {})
+        out = {
+            "artifact": str(artifact),
+            "records": len(records),
+            "scenario": meta.get("scenario"),
+            "replicas": replicas,
+            **report,
+        }
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def _lm_serving_workload(requests: int, seed: int, rate_rps: float, *,
                          short, long, long_frac, budget):
     """Seeded Poisson arrival process with a mixed prompt-length
@@ -1431,6 +1592,38 @@ def main() -> None:
         "tracing-disabled-is-free contract",
     )
     parser.add_argument(
+        "--capture-overhead", action="store_true",
+        help="measure the DISABLED workload-capture cost on the request "
+        "paths (ns/check vs an empty loop); host-only, guards the "
+        "capture-disabled-is-free contract",
+    )
+    parser.add_argument(
+        "--replay", metavar="ARTIFACT", default=None,
+        help="workload-replay tier: re-issue a captured workload "
+        "artifact (telemetry/workload capture dir) open-loop against "
+        "an in-process serving fleet; reports recorded-vs-replayed "
+        "status mix / throughput / latency and arrival fidelity; "
+        "host-only (no accelerator, no relay lock)",
+    )
+    parser.add_argument(
+        "--replay-scenario",
+        choices=["diurnal", "herd", "hot_key", "tenant_spray"],
+        default=None,
+        help="synthesize this scenario artifact and replay it (instead "
+        "of --replay PATH); captured and synthetic workloads share one "
+        "replay path",
+    )
+    parser.add_argument(
+        "--replay-speed", type=float, default=1.0,
+        help="replay time-compression: recorded inter-arrivals are "
+        "divided by this (2.0 = yesterday's traffic at double speed)",
+    )
+    parser.add_argument(
+        "--replay-seed", type=int, default=0,
+        help="seed for deterministic re-materialization of capped "
+        "payloads (same artifact + seed = identical issued stream)",
+    )
+    parser.add_argument(
         "--lm", action="store_true",
         help="LM training headline instead of ResNet-50: ~180M-param "
         "TransformerLM (d_head 128, flash attention, chunked LM-head "
@@ -1485,6 +1678,32 @@ def main() -> None:
         print(json.dumps({"metric": "tracing_disabled_ns_per_span",
                           "value": result["ns_per_disabled_span"],
                           "unit": "ns", **result}))
+        return
+
+    if args.capture_overhead:
+        result = run_capture_overhead_bench()
+        print(json.dumps({"metric": "workload_capture_disabled_ns_per_check",
+                          "value": result["ns_per_disabled_check"],
+                          "unit": "ns", **result}))
+        return
+
+    if args.replay or args.replay_scenario:
+        # Entirely host-side, like --serving-fleet: no accelerator
+        # touch, no relay lock, no TPU probe.
+        _note("workload-replay bench: captured/synthetic stream vs live fleet")
+        result = run_workload_replay_bench(
+            artifact=args.replay,
+            scenario=args.replay_scenario,
+            speed=args.replay_speed,
+            seed=args.replay_seed,
+            smoke=args.smoke,
+        )
+        print(json.dumps({
+            "metric": "workload_replay_requests_per_sec",
+            "value": result["replayed"]["rps"],
+            "unit": "req/s",
+            **result,
+        }))
         return
 
     if args.serving_fleet:

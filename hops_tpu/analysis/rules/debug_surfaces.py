@@ -8,9 +8,12 @@ is a surface nobody will find at 3am, and the docs' event catalog is
 what post-incident tooling greps against. Two statically-checkable
 contracts:
 
-- every string literal starting with ``/debug/`` (route comparisons,
-  clients, tests alike; f-string fragments count) must appear —
-  normalized without its trailing slash — in ``docs/operations.md``;
+- every string literal starting with ``/debug/`` or ``/admin/``
+  (route comparisons, clients, tests alike; f-string fragments count)
+  must appear — normalized without its trailing slash — in
+  ``docs/operations.md`` (admin routes are operator verbs — drain,
+  capture start/stop — an undocumented one is a control plane nobody
+  can operate);
 - every literal event kind passed to ``<receiver ending in
   flight>.record("<kind>", ...)`` (the :mod:`hops_tpu.runtime.flight`
   convention: ``flight.record(...)`` / ``FLIGHT.record(...)``) must
@@ -46,9 +49,9 @@ def _collect(pf: ParsedFile) -> tuple[list[tuple[ast.AST, str]],
     kinds: list[tuple[ast.AST, str]] = []
     for node in ast.walk(pf.tree):
         if (isinstance(node, ast.Constant) and isinstance(node.value, str)
-                and node.value.startswith("/debug/")):
+                and node.value.startswith(("/debug/", "/admin/"))):
             route = node.value.rstrip("/")
-            if route != "/debug":  # a bare prefix is not a route
+            if route not in ("/debug", "/admin"):  # a bare prefix is not a route
                 routes.append((node, route))
         if (
             isinstance(node, ast.Call)
@@ -67,8 +70,8 @@ def _collect(pf: ParsedFile) -> tuple[list[tuple[ast.AST, str]],
 class DebugSurfaceDocsRule(Rule):
     name = "debug-surface-docs"
     description = (
-        "every /debug/* route and flight-recorder event kind is "
-        "documented in docs/operations.md"
+        "every /debug/* and /admin/* route and flight-recorder event "
+        "kind is documented in docs/operations.md"
     )
 
     def check_project(

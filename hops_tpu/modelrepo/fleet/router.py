@@ -48,6 +48,7 @@ from hops_tpu.runtime.logging import get_logger
 from hops_tpu.runtime.resilience import CircuitBreaker
 from hops_tpu.telemetry import export as telemetry_export
 from hops_tpu.telemetry import tracing
+from hops_tpu.telemetry import workload
 from hops_tpu.telemetry.metrics import REGISTRY
 from hops_tpu.telemetry.spans import span
 
@@ -224,6 +225,9 @@ class _ReplicaView:
         # replica) is distinguishable from a healthy idle one whose
         # numbers just happen to sit at zero.
         self.last_scrape_mono: float | None = None
+        # Scraped hops_tpu_workload_capture_active: `GET /fleet`
+        # reports which replica processes are capturing their streams.
+        self.capture_active = 0.0
 
     def inflight_inc(self) -> None:
         with self._count_lock:
@@ -317,13 +321,61 @@ class Router:
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
             def do_POST(self) -> None:
+                # Workload capture stamps the fleet-front-door ARRIVAL
+                # — the recorded stream is what clients sent, with
+                # rate-limited, unrouted, and handler-crash outcomes
+                # included (their status IS the outcome). Defined
+                # before any work so the outer except can record the
+                # 500s it answers.
+                t_arr_mono, t_arr_wall = time.monotonic(), time.time()
+                body = b"{}"
+                is_predict = False
+
+                def capture(status: int, tspan: Any = None) -> None:
+                    if not (is_predict and workload.capturing()):
+                        return
+                    try:
+                        payload_obj = json.loads(body)
+                    except ValueError:
+                        payload_obj = None
+                    workload.record_request(
+                        surface="router",
+                        endpoint=name,
+                        path=self.path.rstrip("/"),
+                        tenant=self.headers.get("X-Tenant"),
+                        payload=payload_obj,
+                        instances=(
+                            payload_obj.get("instances")
+                            if isinstance(payload_obj, dict) else None
+                        ),
+                        status=status,
+                        latency_ms=(time.monotonic() - t_arr_mono) * 1e3,
+                        trace_id=(
+                            tspan.trace_id
+                            if getattr(tspan, "sampled", False) else None
+                        ),
+                        t_mono=t_arr_mono,
+                        t_wall=t_arr_wall,
+                    )
+
                 try:
                     length = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(length) or b"{}"
                     path = self.path.rstrip("/")
+                    if path.startswith("/admin/capture/"):
+                        # Workload-capture control plane on the fleet's
+                        # front door (status: GET /debug/workload).
+                        try:
+                            admin_payload = json.loads(body)
+                        except ValueError:
+                            admin_payload = {}
+                        self._reply(
+                            *workload.admin_action(path, admin_payload))
+                        return
                     if path not in ("/predict", f"/v1/models/{name}:predict"):
                         self._reply(404, {"error": f"unknown path {self.path}"})
                         return
+                    is_predict = True
                     m_requests.inc()
                     tenant = self.headers.get("X-Tenant", "default")
                     wait = router.limiter.acquire(tenant)
@@ -335,6 +387,7 @@ class Router:
                             {"error": f"tenant {tenant!r} rate limited"},
                             headers={"Retry-After": f"{math.ceil(wait)}"},
                         )
+                        capture(429)
                         return
                     t0 = time.perf_counter()
                     # The trace starts (or, with an incoming
@@ -365,8 +418,15 @@ class Router:
                     if code >= 500:
                         m_unrouted.inc()
                     self._reply(code, payload, headers=headers)
+                    # After the write — capture must not delay the
+                    # response.
+                    capture(code, tspan)
                 except Exception as e:  # noqa: BLE001 — server must stay up
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    # A handler crash is a client-visible 500: it
+                    # belongs in the recorded error mix (capture()
+                    # never raises past the recorder's drop counter).
+                    capture(500)
 
             def _reply(self, code: int, body: dict[str, Any],
                        headers: dict[str, str] | None = None) -> None:
@@ -436,6 +496,7 @@ class Router:
             view.last_scrape_mono = time.monotonic()
             view.queue_depth = snap["queue_depth"]
             view.scraped_inflight = snap["inflight"]
+            view.capture_active = snap["capture_active"]
             shed = snap["shed_total"]
             if view._last_shed_total is not None:
                 view.shed_rate = max(0.0, shed - view._last_shed_total)
@@ -470,6 +531,7 @@ class Router:
             "queue_depth": gauge("hops_tpu_serving_batch_queue_depth"),
             "inflight": gauge("hops_tpu_serving_inflight"),
             "shed_total": counter("hops_tpu_serving_shed_total"),
+            "capture_active": gauge("hops_tpu_workload_capture_active"),
         }
 
     # -- selection / forwarding -----------------------------------------------
@@ -687,9 +749,14 @@ class Router:
                     round(now - view.last_scrape_mono, 3)
                     if view.last_scrape_mono is not None else None
                 ),
+                # Scraped per-replica workload-capture status (for
+                # in-process fleets every replica shares the router's
+                # process-global recorder, so these agree).
+                "capture": bool(view.capture_active),
             })
         return {"model": self.name, "replicas": reps,
-                "ready": sum(1 for r in reps if r["state"] == "ready")}
+                "ready": sum(1 for r in reps if r["state"] == "ready"),
+                "capture": workload.status()}
 
     def stop(self) -> None:
         self._stop.set()

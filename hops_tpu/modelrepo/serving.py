@@ -44,6 +44,7 @@ from hops_tpu.runtime.resilience import (
 )
 from hops_tpu.telemetry import export as telemetry_export
 from hops_tpu.telemetry import tracing
+from hops_tpu.telemetry import workload
 from hops_tpu.telemetry.metrics import RATIO_BUCKETS, REGISTRY
 from hops_tpu.telemetry.spans import span
 
@@ -761,8 +762,29 @@ class _RunningServing:
 
             def do_POST(self) -> None:
                 try:
+                    # Workload capture stamps the ARRIVAL, not the
+                    # predict start — queueing ahead of the handler is
+                    # part of the workload being recorded.
+                    t_arr_mono, t_arr_wall = time.monotonic(), time.time()
                     length = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(length) or b"{}")
+                    raw_body = self.rfile.read(length) or b"{}"
+                    # Workload-capture control plane (arm / finalize
+                    # the process-global recorder; status rides
+                    # GET /debug/workload). Checked BEFORE the strict
+                    # body parse so a sloppy body degrades to {} — the
+                    # same tolerant contract as the router's route
+                    # (a capture/stop must not fail on replicas while
+                    # succeeding on the front door).
+                    if self.path.split("?", 1)[0].rstrip("/").startswith(
+                            "/admin/capture/"):
+                        try:
+                            admin_payload = json.loads(raw_body)
+                        except ValueError:
+                            admin_payload = {}
+                        self._reply(*workload.admin_action(
+                            self.path, admin_payload))
+                        return
+                    payload = json.loads(raw_body)
                     # Fleet control plane: flip this endpoint into the
                     # draining state (rollouts, scale-downs). Replies
                     # with the in-flight count the caller will poll to
@@ -782,6 +804,13 @@ class _RunningServing:
                         self._reply(400, {"error": "payload must carry 'instances'"})
                         return
                     m_requests.inc()
+                    if workload.capturing():
+                        # Arm the per-request capture tap: _reply (the
+                        # single exit every branch funnels through)
+                        # records the request WITH its final status —
+                        # sheds, deadline 504s, and 500s included.
+                        self._capture_ctx = (
+                            payload, instances, t_arr_mono, t_arr_wall)
                     # The trace enters (or starts) here: an incoming
                     # `traceparent` — the fleet router injects one per
                     # forward hop — makes this request span a child of
@@ -793,6 +822,7 @@ class _RunningServing:
                     tspan = tracing.start_trace(
                         "serving.request", headers=self.headers, model=name,
                         force_sample=want_debug)
+                    self._capture_span = tspan
                     with tspan:
                         # Shedding BEFORE any model work — draining (stop
                         # ADMITTING, keep finishing; the admission check is
@@ -920,6 +950,32 @@ class _RunningServing:
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
+                ctx = getattr(self, "_capture_ctx", None)
+                if ctx is not None:
+                    # The workload tap: every predict branch replies
+                    # exactly once, so this is the one place the final
+                    # status and latency are both known. After the
+                    # write — capture must not delay the response.
+                    self._capture_ctx = None
+                    req_payload, req_instances, t_mono, t_wall = ctx
+                    tspan = getattr(self, "_capture_span", None)
+                    workload.record_request(
+                        surface="serving",
+                        endpoint=name,
+                        path=self.path,
+                        tenant=self.headers.get("X-Tenant"),
+                        payload=req_payload,
+                        instances=req_instances,
+                        lm_mode=cfg["model_server"] == LM,
+                        status=code,
+                        latency_ms=(time.monotonic() - t_mono) * 1e3,
+                        trace_id=(
+                            tspan.trace_id
+                            if getattr(tspan, "sampled", False) else None
+                        ),
+                        t_mono=t_mono,
+                        t_wall=t_wall,
+                    )
 
         self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)

@@ -20,6 +20,12 @@ a first-class subsystem):
   sampling ``Tracer`` with a bounded ring) served at
   ``GET /debug/traces``; ``span(...)`` joins the active trace so the
   metrics and tracing vocabularies stay one thing.
+- :mod:`~hops_tpu.telemetry.workload` — trace-driven workload capture
+  (the request stream as a versioned, manifest-verified JSONL
+  artifact), deterministic open-loop replay at adjustable speed, and
+  a scenario synthesizer (diurnal / herd / hot-key / tenant-spray)
+  in the same schema; status at ``GET /debug/workload``, replayed by
+  ``bench.py --replay``.
 
 Instrumented out of the box: serving request/error/latency per model,
 LM engine TTFT / tokens / slot occupancy / prefix-cache hits /
@@ -52,6 +58,7 @@ from hops_tpu.telemetry.spans import (  # noqa: F401
     timed,
 )
 from hops_tpu.telemetry import tracing  # noqa: F401
+from hops_tpu.telemetry import workload  # noqa: F401
 from hops_tpu.telemetry.tracing import (  # noqa: F401
     TRACER,
     Span,

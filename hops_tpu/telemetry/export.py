@@ -149,25 +149,48 @@ def handle_debug_path(handler: BaseHTTPRequestHandler) -> bool:
     "Tracing & debugging"):
 
     - ``GET /debug/traces`` — newest-first trace summaries over this
-      process's span ring;
+      process's span ring; ``?limit=N`` caps the summary count and
+      ``?since=<wall-time>`` drops traces that started before the
+      stamp (malformed values degrade to the defaults, never a 500);
     - ``GET /debug/traces/<trace_id>`` — every recorded span of one
       trace (404 when the ring holds none);
-    - ``GET /debug/flight`` — the flight recorder's event ring.
+    - ``GET /debug/flight`` — the flight recorder's event ring;
+    - ``GET /debug/workload`` — workload-capture status (armed,
+      artifact directory, segment/request/byte counts).
 
     Returns True if the request path was a debug route (and answered).
     """
     # Lazy: flight lives in runtime (which imports this package).
     from hops_tpu.runtime import flight as _flight
     from hops_tpu.telemetry import tracing as _tracing
+    from hops_tpu.telemetry import workload as _workload
 
-    path = handler.path.split("?", 1)[0].rstrip("/")
+    path, _, query = handler.path.partition("?")
+    path = path.rstrip("/")
     code = 200
     if path == "/debug/traces":
+        from urllib.parse import parse_qs
+
+        params = parse_qs(query)
+
+        def qnum(key: str, cast, default):
+            try:
+                return cast(params[key][0])
+            except (KeyError, IndexError, ValueError):
+                return default
+
+        limit = qnum("limit", int, 50)
+        if limit < 0:
+            # A negative slice would drop the NEWEST traces — the
+            # opposite of any caller's intent; degrade like any other
+            # malformed value.
+            limit = 50
+        since = qnum("since", float, None)
         body: dict[str, Any] = {
             "enabled": _tracing.enabled(),
             "sample_rate": _tracing.TRACER.sample_rate,
             "ring_size": _tracing.TRACER.ring_size,
-            "traces": _tracing.TRACER.traces(),
+            "traces": _tracing.TRACER.traces(limit=limit, since=since),
         }
     elif path.startswith("/debug/traces/"):
         trace_id = path[len("/debug/traces/"):]
@@ -179,6 +202,8 @@ def handle_debug_path(handler: BaseHTTPRequestHandler) -> bool:
                                         "in this process's ring"}
     elif path == "/debug/flight":
         body = _flight.FLIGHT.snapshot()
+    elif path == "/debug/workload":
+        body = _workload.status()
     else:
         return False
     data = json.dumps(body, default=str).encode()

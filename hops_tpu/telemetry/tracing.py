@@ -282,14 +282,19 @@ class Tracer:
         rows.sort(key=lambda r: r["start"])
         return rows
 
-    def traces(self, limit: int = 50) -> list[dict[str, Any]]:
-        """Newest-first trace summaries over the ring."""
+    def traces(self, limit: int = 50,
+               since: float | None = None) -> list[dict[str, Any]]:
+        """Newest-first trace summaries over the ring. ``since`` (wall
+        time) drops traces whose earliest span started before it — the
+        ``GET /debug/traces?since=`` incremental-poll contract."""
         by_trace: dict[str, list[Span]] = {}
         for s in self.spans():
             by_trace.setdefault(s.trace_id, []).append(s)
         out = []
         for tid, spans in by_trace.items():
             start = min(s.start for s in spans)
+            if since is not None and start < since:
+                continue
             end = max(s.start + (s.duration_s or 0.0) for s in spans)
             roots = [s for s in spans if s.parent_id is None]
             # The root can be missing (fell off the ring, or lives in

@@ -451,6 +451,34 @@ def test_debug_surfaces_kind_docs_match_is_whole_word(tmp_path):
     assert "widget_jam" in findings[0].message
 
 
+def test_debug_surfaces_covers_admin_routes(tmp_path):
+    """Admin routes are operator verbs (drain, capture start/stop) —
+    an undocumented one is a control plane nobody can operate; the
+    rule holds /admin/* literals to the same docs contract as
+    /debug/*."""
+    snippet = """
+def handler(path):
+    if path == "/admin/capture/start":
+        return True
+    return path == "/admin/widgets/drain"
+"""
+    findings = lint_code(
+        tmp_path, snippet,
+        rule="debug-surface-docs",
+        docs="# Ops\n\nNo admin routes documented here.\n",
+    )
+    assert len(findings) == 2
+    messages = " | ".join(f.message for f in findings)
+    assert "/admin/capture/start" in messages
+    assert "/admin/widgets/drain" in messages
+    assert lint_code(
+        tmp_path, snippet,
+        rule="debug-surface-docs",
+        docs="# Ops\n\n`POST /admin/capture/start` arms capture; "
+             "`POST /admin/widgets/drain` drains the widgets.\n",
+    ) == []
+
+
 def test_debug_surfaces_ignores_inflight_lookalike_receivers(tmp_path):
     # `inflight` trackers are everywhere in the serving stack; a
     # suffix match on the receiver would demand their record() calls

@@ -888,41 +888,60 @@ class TestFleetE2E:
             ).value(model=fleet_model, outcome="rolled_back")
             assert rollbacks >= 1
 
-    def test_chaos_replica_killed_mid_traffic_mid_rollout(self, fleet_model):
+    def test_chaos_replica_killed_mid_traffic_mid_rollout(
+            self, fleet_model, tmp_path):
         """Acceptance: sustained traffic + a replica KILLED mid-flight
         + a rollout in progress -> the router completes every request
-        and the autoscaler heals the fleet back to target size."""
+        and the autoscaler heals the fleet back to target size. Runs
+        with workload capture ARMED: recording the stream must not
+        change the zero-client-visible-failures outcome, and the
+        chaos run's capture must come out replayable."""
+        from hops_tpu.telemetry import workload
+
         v2 = _export_version("flt", "return [[v[0] * 3] for v in instances]")
         policy = AutoscalePolicy(min_replicas=3, max_replicas=5,
                                  target_load=50.0)  # heal-only: wide band
-        with _start(fleet_model, replicas=3, autoscale=policy,
-                    autoscale_interval_s=0.05) as f:
-            expect = lambda i: ([[i * 2]], [[i * 3]])  # noqa: E731
-            with _Traffic(f, expect, clients=4) as traffic:
-                time.sleep(0.15)
-                # Kill a replica mid-flight (no drain, no goodbye) ...
-                victim = f.manager.ready()[0]
-                f.manager.kill(victim.rid)
-                # ... while a rollout is in progress.
-                summary = f.roll_out(v2, canary_requests=2,
-                                     canary_window_s=10)
-                # Let the autoscaler heal back to the floor.
-                deadline = time.monotonic() + 15
-                while time.monotonic() < deadline:
-                    if len(f.manager.ready()) >= 3:
-                        break
-                    time.sleep(0.05)
-            assert summary["outcome"] == "completed"
-            assert traffic.errors == []  # ZERO failed requests
-            assert traffic.bad == []
-            assert len(traffic.done_t) > 30
-            assert len(f.manager.ready()) >= 3
-            # A completed rollout leaves the fleet HOMOGENEOUS: the
-            # version commits before the shift (so mid-rollout heals
-            # resolve the new artifact) and the straggler sweep drains
-            # any old-version replica a heal landed during the canary.
-            assert all(r.version == v2 for r in f.manager.ready())
-            assert f.predict([[4]])["predictions"] == [[12]]
+        workload.start_capture(tmp_path / "chaos_capture")
+        try:
+            with _start(fleet_model, replicas=3, autoscale=policy,
+                        autoscale_interval_s=0.05) as f:
+                expect = lambda i: ([[i * 2]], [[i * 3]])  # noqa: E731
+                with _Traffic(f, expect, clients=4) as traffic:
+                    time.sleep(0.15)
+                    # Kill a replica mid-flight (no drain, no goodbye) ...
+                    victim = f.manager.ready()[0]
+                    f.manager.kill(victim.rid)
+                    # ... while a rollout is in progress.
+                    summary = f.roll_out(v2, canary_requests=2,
+                                         canary_window_s=10)
+                    # Let the autoscaler heal back to the floor.
+                    deadline = time.monotonic() + 15
+                    while time.monotonic() < deadline:
+                        if len(f.manager.ready()) >= 3:
+                            break
+                        time.sleep(0.05)
+                assert summary["outcome"] == "completed"
+                assert traffic.errors == []  # ZERO failed requests
+                assert traffic.bad == []
+                assert len(traffic.done_t) > 30
+                assert len(f.manager.ready()) >= 3
+                # A completed rollout leaves the fleet HOMOGENEOUS: the
+                # version commits before the shift (so mid-rollout heals
+                # resolve the new artifact) and the straggler sweep drains
+                # any old-version replica a heal landed during the canary.
+                assert all(r.version == v2 for r in f.manager.ready())
+                assert f.predict([[4]])["predictions"] == [[12]]
+        finally:
+            workload.stop_capture()
+        # The chaos run's capture verifies and holds the front-door
+        # stream — every client request, zero 5xx outcomes (retries
+        # were invisible), ready to replay through bench.py --replay.
+        loaded = workload.load_artifact(tmp_path / "chaos_capture")
+        router_recs = [r for r in loaded["records"]
+                       if r["surface"] == "router"]
+        assert len(router_recs) >= len(traffic.done_t)
+        assert all(r["status"] < 500 for r in router_recs
+                   if r.get("path") == "/predict")
 
 
 # -- out-of-process workers ---------------------------------------------------

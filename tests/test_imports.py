@@ -152,6 +152,19 @@ def test_resilience_registered_in_drift_guard():
     assert "hops_tpu.runtime.faultinject" in names
 
 
+def test_workload_registered_in_drift_guard():
+    """The workload capture/replay layer is compiled into every
+    serving and router request path (the capture tap) and is what the
+    `--replay` bench tier and the crash-flush path import; if it stops
+    importing, capture silently disarms and every replay artifact goes
+    unreadable — pin the package and its modules by name."""
+    names = _module_names()
+    assert "hops_tpu.telemetry.workload" in names
+    assert "hops_tpu.telemetry.workload.capture" in names
+    assert "hops_tpu.telemetry.workload.replay" in names
+    assert "hops_tpu.telemetry.workload.synthesize" in names
+
+
 @pytest.mark.parametrize("name", _module_names())
 def test_module_imports(name):
     try:

@@ -357,6 +357,48 @@ class TestDebugRoutes:
         finally:
             srv.stop()
 
+    def test_traces_limit_and_since_query_filters(self):
+        """`GET /debug/traces?limit=&since=` scopes the summary window
+        (the fixed 50-trace window used to be the only view): limit
+        caps the newest-first list, since drops traces that started
+        before the wall-time stamp, malformed values degrade to the
+        defaults."""
+        spans = []
+        for i in range(6):
+            with tracing.start_trace(f"probe.{i}") as s:
+                spans.append(s)
+            time.sleep(0.002)  # distinct wall-clock starts for `since`
+        cut = spans[3].start  # traces 0-2 started before this stamp
+        srv = telemetry_export.start_http_server()
+        try:
+            code, body = self._get(srv.port, "/debug/traces?limit=2")
+            assert code == 200
+            assert len(body["traces"]) == 2
+            # Newest-first: the limited window holds the LAST starts.
+            assert {t["root"] for t in body["traces"]} == {
+                "probe.5", "probe.4"}
+
+            code, body = self._get(srv.port, f"/debug/traces?since={cut}")
+            assert code == 200
+            assert {t["root"] for t in body["traces"]} == {
+                "probe.3", "probe.4", "probe.5"}
+
+            code, body = self._get(
+                srv.port, f"/debug/traces?since={cut}&limit=1")
+            assert [t["root"] for t in body["traces"]] == ["probe.5"]
+
+            # Malformed values: defaults, never a 500. A negative
+            # limit would slice off the NEWEST traces — default too.
+            code, body = self._get(
+                srv.port, "/debug/traces?limit=banana&since=")
+            assert code == 200
+            assert len(body["traces"]) == 6
+            code, body = self._get(srv.port, "/debug/traces?limit=-1")
+            assert code == 200
+            assert len(body["traces"]) == 6
+        finally:
+            srv.stop()
+
 
 # -- e2e through real serving -------------------------------------------------
 
