@@ -1,0 +1,18 @@
+"""One-shot: run the --serving-fleet bench with workload capture armed,
+then finalize the capture segment/manifest before exit (a plain env-armed
+bench run exits without stop_capture, leaving the manifest empty)."""
+import json
+import sys
+
+sys.argv = ["bench.py", "--serving-fleet"]
+
+from hops_tpu.telemetry import workload
+
+workload.start_capture("bench_artifacts/hotpath_r12_precapture")
+import bench
+
+try:
+    bench.main()
+finally:
+    st = workload.stop_capture()
+    print(json.dumps({"capture_stopped": st}, default=str), file=sys.stderr)

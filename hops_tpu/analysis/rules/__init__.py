@@ -11,6 +11,7 @@ Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 - :mod:`.swallowed_exception` — ``swallowed-exception``
 - :mod:`.naked_retry` — ``naked-retry-loop``
 - :mod:`.blocking_call` — ``blocking-call-no-deadline``
+- :mod:`.relay_json_roundtrip` — ``relay-json-roundtrip``
 """
 
 from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effects
@@ -22,5 +23,6 @@ from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effect
     lock_discipline,
     metric_consistency,
     naked_retry,
+    relay_json_roundtrip,
     swallowed_exception,
 )

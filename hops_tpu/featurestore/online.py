@@ -122,11 +122,70 @@ def open_store(name: str, version: int) -> OnlineStore:
 
 
 def _open_backend(path: Path):
+    """Pick the shard backend: the native log-structured engine when
+    ``libhops_native.so`` is built, else sqlite.
+
+    ``HOPS_TPU_ONLINE_BACKEND`` overrides: ``auto`` (default — prefer
+    native, fall back to sqlite with a logged reason), ``native``
+    (required: raise if unbuilt — a deployment that EXPECTS native
+    lookup latency must not silently run 10x slower), ``sqlite``
+    (force the fallback, e.g. to compare in ``bench.py --hot-path``).
+
+    An existing shard file wins over the preference: a store created
+    under one backend must keep reading its own data after the env
+    changes (the two formats are not interchangeable on disk).
+    """
+    import os
+
     from hops_tpu.native import kvstore
 
+    choice = os.environ.get("HOPS_TPU_ONLINE_BACKEND", "auto").strip().lower()
+    if choice not in ("auto", "native", "sqlite"):
+        raise ValueError(
+            f"HOPS_TPU_ONLINE_BACKEND={choice!r}: pick auto|native|sqlite"
+        )
+    native_path = Path(str(path) + ".hkv")
+    sqlite_path = Path(str(path) + ".db")
+    # Existing data pins the backend regardless of preference.
+    file_pinned = False
+    if native_path.exists() and not sqlite_path.exists():
+        if choice == "sqlite":
+            log.warning(
+                "online store %s: HOPS_TPU_ONLINE_BACKEND=sqlite but an "
+                "existing native shard file wins (formats are not "
+                "interchangeable on disk)", path.name,
+            )
+        choice = "native"
+        file_pinned = True
+    elif sqlite_path.exists() and not native_path.exists():
+        if choice == "native":
+            log.warning(
+                "online store %s: HOPS_TPU_ONLINE_BACKEND=native but an "
+                "existing sqlite shard file wins (formats are not "
+                "interchangeable on disk)", path.name,
+            )
+        choice = "sqlite"
+    if choice == "sqlite":
+        return _SqliteKV(str(sqlite_path))
     if kvstore.available():
-        return kvstore.NativeKV(str(path) + ".hkv")
-    return _SqliteKV(str(path) + ".db")
+        return kvstore.NativeKV(str(native_path))
+    if choice == "native":
+        reason = (
+            f"existing native shard file {native_path.name} requires the "
+            "native backend (sqlite cannot read it)"
+            if file_pinned
+            else "HOPS_TPU_ONLINE_BACKEND=native"
+        )
+        raise RuntimeError(
+            f"{reason}, but libhops_native.so is not built; run "
+            "`make -C hops_tpu/native`"
+        )
+    log.info(
+        "online store %s: native kvstore not built, falling back to "
+        "sqlite (run `make -C hops_tpu/native` for log-structured "
+        "point lookups)", path.name,
+    )
+    return _SqliteKV(str(sqlite_path))
 
 
 class _SqliteKV:

@@ -10,6 +10,7 @@ keep shapes static (no recompiles).
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Iterator
 
 import jax
@@ -17,6 +18,74 @@ import numpy as np
 
 from hops_tpu.parallel.strategy import Strategy
 from hops_tpu.telemetry.metrics import RATIO_BUCKETS, REGISTRY
+
+
+class AssemblyPool:
+    """Reusable host assembly buffers keyed by ``(shape, dtype)`` —
+    PR 3's ``loader._BufferPool`` discipline on the serving side.
+
+    The dynamic batcher and the batch-inference chunk loop assemble a
+    fresh padded host array per wave; at steady state every wave has
+    the same bucketed shape, so the allocation (and the page faults of
+    first touch) is pure churn. ``take`` hands back a previously
+    released buffer when one of the right spec is free (a *hit* on the
+    reuse counter) or allocates (a *miss* — the first wave of each
+    shape, or concurrent waves deeper than the pool has seen). Callers
+    must ``give`` the buffer back only once nothing reads it — the
+    dispatch path copies host→device before returning, so returning it
+    after the predict call resolves is safe.
+
+    Per-spec free lists are capped at ``depth`` buffers so a burst of
+    concurrent waves can't grow the pool beyond bounded steady-state
+    memory.
+    """
+
+    def __init__(self, depth: int = 4):
+        self.depth = depth
+        self._lock = threading.Lock()
+        # (shape, dtype-str) -> free buffers. # guarded by: self._lock
+        self._free: dict[tuple, list[np.ndarray]] = {}
+        # Per-instance tallies behind hit_rate(): the registry counter
+        # below is get-or-create and therefore shared by EVERY pool in
+        # the process — fine for dashboards, wrong for one pool's rate.
+        self._hits = 0  # guarded by: self._lock
+        self._misses = 0  # guarded by: self._lock
+        self._m_reuse = REGISTRY.counter(
+            "hops_tpu_batch_assembly_reuse_total",
+            "Batch-assembly buffer checkouts, hit = reused allocation",
+            labels=("site", "result"),
+        )
+
+    def take(self, shape: tuple[int, ...], dtype: Any,
+             site: str = "serving") -> np.ndarray:
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            stack = self._free.get(key)
+            if stack:
+                self._hits += 1
+                self._m_reuse.inc(site=site, result="hit")
+                return stack.pop()
+            self._misses += 1
+        self._m_reuse.inc(site=site, result="miss")
+        return np.empty(shape, dtype)
+
+    def give(self, buf: np.ndarray) -> None:
+        key = (buf.shape, buf.dtype.str)
+        with self._lock:
+            stack = self._free.setdefault(key, [])
+            if len(stack) < self.depth:
+                stack.append(buf)
+
+    def hit_rate(self) -> float:
+        """THIS pool's lifetime hit fraction (bench surface)."""
+        with self._lock:
+            total = self._hits + self._misses
+            return self._hits / total if total else 0.0
+
+
+#: Process-global pool: serving predictors and batch_predict share it,
+#: so a replica's steady state allocates zero assembly buffers per wave.
+ASSEMBLY_POOL = AssemblyPool()
 
 
 def batch_predict(
@@ -47,14 +116,22 @@ def batch_predict(
 
     outs: list[np.ndarray] = []
     n = len(inputs)
+    pad_buf = None
     for start in range(0, n, chunk):
         block = inputs[start : start + chunk]
         valid = len(block)
-        if valid < chunk:  # pad tail to the static shape
-            pad = np.repeat(block[-1:], chunk - valid, axis=0)
-            block = np.concatenate([block, pad], axis=0)
+        if valid < chunk:  # pad tail to the static shape (pooled buffer)
+            pad_buf = ASSEMBLY_POOL.take(
+                (chunk,) + inputs.shape[1:], inputs.dtype, site="batch")
+            pad_buf[:valid] = block
+            pad_buf[valid:] = block[-1:]
+            block = pad_buf
         placed = strategy.distribute_batch(block)
         preds = np.asarray(jitted(placed))
+        if pad_buf is not None:
+            # distribute_batch/jit copied host→device; safe to recycle.
+            ASSEMBLY_POOL.give(pad_buf)
+            pad_buf = None
         m_fill.observe(valid / chunk)
         m_rows.inc(valid)
         outs.append(preds[:valid])

@@ -30,6 +30,19 @@ server without clients noticing. Design:
 Every forward passes through the ``router.forward`` fault point and an
 explicit timeout (the ``blocking-call-no-deadline`` lint rule holds
 this module to that).
+
+**Zero-copy relay.** The forward path streams request and response
+bodies through as raw bytes: the client's body goes onto the replica
+wire unparsed, and the replica's response body returns to the client
+byte-for-byte (2xx and 4xx/5xx alike) — no ``json.loads``/``json.dumps``
+round-trip per hop (the ``relay-json-roundtrip`` lint rule keeps it
+that way). Routing needs only the status code, headers and the
+router's own scrape state; the body is parsed lazily in exactly two
+places that need the object — the workload recorder's shape summaries
+(armed captures only, after the reply is written) and the
+``X-Hops-Debug: timeline`` merge (explicit operator ask). Tenant
+extraction is header-based (``X-Tenant``). ``_reply`` recomputes only
+the framing headers ``_relay_headers`` already owned.
 """
 
 from __future__ import annotations
@@ -82,10 +95,12 @@ _m_unrouted = REGISTRY.counter(
 )
 
 
-#: Headers never relayed from a replica response: ``_reply`` frames the
-#: re-serialized body itself, so passing the replica's framing through
-#: would send two (possibly conflicting) Content-Lengths and truncate
-#: or hang clients.
+#: Headers never relayed from a replica response: the body travels
+#: through the router as VERBATIM bytes, but ``_reply`` still frames it
+#: itself (one Content-Length it computed, one Content-Type it owns), so
+#: passing the replica's framing through would send two (possibly
+#: conflicting) Content-Lengths and truncate or hang clients. These
+#: framing headers are the ONLY thing the relay recomputes.
 _NO_RELAY_HEADERS = frozenset({
     "content-length", "content-type", "transfer-encoding", "connection",
     "keep-alive", "server", "date",
@@ -95,6 +110,25 @@ _NO_RELAY_HEADERS = frozenset({
 def _relay_headers(headers: Any) -> dict[str, str]:
     return {k: v for k, v in dict(headers).items()
             if k.lower() not in _NO_RELAY_HEADERS}
+
+
+def _relayed_with_ctype(headers: Any) -> dict[str, str]:
+    """Relay headers for a VERBATIM byte body: the non-framing headers
+    plus the replica's own Content-Type — the bytes are the replica's
+    serialization, so its declared type must travel with them
+    (``_reply`` honors a caller-supplied Content-Type and recomputes
+    only Content-Length)."""
+    out = _relay_headers(headers)
+    # Case-insensitive lookup: HTTP headers may arrive in any casing
+    # (proxies/h2 commonly lowercase), and _relay_headers already
+    # filtered every variant out.
+    ctype = next(
+        (v for k, v in dict(headers).items() if k.lower() == "content-type"),
+        None,
+    )
+    if ctype:
+        out["Content-Type"] = ctype
+    return out
 
 
 class TokenBucket:
@@ -408,9 +442,11 @@ class Router:
                         with span("hops_tpu_fleet_request", model=name):
                             code, payload, headers = router.route(
                                 body, extra_headers=relay_headers)
-                        if (debug.strip().lower() == "timeline"
-                                and isinstance(payload, dict)):
-                            router._merge_debug(payload, tspan)
+                        if debug.strip().lower() == "timeline":
+                            # The ONE relay path that needs the object:
+                            # the inline timeline merges the router's
+                            # own spans into the replica's breakdown.
+                            payload = router._merge_debug(payload, tspan)
                     # Rolling window behind recent_p99_ms(): the
                     # autoscaler's latency trigger reads this, the
                     # histogram above is for dashboards.
@@ -428,13 +464,23 @@ class Router:
                     # never raises past the recorder's drop counter).
                     capture(500)
 
-            def _reply(self, code: int, body: dict[str, Any],
+            def _reply(self, code: int, body: dict[str, Any] | bytes,
                        headers: dict[str, str] | None = None) -> None:
-                data = json.dumps(body).encode()
+                # Relay path hands bytes straight through (zero-copy:
+                # the replica's serialized body is the response);
+                # router-authored payloads (errors, /fleet) are dicts.
+                # A relayed byte body keeps the REPLICA's declared
+                # Content-Type (route() passes it through) — stamping
+                # application/json on, say, an HTML error page from the
+                # replica's HTTP stack would lie to the client; only
+                # Content-Length is always recomputed.
+                data = body if isinstance(body, bytes) else json.dumps(body).encode()
+                hdrs = dict(headers or {})
+                ctype = hdrs.pop("Content-Type", "application/json")
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
-                for k, v in (headers or {}).items():
+                for k, v in hdrs.items():
                     self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
@@ -502,11 +548,24 @@ class Router:
                 view.shed_rate = max(0.0, shed - view._last_shed_total)
             view._last_shed_total = shed
 
+    #: The only families the routing score reads — the scrape asks the
+    #: replica for exactly these, so each poll renders and parses a
+    #: four-family view instead of the replica's full registry snapshot
+    #: (which grows with every instrumented subsystem).
+    _SCRAPE_FAMILIES = (
+        "hops_tpu_serving_batch_queue_depth",
+        "hops_tpu_serving_inflight",
+        "hops_tpu_serving_shed_total",
+        "hops_tpu_workload_capture_active",
+    )
+
     def _scrape_replica(self, port: int) -> dict[str, float] | None:
         timeout = max(0.5, self.scrape_interval_s * 2)
         try:
             with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}/metrics.json", timeout=timeout
+                f"http://127.0.0.1:{port}/metrics.json"
+                f"?families={','.join(self._SCRAPE_FAMILIES)}",
+                timeout=timeout,
             ) as resp:
                 families = json.loads(resp.read()).get("metrics", {})
         except (OSError, ValueError):
@@ -564,10 +623,16 @@ class Router:
 
     def route(
         self, body: bytes, extra_headers: dict[str, str] | None = None
-    ) -> tuple[int, dict[str, Any], dict[str, str]]:
+    ) -> tuple[int, dict[str, Any] | bytes, dict[str, str]]:
         """Forward ``body`` to the best replica, retrying the next-best
         on transport failure / replica 5xx / shed-503 until attempts or
-        replicas run out. Returns ``(status, payload, headers)``.
+        replicas run out. Returns ``(status, payload, headers)`` where
+        ``payload`` is the replica's response body as VERBATIM bytes —
+        the zero-copy relay contract: the forward path never parses or
+        re-serializes either body (routing needs only the status code
+        and headers), so 2xx and 4xx/5xx alike reach the client
+        byte-for-byte as the replica sent them. Only the router's own
+        no-replica 503 is a dict (it authored it).
 
         Tracing: each forward attempt is a ``fleet.forward`` child span
         of the caller's active trace, tagged with the replica id, the
@@ -623,7 +688,9 @@ class Router:
                 view.inflight_dec()
             if code < 400:
                 view.breaker.record_success()
-                return code, payload, {}
+                # Non-framing replica headers relay on success too —
+                # the same contract the 4xx path already kept.
+                return code, payload, headers
             if code in (429, 503):
                 # Shedding/draining: load, not failure. Don't strike
                 # the breaker; try a less-loaded replica.
@@ -652,7 +719,7 @@ class Router:
     def _forward(
         self, port: int, body: bytes,
         extra_headers: dict[str, str] | None = None,
-    ) -> tuple[int, dict[str, Any], dict[str, str]]:
+    ) -> tuple[int, bytes, dict[str, str]]:
         headers = {"Content-Type": "application/json", **(extra_headers or {})}
         # Propagate the trace across the process boundary: the active
         # span here is this hop's fleet.forward, so the replica's
@@ -666,20 +733,42 @@ class Router:
             with urllib.request.urlopen(
                 req, timeout=self.forward_timeout_s
             ) as resp:
-                return (resp.status, json.loads(resp.read()),
-                        _relay_headers(resp.headers))
+                # Zero-copy: the replica's body relays as raw bytes —
+                # no json.loads/json.dumps round-trip on the hot path.
+                return resp.status, resp.read(), _relayed_with_ctype(resp.headers)
         except urllib.error.HTTPError as e:
-            try:
-                payload = json.loads(e.read())
-            except ValueError:
-                payload = {"error": f"replica answered {e.code}"}
-            return e.code, payload, _relay_headers(e.headers)
+            body = e.read()
+            if body:
+                return e.code, body, _relayed_with_ctype(e.headers)
+            return (
+                e.code,
+                json.dumps({"error": f"replica answered {e.code}"}).encode(),
+                _relay_headers(e.headers),
+            )
 
-    def _merge_debug(self, payload: dict[str, Any], tspan: Any) -> None:
+    def _merge_debug(
+        self, payload: dict[str, Any] | bytes, tspan: Any
+    ) -> dict[str, Any] | bytes:
         """Fold the router's own spans for this trace into the inline
         timeline a replica returned under ``X-Hops-Debug: timeline``
         (dedup by span id: with in-process replicas the shared ring
-        already holds the replica's spans)."""
+        already holds the replica's spans). The one relay path that
+        parses the relayed bytes — the operator asked for the merged
+        object. A non-JSON body relays untouched."""
+        if isinstance(payload, bytes):
+            raw = payload
+            try:
+                parsed = json.loads(payload)
+            except ValueError:
+                return raw
+            if not isinstance(parsed, dict):
+                # Valid JSON but not an object (list/scalar): nothing
+                # to merge into — relay the ORIGINAL bytes, not a
+                # re-serialization of the parse.
+                return raw
+            payload = parsed
+        if not isinstance(payload, dict):
+            return payload
         dbg = payload.setdefault("debug", {})
         rows = {r["span_id"]: r for r in dbg.get("timeline", [])
                 if isinstance(r, dict) and "span_id" in r}
@@ -689,6 +778,7 @@ class Router:
         if merged:
             dbg["timeline"] = merged
             dbg.setdefault("trace_id", merged[0].get("trace_id"))
+        return payload
 
     # -- surface --------------------------------------------------------------
 

@@ -312,10 +312,10 @@ class LMEngine:
         if self._paged:
             if kv_page_size < 1:
                 raise ValueError(f"kv_page_size must be >= 1, got {kv_page_size}")
-            if getattr(model, "kv_cache_dtype", None) is not None:
+            if getattr(model, "kv_cache_dtype", None) not in (None, "int8"):
                 raise ValueError(
-                    "paged engine supports kv_cache_dtype=None only "
-                    "(int8 pools need paged scale tables)"
+                    "paged engine supports kv_cache_dtype None or 'int8' "
+                    f"(got {model.kv_cache_dtype!r})"
                 )
             cap0 = model.max_decode_len
             max_blocks = -(-cap0 // kv_page_size)

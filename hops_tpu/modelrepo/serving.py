@@ -125,12 +125,32 @@ class FlaxPredictor:
 
     def predict(self, instances: list[Any]) -> list[Any]:
         np = self._np
-        x = np.asarray(instances, dtype=np.float32)
-        n = len(x)
+        from hops_tpu.modelrepo.batch import ASSEMBLY_POOL
+
+        n = len(instances)
+        if n == 0:
+            return []
         bucket = 1 << max(0, (n - 1)).bit_length()
-        if bucket != n:
-            x = np.concatenate([x, np.broadcast_to(x[:1], (bucket - n, *x.shape[1:]))])
-        return np.asarray(self._apply(x))[:n].tolist()
+        # Assemble straight into a pooled (bucket, ...) buffer: at
+        # steady state every wave of a bucketed size reuses the same
+        # allocation instead of np.asarray + a pad-concatenate copy
+        # per wave. Row 0 converts first to learn the row shape (and
+        # to fail on malformed input before a buffer is taken).
+        row0 = np.asarray(instances[0], dtype=np.float32)
+        x = ASSEMBLY_POOL.take((bucket, *row0.shape), np.float32)
+        try:
+            x[0] = row0
+            if n > 1:
+                x[1:n] = instances[1:]
+            if bucket != n:
+                x[n:] = row0  # pad rows: any valid row keeps shapes static
+            preds = np.asarray(self._apply(x))[:n].tolist()
+        finally:
+            # jit copied the buffer host→device at dispatch, and
+            # np.asarray above blocked on the result — safe to recycle
+            # even when conversion/predict raised.
+            ASSEMBLY_POOL.give(x)
+        return preds
 
 
 class PythonPredictor:
@@ -172,6 +192,13 @@ class LMEnginePredictor:
         cfg = lm_config or {}
         bundle = pickle.loads((artifact_dir / "flax_model.pkl").read_bytes())
         module = bundle["module"].clone(ragged_decode=True)
+        if cfg.get("kv_cache_dtype"):
+            # {"kv_cache_dtype": "int8"}: quantized-at-rest KV — on the
+            # paged layout the pool stores int8 blocks + per-position
+            # scale tables, ≈4x live tokens per cache byte (greedy
+            # streams bit-identical to fp-layout scheduling peers at
+            # the same dtype; see ops/attention int8 paths).
+            module = module.clone(kv_cache_dtype=str(cfg["kv_cache_dtype"]))
         draft_module = draft_params = None
         if cfg.get("draft_model"):
             # Speculative serving: the draft is a second registry model

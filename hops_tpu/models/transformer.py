@@ -290,11 +290,17 @@ class Attention(nn.Module):
             cv.value = put(cv.value, v.astype(self.dtype), offset)
         idx.value = offset + s
 
-        if s > 1 and fresh_cache:
+        if s > 1 and fresh_cache and not int8_cache:
             # Prefill chunk on a fresh cache: nothing earlier to attend
-            # to, so the chunk's own (unquantized) k/v are the whole
-            # visible history. GQA broadcasts kv heads for this one
-            # compute-bound pass; the cache itself stays small.
+            # to, so the chunk's own k/v are the whole visible history.
+            # GQA broadcasts kv heads for this one compute-bound pass;
+            # the cache itself stays small. The int8 cache SKIPS this
+            # shortcut: attending the exact (unquantized) chunk here
+            # while every later read sees quantized bytes made dense
+            # prefill numerics unreproducible by the paged engine's
+            # chunked prefill (which reads the chunk back through the
+            # pool) — int8 prefill reads the quantized cache instead,
+            # so dense and paged int8 streams agree bit-for-bit.
             o = flash_attention(
                 q, *repeat_kv(q, k, v), causal=True, window=self.window
             )
@@ -337,12 +343,6 @@ class Attention(nn.Module):
                 "paged_decode requires ragged_decode=True — the page "
                 "table is per-row, so rows must advance independently"
             )
-        if self.kv_cache_dtype is not None:
-            raise NotImplementedError(
-                "paged_decode supports only the bf16/fp32 cache "
-                "(kv_cache_dtype=None); the int8 pool needs paged "
-                "scale tables"
-            )
         if self.kv_pool_blocks is None or self.kv_pool_blocks < 2:
             raise ValueError(
                 "paged_decode needs kv_pool_blocks >= 2 (block 0 is "
@@ -351,11 +351,26 @@ class Attention(nn.Module):
         page = self.kv_page_size
         if page < 1:
             raise ValueError(f"kv_page_size must be >= 1, got {page}")
+        int8_cache = self.kv_cache_dtype == "int8"
         kv_heads = k.shape[1]
         max_blocks = -(-self.max_decode_len // page)
         pool_shape = (kv_heads, self.kv_pool_blocks, page, head_dim)
-        ck = self.variable("cache", "k", jnp.zeros, pool_shape, self.dtype)
-        cv = self.variable("cache", "v", jnp.zeros, pool_shape, self.dtype)
+        store_dtype = jnp.int8 if int8_cache else self.dtype
+        ck = self.variable("cache", "k", jnp.zeros, pool_shape, store_dtype)
+        cv = self.variable("cache", "v", jnp.zeros, pool_shape, store_dtype)
+        if int8_cache:
+            # Per-position scale tables live alongside the page table:
+            # one fp32 scale per (head, block, slot) for each of k/v.
+            # Every position quantizes exactly once at write time (a
+            # block never requantizes — slots are write-once until the
+            # block is freed), so CoW sharing, preemption replay, and
+            # prefix publication all see deterministic bytes.
+            cks = self.variable(
+                "cache", "k_scale", jnp.ones, pool_shape[:3], jnp.float32
+            )
+            cvs = self.variable(
+                "cache", "v_scale", jnp.ones, pool_shape[:3], jnp.float32
+            )
         pages = self.variable(
             "cache", "pages", jnp.zeros, (b, max_blocks), jnp.int32
         )
@@ -372,16 +387,27 @@ class Attention(nn.Module):
         off = posc % page
         # pool[:, blk, off] — adjacent advanced indices land at axis 1:
         # updates arrive head-major (kv_heads, b, s, head_dim).
-        ck.value = ck.value.at[:, blk, off].set(
-            jnp.swapaxes(k.astype(self.dtype), 0, 1)
-        )
-        cv.value = cv.value.at[:, blk, off].set(
-            jnp.swapaxes(v.astype(self.dtype), 0, 1)
-        )
+        if int8_cache:
+            k_q, k_s = quantize_kv(k)
+            v_q, v_s = quantize_kv(v)
+            ck.value = ck.value.at[:, blk, off].set(jnp.swapaxes(k_q, 0, 1))
+            cv.value = cv.value.at[:, blk, off].set(jnp.swapaxes(v_q, 0, 1))
+            cks.value = cks.value.at[:, blk, off].set(jnp.swapaxes(k_s, 0, 1))
+            cvs.value = cvs.value.at[:, blk, off].set(jnp.swapaxes(v_s, 0, 1))
+        else:
+            ck.value = ck.value.at[:, blk, off].set(
+                jnp.swapaxes(k.astype(self.dtype), 0, 1)
+            )
+            cv.value = cv.value.at[:, blk, off].set(
+                jnp.swapaxes(v.astype(self.dtype), 0, 1)
+            )
         idx.value = offset + s
 
         o = paged_decode_attention(
-            q, ck.value, cv.value, idx.value, pages.value, window=self.window
+            q, ck.value, cv.value, idx.value, pages.value,
+            window=self.window,
+            k_scale=cks.value if int8_cache else None,
+            v_scale=cvs.value if int8_cache else None,
         )
         return self._project_out(o, b, s, dm)
 

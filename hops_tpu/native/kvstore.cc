@@ -14,6 +14,7 @@
 //
 // C ABI only (consumed via ctypes — no pybind11 in the image).
 
+#include <sys/mman.h>
 #include <unistd.h>
 
 #include <cstdint>
@@ -40,7 +41,46 @@ struct Store {
   std::unordered_map<std::string, Entry> index;
   uint64_t end = 0;  // current append offset
   std::mutex mu;
+  // Read-only mmap of the log: point lookups are a hash probe plus a
+  // memcpy out of the mapping instead of fseek+fread per key (the
+  // fseek path measured ~2x SLOWER than sqlite's batched SELECT; the
+  // mapping is what makes the native engine the fast online backend).
+  // Remapped lazily when the log outgrows it; `flushed` tracks how far
+  // the stdio stream has been pushed into the file — a MAP_SHARED
+  // mapping sees file bytes, never the stream's private buffer.
+  char* map = nullptr;
+  uint64_t map_len = 0;
+  uint64_t flushed = 0;
 };
+
+void drop_mapping(Store* s) {
+  if (s->map != nullptr) munmap(s->map, s->map_len);
+  s->map = nullptr;
+  s->map_len = 0;
+}
+
+// Make [0, s->end) readable through s->map. Caller holds s->mu.
+// Returns false when the log is empty or mmap fails (callers fall back
+// to the fseek+fread path).
+bool ensure_mapped(Store* s) {
+  if (s->flushed < s->end) {
+    std::fflush(s->f);
+    s->flushed = s->end;
+  }
+  if (s->map != nullptr && s->map_len >= s->end) return true;
+  drop_mapping(s);
+  if (s->end == 0) return false;  // empty log: nothing to map
+  // Map the whole file (it may exceed `end` only transiently); the
+  // file can only grow, so headroom beyond `end` stays valid.
+  std::fseek(s->f, 0, SEEK_END);
+  uint64_t file_size = (uint64_t)std::ftell(s->f);
+  if (file_size < s->end) return false;
+  void* m = mmap(nullptr, file_size, PROT_READ, MAP_SHARED, fileno(s->f), 0);
+  if (m == MAP_FAILED) return false;
+  s->map = (char*)m;
+  s->map_len = file_size;
+  return true;
+}
 
 bool read_exact(std::FILE* f, void* buf, size_t n) {
   return std::fread(buf, 1, n, f) == n;
@@ -76,6 +116,7 @@ bool rebuild_index(Store* s) {
     }
   }
   s->end = pos;
+  s->flushed = pos;  // everything scanned is already in the file
   if (pos < file_size) {
     // Torn tail: cut it off. Leaving the garbage in place would let a
     // shorter subsequent append partially overwrite it, and the NEXT
@@ -136,6 +177,18 @@ int kv_put(void* h, const char* k, uint32_t klen, const char* v,
   return append_record(s, k, klen, v, vlen);
 }
 
+// Copy one entry's value bytes into `dst`. Caller holds s->mu. Serves
+// from the mmap when available (hash probe + memcpy — the hot path),
+// else falls back to fseek+fread.
+bool read_value(Store* s, const Entry& e, char* dst) {
+  if (ensure_mapped(s) && e.offset + e.length <= s->map_len) {
+    std::memcpy(dst, s->map + e.offset, e.length);
+    return true;
+  }
+  std::fseek(s->f, (long)e.offset, SEEK_SET);
+  return read_exact(s->f, dst, e.length);
+}
+
 // On hit: *out is malloc'd (caller frees via kv_free), returns 0. Miss: -1.
 int kv_get(void* h, const char* k, uint32_t klen, char** out,
            uint32_t* out_len) {
@@ -144,14 +197,63 @@ int kv_get(void* h, const char* k, uint32_t klen, char** out,
   auto it = s->index.find(std::string(k, klen));
   if (it == s->index.end()) return -1;
   char* buf = (char*)std::malloc(it->second.length + 1);
-  std::fseek(s->f, (long)it->second.offset, SEEK_SET);
-  if (!read_exact(s->f, buf, it->second.length)) {
+  if (!read_value(s, it->second, buf)) {
     std::free(buf);
     return -2;
   }
   buf[it->second.length] = 0;
   *out = buf;
   *out_len = it->second.length;
+  return 0;
+}
+
+// Batched point lookup — the online store's multi-get hot path. `keys`
+// is n records of [u32 klen][key bytes]; the reply is ONE malloc'd
+// buffer of n records [u32 vlen][value bytes] in input order, with
+// vlen == 0xFFFFFFFF (and no bytes) for a miss. One FFI crossing and
+// one lock acquisition amortize over the whole batch — the per-key
+// ctypes + mutex cost was most of a native point lookup.
+int kv_get_many(void* h, const char* keys, uint32_t nkeys, char** out,
+                uint64_t* out_len) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  // Pass 1: resolve entries and size the reply buffer.
+  std::vector<const Entry*> hits(nkeys, nullptr);
+  uint64_t total = 0;
+  const char* p = keys;
+  for (uint32_t i = 0; i < nkeys; ++i) {
+    uint32_t klen;
+    std::memcpy(&klen, p, sizeof klen);
+    p += sizeof klen;
+    auto it = s->index.find(std::string(p, klen));
+    p += klen;
+    total += sizeof(uint32_t);
+    if (it != s->index.end()) {
+      hits[i] = &it->second;
+      total += it->second.length;
+    }
+  }
+  char* buf = (char*)std::malloc(total ? total : 1);
+  if (!buf) return -1;
+  char* w = buf;
+  for (uint32_t i = 0; i < nkeys; ++i) {
+    if (!hits[i]) {
+      uint32_t miss = kTombstone;
+      std::memcpy(w, &miss, sizeof miss);
+      w += sizeof miss;
+      continue;
+    }
+    uint32_t vlen = hits[i]->length;
+    std::memcpy(w, &vlen, sizeof vlen);
+    w += sizeof vlen;
+    if (!read_value(s, *hits[i], w)) {
+      std::free(buf);
+      return -2;
+    }
+    w += vlen;
+  }
+  *out = buf;
+  *out_len = total;
   return 0;
 }
 
@@ -171,6 +273,7 @@ void kv_flush(void* h) {
   auto* s = static_cast<Store*>(h);
   std::lock_guard<std::mutex> lock(s->mu);
   std::fflush(s->f);
+  s->flushed = s->end;
 }
 
 // Rewrite the log with live records only; returns reclaimed bytes.
@@ -196,15 +299,18 @@ int64_t kv_compact(void* h) {
     pos = voff + e.length;
   }
   std::fflush(tmp);
+  drop_mapping(s);  // the old file is about to be replaced
   std::fclose(s->f);
   if (std::rename(tmp_path.c_str(), s->path.c_str()) != 0) {
     std::fclose(tmp);
     s->f = std::fopen(s->path.c_str(), "r+b");
+    s->flushed = 0;  // conservatively re-flush before the next mapping
     return -1;
   }
   s->f = tmp;
   s->index = std::move(new_index);
   s->end = pos;
+  s->flushed = pos;
   return (int64_t)(old_end - pos);
 }
 
@@ -236,6 +342,7 @@ void kv_close(void* h) {
   auto* s = static_cast<Store*>(h);
   {
     std::lock_guard<std::mutex> lock(s->mu);
+    drop_mapping(s);
     std::fflush(s->f);
     std::fclose(s->f);
   }

@@ -7,6 +7,7 @@ Exposes the same backend protocol as ``online._SqliteKV`` so
 from __future__ import annotations
 
 import ctypes
+import struct
 import threading
 from typing import Iterator
 
@@ -27,6 +28,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.kv_delete.restype = ctypes.c_int
     lib.kv_delete.argtypes = [ctypes.c_void_p, c, u32]
+    lib.kv_get_many.restype = ctypes.c_int
+    lib.kv_get_many.argtypes = [
+        ctypes.c_void_p, c, u32,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char)), ctypes.POINTER(u64),
+    ]
     lib.kv_count.restype = u64
     lib.kv_count.argtypes = [ctypes.c_void_p]
     lib.kv_flush.argtypes = [ctypes.c_void_p]
@@ -103,6 +109,43 @@ class NativeKV:
     def delete(self, key: str) -> None:
         k = key.encode()
         self._lib.kv_delete(self._h, k, len(k))
+
+    def get_many(self, keys: list[str]) -> list[str | None]:
+        """Batched point lookup in input order (None = miss): the keys
+        pack into one buffer, cross the FFI once, and the C side
+        resolves the whole batch under ONE lock acquisition — the
+        online store's multi-get path stops paying per-key ctypes +
+        mutex overhead."""
+        if not keys:
+            return []
+        parts = []
+        for key in keys:
+            k = key.encode()
+            parts.append(struct.pack("<I", len(k)))
+            parts.append(k)
+        packed = b"".join(parts)
+        out = ctypes.POINTER(ctypes.c_char)()
+        out_len = ctypes.c_uint64()
+        rc = self._lib.kv_get_many(
+            self._h, packed, len(keys), ctypes.byref(out), ctypes.byref(out_len)
+        )
+        if rc != 0:
+            raise OSError(f"kv_get_many failed (rc={rc})")
+        try:
+            blob = ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.kv_free(out)
+        vals: list[str | None] = []
+        pos = 0
+        for _ in keys:
+            (vlen,) = struct.unpack_from("<I", blob, pos)
+            pos += 4
+            if vlen == 0xFFFFFFFF:
+                vals.append(None)
+                continue
+            vals.append(blob[pos:pos + vlen].decode())
+            pos += vlen
+        return vals
 
     def scan(self) -> Iterator[str]:
         it = self._lib.kv_scan(self._h)

@@ -876,6 +876,15 @@ def paged_gather_kv(pool: jax.Array, pages: jax.Array) -> jax.Array:
     return jnp.moveaxis(pool[:, pages], 1, 0).reshape(b, hkv, mb * ps, d)
 
 
+def paged_gather_scales(pool_s: jax.Array, pages: jax.Array) -> jax.Array:
+    """Scale-table twin of :func:`paged_gather_kv`: a ``(hkv, nblocks,
+    page)`` per-position scale pool gathers to the dense ``(b, hkv,
+    max_blocks*page)`` view (:func:`quantize_kv`'s scale layout)."""
+    hkv, _, ps = pool_s.shape
+    b, mb = pages.shape
+    return jnp.moveaxis(pool_s[:, pages], 1, 0).reshape(b, hkv, mb * ps)
+
+
 def paged_decode_attention_reference(
     q: jax.Array,
     k: jax.Array,
@@ -884,12 +893,23 @@ def paged_decode_attention_reference(
     pages: jax.Array,
     sm_scale: float | None = None,
     window: int | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """XLA ground truth for :func:`paged_decode_attention`: gather the
     dense view, then :func:`decode_attention_reference`. Kept for (a)
-    numeric tests, (b) page sizes the kernel's tiling can't take."""
+    numeric tests, (b) page sizes the kernel's tiling can't take. With
+    ``k_scale``/``v_scale`` pools the gathered int8 view dequantizes
+    before the reference math (the kernel folds the same scales into
+    its dots instead)."""
     dk = paged_gather_kv(k, pages)
     dv = paged_gather_kv(v, pages)
+    if k_scale is not None:
+        dk = dequantize_kv(dk, paged_gather_scales(k_scale, pages))
+        dv = dequantize_kv(dv, paged_gather_scales(v_scale, pages))
+        return decode_attention_reference(
+            q.astype(jnp.float32), dk, dv, valid_len, sm_scale, window
+        ).astype(q.dtype)
     return decode_attention_reference(q, dk, dv, valid_len, sm_scale, window)
 
 
@@ -942,6 +962,59 @@ def _paged_decode_kernel(
         o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
 
 
+def _paged_decode_q8_kernel(
+    vl_ref, pages_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    m_scr, l_scr, acc_scr,
+    *, sm_scale, block_q, page, s, rows, window,
+):
+    """:func:`_paged_decode_kernel` over int8 pool blocks — the paged
+    twin of :func:`_decode_q8_kernel`: the physical block's int8 tiles
+    dot as raw casts (int8 is exact in bf16), the per-position fp32
+    k-scales fold into the score columns and the v-scales into the
+    prob@value dot, so no dequantized ``(page, d)`` tile is ever
+    materialized and HBM streams ~1/4 the fp32 bytes per visible
+    token. The scale tables ride the SAME page-table translation as
+    the blocks (their BlockSpec index maps share ``kv_index``), so a
+    value and its scale can never come from different physical
+    blocks."""
+    bi, qi, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    vl = _read_vl(vl_ref, bi)
+    first, last = _decode_block_range(vl, block_k=page, s=s, window=window)
+
+    @pl.when((kj >= first) & (kj <= last))
+    def _body():
+        kb = k_ref[0, 0].astype(q_ref.dtype)
+        sc = jax.lax.dot_general(
+            q_ref[0], kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        sc = sc * ks_ref[0, 0]  # (1, page) broadcasts over q rows
+        visible = _decode_mask(
+            vl, qi, kj, block_q=block_q, block_k=page, s=s, rows=rows,
+            window=window,
+        )
+        sc = jnp.where(visible, sc * sm_scale, NEG_INF)
+        _online_softmax_update(
+            sc, v_ref[0, 0].astype(q_ref.dtype),
+            m_scr.at[0], l_scr.at[0], acc_scr.at[0],
+            p_scale=vs_ref[0, 0],
+        )
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_scr[...][:, :, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+
+
 def paged_decode_attention(
     q: jax.Array,
     k: jax.Array,
@@ -949,6 +1022,8 @@ def paged_decode_attention(
     valid_len: jax.Array,
     pages: jax.Array,
     *,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
     sm_scale: float | None = None,
     window: int | None = None,
     interpret: bool | None = None,
@@ -977,7 +1052,18 @@ def paged_decode_attention(
     Pool rows the page table never references are never read. Page
     sizes that don't tile (``page % 8 != 0``) fall back to the gathered
     reference formulation.
+
+    With ``k_scale``/``v_scale`` (both or neither; fp32 ``(hkv,
+    nblocks, page)`` per-position scale pools living beside the page
+    table) the pools are int8 and the kernel folds the scales into its
+    dots in-VMEM — ~1/4 the fp32 HBM bytes per live token, which is
+    what lets an equal-memory pool hold ~4x the blocks. Routing,
+    masking, and the page translation are THIS function for both
+    precisions.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    quantized = k_scale is not None
     if window is not None and window < 1:
         raise ValueError(f"window must be >= 1, got {window}")
     b, h, s, d = q.shape
@@ -986,6 +1072,13 @@ def paged_decode_attention(
         raise ValueError(f"pool head_dim {dk} != query head_dim {d}")
     if h % hkv:
         raise ValueError(f"{h} query heads not divisible by {hkv} kv heads")
+    if quantized:
+        for name, sc in (("k_scale", k_scale), ("v_scale", v_scale)):
+            if sc.shape != (hkv, nblocks, page):
+                raise ValueError(
+                    f"scale pool {name} shape {sc.shape} != "
+                    f"{(hkv, nblocks, page)}"
+                )
     if pages.shape[0] != b:
         raise ValueError(
             f"page table rows {pages.shape[0]} != batch {b}"
@@ -998,7 +1091,8 @@ def paged_decode_attention(
         # Sub-sublane pages can't be a Mosaic block; the gathered
         # reference is the shape fallback (tests use it as ground truth).
         return paged_decode_attention_reference(
-            q, k, v, valid_len, pages, sm_scale, window
+            q, k, v, valid_len, pages, sm_scale, window,
+            k_scale=k_scale, v_scale=v_scale,
         ).astype(q.dtype)
     if interpret is None:
         if jax.default_backend() != "tpu":
@@ -1009,7 +1103,8 @@ def paged_decode_attention(
             # formulation. Pass interpret=True to force the kernel
             # (the unit tests do, to pin kernel/reference parity).
             return paged_decode_attention_reference(
-                q, k, v, valid_len, pages, sm_scale, window
+                q, k, v, valid_len, pages, sm_scale, window,
+                k_scale=k_scale, v_scale=v_scale,
             ).astype(q.dtype)
         interpret = False
 
@@ -1036,26 +1131,43 @@ def paged_decode_attention(
         kjc = jnp.maximum(jnp.clip(kj, first, last), 0)  # vl==0: last=-1
         return bi % hkv, pages_ref[bi // hkv, kjc], 0, 0
 
+    # Scale pools ride as (hkv, nblocks, 1, page): the lane-major
+    # layout hands the kernel (1, page) tiles that broadcast over score
+    # columns with no relayout (same Mosaic block-shape reasoning as
+    # the dense q8 path), and the index map is kv_index itself — the
+    # scale tile always comes from the same physical block as its
+    # values.
+    q_spec = pl.BlockSpec(
+        (1, block_q, d), lambda bi, qi, kj, vl_ref, pages_ref: (bi, qi, 0)
+    )
+    in_specs = [
+        q_spec,
+        pl.BlockSpec((1, 1, page, d), kv_index),
+        pl.BlockSpec((1, 1, page, d), kv_index),
+    ]
+    args = (qf, k, v)
+    if quantized:
+        kernel = _paged_decode_q8_kernel
+        scale_spec = pl.BlockSpec(
+            (1, 1, 1, page),
+            lambda bi, qi, kj, vl_ref, pages_ref: (
+                *kv_index(bi, qi, kj, vl_ref, pages_ref)[:2], 0, 0),
+        )
+        in_specs += [scale_spec, scale_spec]
+        args += (k_scale.reshape(hkv, nblocks, 1, page),
+                 v_scale.reshape(hkv, nblocks, 1, page))
+    else:
+        kernel = _paged_decode_kernel
     out = pl.pallas_call(
         functools.partial(
-            _paged_decode_kernel, sm_scale=sm_scale, block_q=block_q,
+            kernel, sm_scale=sm_scale, block_q=block_q,
             page=page, s=s, rows=rows, window=window,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(bh, q_rows // block_q, max_blocks),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, block_q, d),
-                    lambda bi, qi, kj, vl_ref, pages_ref: (bi, qi, 0),
-                ),
-                pl.BlockSpec((1, 1, page, d), kv_index),
-                pl.BlockSpec((1, 1, page, d), kv_index),
-            ],
-            out_specs=pl.BlockSpec(
-                (1, block_q, d),
-                lambda bi, qi, kj, vl_ref, pages_ref: (bi, qi, 0),
-            ),
+            in_specs=in_specs,
+            out_specs=q_spec,
             scratch_shapes=[
                 pltpu.VMEM((1, block_q, _LANES), jnp.float32),
                 pltpu.VMEM((1, block_q, _LANES), jnp.float32),
@@ -1067,7 +1179,7 @@ def paged_decode_attention(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")
         ),
         interpret=interpret,
-    )(vl, pages32, qf, k, v)
+    )(vl, pages32, *args)
     return out[:, :rows].reshape(b, hkv, g, s, d).reshape(b, h, s, d)
 
 

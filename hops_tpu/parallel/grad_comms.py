@@ -605,22 +605,39 @@ def _sharded_state_update(
     extra: dict[str, Any],
 ) -> Any:
     """Shared ZeRO-1/2 tail: optimizer on the 1/N flat shards of params
-    and param-shaped optimizer state, params (and moments, to keep the
-    replicated state contract) all-gathered back. ``flatten_fn`` fixes
-    the flat layout — per-dtype buckets for ZeRO-1, per-leaf buffers
-    for ZeRO-2 (must match how ``gshards`` was produced)."""
+    and param-shaped optimizer state, params all-gathered back.
+    ``flatten_fn`` fixes the flat layout — per-dtype buckets for
+    ZeRO-1, per-leaf buffers for ZeRO-2 (must match how ``gshards``
+    was produced).
+
+    Moments come in two carriages: replicated param-shaped subtrees
+    (the legacy contract) are sliced here and all-gathered back after
+    the update; :class:`MomentShards` subtrees (the persistent carrier
+    from :func:`zero12_init`) arrive as the resident local shards —
+    they update in place and are NEVER gathered, which is both the
+    1/N-at-rest memory win and one less all-gather per step."""
     pbufs, playout = flatten_fn(state.params)
     pshards = [_shard_slice(b, n, idx) for b in pbufs]
     is_param_like = _param_subtree_pred(state.params)
-    opt_vals, opt_def = jax.tree.flatten(state.opt_state, is_leaf=is_param_like)
-    opt_flags = [is_param_like(v) for v in opt_vals]
-    opt_shards, opt_layouts = [], []
-    for val, flag in zip(opt_vals, opt_flags):
-        if flag:
+    opt_vals, opt_def = jax.tree.flatten(
+        state.opt_state,
+        is_leaf=lambda x: _is_moment_shards(x) or is_param_like(x),
+    )
+    # Per entry: "persistent" (MomentShards), "replicated" (param-like,
+    # slice + gather), or passthrough (scalars like Adam's count).
+    opt_kind, opt_shards, opt_layouts = [], [], []
+    for val in opt_vals:
+        if _is_moment_shards(val):
+            opt_kind.append("persistent")
+            opt_shards.append(list(val.buffers))  # already the local shards
+            opt_layouts.append(None)
+        elif is_param_like(val):
+            opt_kind.append("replicated")
             bufs, vlayout = flatten_fn(val)
             opt_shards.append([_shard_slice(b, n, idx) for b in bufs])
             opt_layouts.append(vlayout)
         else:
+            opt_kind.append("scalar")
             opt_shards.append(val)
             opt_layouts.append(None)
     opt_state_shard = jax.tree.unflatten(opt_def, opt_shards)
@@ -632,10 +649,12 @@ def _sharded_state_update(
         [lax.all_gather(s, axis_name, tiled=True) for s in new_pshards], playout
     )
     new_opt_vals = []
-    for flag, vlayout, new_val in zip(
-        opt_flags, opt_layouts, opt_def.flatten_up_to(new_opt_shard)
+    for kind, vlayout, new_val in zip(
+        opt_kind, opt_layouts, opt_def.flatten_up_to(new_opt_shard)
     ):
-        if flag:
+        if kind == "persistent":
+            new_opt_vals.append(MomentShards(new_val))
+        elif kind == "replicated":
             gathered = [lax.all_gather(s, axis_name, tiled=True) for s in new_val]
             new_opt_vals.append(unflatten_buckets(gathered, vlayout))
         else:
@@ -669,6 +688,176 @@ def zero2_apply_gradients(
     return _sharded_state_update(
         state, gshards, lambda t: _per_leaf_buffers(t, n), axis_name, n, idx, extra
     )
+
+
+# -- ZeRO-1/2 persistent-sharded moments ---------------------------------------
+#
+# The updates above keep the replicated state contract: moments are
+# all-gathered back after every step, paying N x the optimizer-state
+# memory at rest PLUS a per-step gather of bytes nobody reads between
+# steps (only the owning shard's slice is consumed next step). The
+# carrier below banks the ZeRO-1/2 memory win ZeRO-3 already proved —
+# moments stay 1/N-sharded between steps, params stay dense/replicated
+# (no resharding of the forward path) — exact for elementwise
+# optimizers: the moment shard each replica keeps is byte-identical to
+# the slice it would have re-sliced out of the gathered tree.
+
+
+@jax.tree_util.register_pytree_node_class
+class MomentShards:
+    """A param-like optimizer-state subtree held as flat 1/N shards.
+
+    ``buffers`` mirrors the flat-buffer layout of the matching
+    gradient shards (per-dtype buckets for ZeRO-1, per-leaf buffers
+    for ZeRO-2); at rest each buffer is a global array sharded
+    ``P(axis)`` across the data mesh, inside ``shard_map`` it is the
+    replica's local ``(m,)`` slice. The wrapper is how the sharded
+    update tells "already-sharded moments" apart from the replicated
+    param-shaped subtrees it would otherwise slice."""
+
+    def __init__(self, buffers):
+        self.buffers = list(buffers)
+
+    def tree_flatten(self):
+        return self.buffers, len(self.buffers)
+
+    @classmethod
+    def tree_unflatten(cls, _n, children):
+        return cls(children)
+
+    def __repr__(self):
+        return f"MomentShards({len(self.buffers)} buffers)"
+
+
+def _is_moment_shards(x: Any) -> bool:
+    return isinstance(x, MomentShards)
+
+
+def _zero12_flatten_fn(cfg: GradCommsConfig, n: int):
+    """The flat layout the gradient shards arrive in — per-dtype
+    buckets at ``bucket_bytes`` for ZeRO-1 (update-time reduce-scatter),
+    per-leaf buffers for ZeRO-2 (scatter hooks fire per leaf). The
+    moments MUST live in the same layout."""
+    if cfg.update_sharding == "zero2":
+        return lambda t: _per_leaf_buffers(t, n)
+    return lambda t: flatten_buckets(t, cfg.bucket_bytes, pad_multiple=n)
+
+
+def zero12_init(
+    state: Any, mesh: Any, config: GradCommsConfig, axis_name: Any = "data"
+) -> Any:
+    """Convert a replicated train state into the persistent-sharded-
+    moments carrier for ZeRO-1 (``cross_replica``) / ZeRO-2: every
+    param-like optimizer subtree (Adam mu/nu, SGD trace) becomes a
+    :class:`MomentShards` of flat buffers placed ``P(axis_name)``
+    across the mesh — 1/N optimizer bytes per chip at rest. Params and
+    scalars stay replicated; the same ``TrainState`` class carries the
+    state (only ``opt_state`` changes shape). Host-side; the inverse is
+    :func:`zero12_unshard`.
+
+    A mid-training state converts moment-for-moment (the shards are
+    slices of the live moments), so resuming keeps the trajectory.
+    Raises when a param-like subtree's dtypes differ from the params'
+    — the unshard layout is derived from the param tree.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if config.update_sharding not in ("cross_replica", "zero2"):
+        raise ValueError(
+            "zero12_init applies to update_sharding='cross_replica' "
+            f"(ZeRO-1) or 'zero2', got {config.update_sharding!r}"
+        )
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    n = math.prod(mesh.shape[a] for a in axes)
+    if n == 1:
+        return state  # nothing to shard; the replicated update is exact
+    flatten_fn = _zero12_flatten_fn(config, n)
+    sharded = NamedSharding(mesh, P(axis_name))
+    p_dtypes = [jnp.dtype(l.dtype) for l in jax.tree.leaves(state.params)]
+    is_param_like = _param_subtree_pred(state.params)
+    opt_vals, opt_def = jax.tree.flatten(state.opt_state, is_leaf=is_param_like)
+    conv = []
+    for v in opt_vals:
+        if not is_param_like(v):
+            conv.append(v)
+            continue
+        v_dtypes = [jnp.dtype(l.dtype) for l in jax.tree.leaves(v)]
+        if v_dtypes != p_dtypes:
+            raise ValueError(
+                "zero12_init: optimizer moments must share the param "
+                "dtypes (the unshard layout is derived from params); "
+                "keep this optimizer on the replicated update"
+            )
+        bufs, _ = flatten_fn(v)
+        conv.append(MomentShards(
+            [jax.device_put(np.asarray(b), sharded) for b in bufs]
+        ))
+    return state.replace(opt_state=jax.tree.unflatten(opt_def, conv))
+
+
+def zero12_unshard(
+    state: Any, config: GradCommsConfig, axis_name: Any = "data"
+) -> Any:
+    """Host-side inverse of :func:`zero12_init` (eval / checkpoint
+    export): dense replicated moments rebuilt from the flat shards via
+    the param tree's flatten layout."""
+    is_param_like = _param_subtree_pred(state.params)
+    opt_vals, opt_def = jax.tree.flatten(
+        state.opt_state, is_leaf=lambda x: _is_moment_shards(x) or is_param_like(x)
+    )
+    if not any(_is_moment_shards(v) for v in opt_vals):
+        return state
+    # The layout template must use the SAME pad_multiple as init: the
+    # replica count of the mesh the shard buffers live on.
+    first = next(v for v in opt_vals if _is_moment_shards(v))
+    axes = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    n = math.prod(first.buffers[0].sharding.mesh.shape[a] for a in axes)
+    flatten_fn = _zero12_flatten_fn(config, n)
+    _, playout = flatten_fn(state.params)
+    out_vals = []
+    for v in opt_vals:
+        if _is_moment_shards(v):
+            out_vals.append(unflatten_buckets(
+                [jnp.asarray(np.asarray(b)) for b in v.buffers], playout
+            ))
+        else:
+            out_vals.append(v)
+    return state.replace(opt_state=jax.tree.unflatten(opt_def, out_vals))
+
+
+def zero12_state_specs(state: Any, axis_name: Any = "data") -> Any:
+    """PartitionSpec tree for a ZeRO-1/2 state under ``shard_map``:
+    :class:`MomentShards` buffers split over the data axis, everything
+    else (params, step, scalars, non-param-like opt entries)
+    replicated. For a state with NO sharded moments this degenerates to
+    the all-replicated spec — the legacy replicated-contract path."""
+    from jax.sharding import PartitionSpec as P
+
+    def opt_spec(v):
+        if _is_moment_shards(v):
+            return MomentShards([P(axis_name) for _ in v.buffers])
+        return jax.tree.map(lambda _: P(), v)
+
+    opt_specs = jax.tree.map(
+        opt_spec, state.opt_state, is_leaf=_is_moment_shards
+    )
+    rep = jax.tree.map(lambda _: P(), state.params)
+    kw = {}
+    if getattr(state, "rng", None) is not None:
+        kw["rng"] = jax.tree.map(lambda _: P(), state.rng)
+    if getattr(state, "batch_stats", None) is not None:
+        kw["batch_stats"] = jax.tree.map(lambda _: P(), state.batch_stats)
+    return state.replace(step=P(), params=rep, opt_state=opt_specs, **kw)
+
+
+def has_sharded_moments(state: Any) -> bool:
+    """True when ``state.opt_state`` carries :class:`MomentShards`
+    (the persistent ZeRO-1/2 carrier) — Strategy.step derives per-leaf
+    shard_map specs for such states."""
+    vals, _ = jax.tree.flatten(
+        getattr(state, "opt_state", None), is_leaf=_is_moment_shards
+    )
+    return any(_is_moment_shards(v) for v in vals)
 
 
 # -- ZeRO-3: parameters sharded at rest ----------------------------------------

@@ -136,7 +136,22 @@ class Strategy:
                 # so the shard_map specs depend on the state's structure
                 # — derived lazily from the first state seen and
                 # memoized per abstract signature.
-                inner_jit = self._zero3_step(fn, donate)
+                inner_jit = self._lazy_spec_step(
+                    fn, donate,
+                    lambda st: gc.zero3_state_specs(st, self.data_axis),
+                )
+            elif getattr(cfg, "update_sharding", None) in (
+                "cross_replica", "zero2",
+            ):
+                # ZeRO-1/2: with the persistent-sharded-moments carrier
+                # (grad_comms.zero12_init) the MomentShards buffers ride
+                # P(data) and stay resident; a plain replicated state
+                # degenerates to the all-replicated spec — same lazy
+                # per-structure derivation either way.
+                inner_jit = self._lazy_spec_step(
+                    fn, donate,
+                    lambda st: gc.zero12_state_specs(st, self.data_axis),
+                )
             else:
                 from jax.experimental.shard_map import shard_map
 
@@ -171,14 +186,18 @@ class Strategy:
         self._step_cache[key] = stepped
         return stepped
 
-    def _zero3_step(self, fn: Callable[..., Any], donate: tuple) -> Callable[..., Any]:
-        """Lazy shard_map compile for ZeRO-3 steps: the state's flat
-        param/moment shards ride ``P(data_axis)``, scalars replicate —
-        specs come from ``grad_comms.zero3_state_specs`` on the actual
-        state at first call (and re-derive per state signature)."""
+    def _lazy_spec_step(
+        self,
+        fn: Callable[..., Any],
+        donate: tuple,
+        spec_fn: Callable[[Any], Any],
+    ) -> Callable[..., Any]:
+        """Lazy shard_map compile for steps whose state carries
+        per-device shard leaves (ZeRO-3 flat param/moment shards,
+        ZeRO-1/2 persistent MomentShards buffers): the specs come from
+        ``spec_fn`` on the actual state at first call and re-derive per
+        state structure/shape signature."""
         from jax.experimental.shard_map import shard_map
-
-        from hops_tpu.parallel import grad_comms as gc
 
         compiled: dict[Any, Callable[..., Any]] = {}
 
@@ -189,7 +208,7 @@ class Strategy:
             )
             exe = compiled.get(key)
             if exe is None:
-                specs = gc.zero3_state_specs(state, self.data_axis)
+                specs = spec_fn(state)
                 inner = shard_map(
                     fn,
                     mesh=self.mesh,

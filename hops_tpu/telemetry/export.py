@@ -103,20 +103,28 @@ def render_prometheus(registry: Registry = REGISTRY,
     return "\n".join(out) + "\n"
 
 
-def snapshot(registry: Registry = REGISTRY) -> dict[str, Any]:
-    """JSON-able point-in-time dump of every metric family."""
-    families: dict[str, Any] = {}
+def snapshot(registry: Registry = REGISTRY,
+             families: "set[str] | None" = None) -> dict[str, Any]:
+    """JSON-able point-in-time dump of every metric family.
+
+    ``families`` restricts the dump to the named families — the hot
+    scrape path: the fleet router polls every replica several times a
+    second to read FOUR gauges, and rendering + parsing the full
+    registry per poll was almost all of that cost."""
+    out: dict[str, Any] = {}
     for metric in registry.collect():
+        if families is not None and metric.name not in families:
+            continue
         rows = [
             {"suffix": suffix, "labels": labels, "value": value}
             for suffix, labels, value in metric.samples()
         ]
-        families[metric.name] = {
+        out[metric.name] = {
             "type": metric.type,
             "help": metric.help,
             "samples": rows,
         }
-    return {"time": time.time(), "host": _metrics.hosttag(), "metrics": families}
+    return {"time": time.time(), "host": _metrics.hosttag(), "metrics": out}
 
 
 def handle_metrics_path(handler: BaseHTTPRequestHandler,
@@ -124,13 +132,25 @@ def handle_metrics_path(handler: BaseHTTPRequestHandler,
     """Serve ``GET /metrics`` / ``GET /metrics.json`` on an existing
     ``BaseHTTPRequestHandler`` — the hook ``modelrepo/serving.py`` uses
     to mount the scrape route on each serving's own port. Returns True
-    if the request path was a metrics route (and was answered)."""
-    path = handler.path.split("?", 1)[0].rstrip("/")
+    if the request path was a metrics route (and was answered).
+
+    ``GET /metrics.json?families=a,b`` serves only the named families
+    (unknown names are simply absent) — the router's scrape asks for
+    exactly the gauges it routes on instead of the whole registry."""
+    path, _, query = handler.path.partition("?")
+    path = path.rstrip("/")
     if path == "/metrics":
         data = render_prometheus(registry).encode()
         ctype = "text/plain; version=0.0.4; charset=utf-8"
     elif path == "/metrics.json":
-        data = json.dumps(snapshot(registry)).encode()
+        wanted = None
+        if query:
+            from urllib.parse import parse_qs
+
+            raw = parse_qs(query).get("families", [])
+            names = {n for part in raw for n in part.split(",") if n}
+            wanted = names or None
+        data = json.dumps(snapshot(registry, families=wanted)).encode()
         ctype = "application/json"
     else:
         return False

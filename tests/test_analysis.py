@@ -809,6 +809,97 @@ def test_blocking_call_tree_is_clean():
     assert findings == []
 
 
+# -- relay-json-roundtrip -----------------------------------------------------
+
+
+def test_relay_roundtrip_flags_parse_then_redump(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        import json
+
+        def forward(resp):
+            payload = json.loads(resp.read())
+            return json.dumps(payload).encode()
+
+        def reply(body):
+            return json.dumps(json.loads(body))
+        """,
+        rule="relay-json-roundtrip",
+        filename=FLEET_FILE,
+    )
+    assert len(findings) == 2
+    assert all(f.rule == "relay-json-roundtrip" for f in findings)
+    assert "re-json.dumps'ed" in findings[0].message or \
+        "never read" in findings[0].message
+
+
+def test_relay_roundtrip_not_flagged_when_object_is_read(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        import json
+
+        def merge(body, extra):
+            payload = json.loads(body)        # inspected below: fine
+            payload["debug"] = extra
+            return json.dumps(payload)
+
+        def inspect(body):
+            obj = json.loads(body)            # read, never re-dumped
+            return obj.get("instances")
+
+        def branch(body):
+            p = json.loads(body)
+            if p.get("error"):                # conditional read
+                return json.dumps(p)
+            return b"{}"
+
+        def dumps_something_else(body, other):
+            _ = json.loads(body)  # noqa — unused parse, not a re-dump
+            return json.dumps(other)
+        """,
+        rule="relay-json-roundtrip",
+        filename=FLEET_FILE,
+    )
+    assert findings == []
+
+
+def test_relay_roundtrip_scoped_to_fleet_and_serving(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    (tmp_path / "hops_tpu" / "featurestore").mkdir(parents=True)
+    code = """
+    import json
+
+    def echo(body):
+        return json.dumps(json.loads(body))
+    """
+    outside = lint_code(tmp_path, code, rule="relay-json-roundtrip",
+                        filename="hops_tpu/featurestore/feed.py")
+    assert outside == []
+    for scoped in (FLEET_FILE, "hops_tpu/modelrepo/serving.py"):
+        inside = lint_code(tmp_path, code, rule="relay-json-roundtrip",
+                           filename=scoped)
+        assert len(inside) == 1, scoped
+
+
+def test_relay_roundtrip_tree_is_clean():
+    """The relay tier itself holds the zero-copy discipline — zero
+    findings over fleet/ + serving.py, no baseline entries."""
+    import hops_tpu
+
+    modelrepo = Path(hops_tpu.__file__).parent / "modelrepo"
+    rules = [r for r in engine.all_rules()
+             if r.name == "relay-json-roundtrip"]
+    findings = engine.run(
+        [modelrepo / "fleet", modelrepo / "serving.py"],
+        root=modelrepo.parent.parent, rules=rules,
+    )
+    assert findings == []
+
+
 # -- suppression --------------------------------------------------------------
 
 

@@ -196,6 +196,126 @@ class TestRouterRateLimit:
         assert counter.value(tenant="sprayed-0") == 0
 
 
+# -- zero-copy relay ----------------------------------------------------------
+
+
+class TestZeroCopyRelay:
+    """The forward path relays bodies as verbatim bytes (no parse /
+    re-serialize); the lazy-parse paths (capture summaries, timeline
+    merge) still see the object they need."""
+
+    def _get_bytes(self, url: str, body: bytes) -> tuple[int, bytes]:
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read()
+
+    def test_200_relays_replica_bytes_verbatim(self, fleet_model):
+        with _start(fleet_model, replicas=1) as f:
+            rep = f.manager.replicas()[0]
+            body = json.dumps({"instances": [[3]]}).encode()
+            code_d, direct = self._get_bytes(
+                f"http://127.0.0.1:{rep.port}/v1/models/flt:predict", body)
+            code_r, routed = self._get_bytes(
+                f"{f.router.endpoint}/predict", body)
+            assert code_d == code_r == 200
+            assert routed == direct  # byte-for-byte, not just value-equal
+
+    def test_4xx_and_5xx_relay_verbatim(self, fleet_model):
+        # 400: serving rejects a bodyless instances list; 500: the
+        # predictor raises. Both replica-authored bodies must reach
+        # the client untouched (they used to be parsed + re-dumped).
+        v_err = _export_version("flt", "raise RuntimeError('boom-xyz')")
+        serving.create_or_update("flt", model_name="flt",
+                                 model_version=v_err, model_server="PYTHON")
+        with _start(fleet_model, replicas=1, max_attempts=1) as f:
+            rep = f.manager.replicas()[0]
+            bad = json.dumps({"bogus": True}).encode()
+            code_d, direct = self._get_bytes(
+                f"http://127.0.0.1:{rep.port}/v1/models/flt:predict", bad)
+            code_r, routed = self._get_bytes(
+                f"{f.router.endpoint}/predict", bad)
+            assert code_d == code_r and code_d >= 400
+            assert routed == direct
+            good = json.dumps({"instances": [[1]]}).encode()
+            code_d, direct = self._get_bytes(
+                f"http://127.0.0.1:{rep.port}/v1/models/flt:predict", good)
+            code_r, routed = self._get_bytes(
+                f"{f.router.endpoint}/predict", good)
+            assert code_d == code_r == 500
+            assert b"boom-xyz" in routed
+            assert routed == direct
+
+    def test_timeline_merge_still_parses_lazily(self, fleet_model):
+        # The ONE success path that needs the object: an explicit
+        # X-Hops-Debug ask still gets the merged router+replica
+        # timeline out of the relayed bytes.
+        with _start(fleet_model, replicas=1) as f:
+            req = urllib.request.Request(
+                f"{f.router.endpoint}/predict",
+                data=json.dumps({"instances": [[2]]}).encode(),
+                headers={"Content-Type": "application/json",
+                         "X-Hops-Debug": "timeline"},
+            )
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                payload = json.loads(resp.read())
+            assert payload["predictions"] == [[4]]
+            names = {r.get("name") for r in payload["debug"]["timeline"]}
+            assert "fleet.request" in names  # router's own span merged
+            assert "fleet.forward" in names
+
+    def test_capture_shape_summaries_survive_byte_relay(self, fleet_model):
+        # The recorder's shape summaries parse the REQUEST body lazily
+        # (armed captures only) — the zero-copy path must not starve
+        # them.
+        from hops_tpu.telemetry import workload
+
+        d = Path(tempfile.mkdtemp(prefix="relay_cap_"))
+        with _start(fleet_model, replicas=1) as f:
+            workload.start_capture(d)
+            try:
+                assert f.predict([[5]])["predictions"] == [[10]]
+            finally:
+                workload.stop_capture()
+        records = [
+            json.loads(line)
+            for seg in sorted(d.glob("segment_*.jsonl"))
+            for line in seg.read_text().splitlines()
+        ]
+        front = [r for r in records if r.get("surface") == "router"]
+        assert front and front[0]["payload"]["instances"] == [[5]]
+        assert front[0]["status"] == 200
+
+
+# -- hot-path micro bounds ----------------------------------------------------
+
+
+class TestHotPathOverheadBounds:
+    def test_hot_path_micro_tier_bounds(self):
+        """bench.py --hot-path, bound-enforced (the --tracing-overhead
+        pattern): the zero-copy relay must be orders of magnitude under
+        the json round-trip it replaced, steady-state batch assembly
+        must ride the pool, the native online backend must not regress
+        below sqlite (the pre-mmap fseek path measured 0.5x), and the
+        int8 block tax must be measured and finite."""
+        from bench import run_hot_path_bench
+
+        result = run_hot_path_bench(smoke=True)
+        assert result["relay_zero_copy_ns_per_request"] < 5_000
+        assert (result["relay_zero_copy_ns_per_request"] * 10
+                < result["relay_json_roundtrip_ns_per_request"])
+        assert result["assembly_reuse_hit_rate"] > 0.9
+        assert result["kv_quant_ns_per_block"] > 0
+        assert result["kv_dequant_ns_per_block"] > 0
+        if result["online_lookup_native_ns"] is not None:
+            # mmap reads: a native lookup must at least keep pace with
+            # sqlite (generous floor for noisy CI boxes).
+            assert result["online_native_speedup"] > 0.9
+
+
 # -- least-loaded selection ---------------------------------------------------
 
 
@@ -282,6 +402,33 @@ class TestRouterSelection:
             "Retry-After": "2", "X-Custom": "kept",
         })
         assert relayed == {"Retry-After": "2", "X-Custom": "kept"}
+
+    def test_byte_relay_keeps_replica_content_type(self):
+        # A verbatim byte body travels with the replica's DECLARED
+        # type (an HTML error page must not be stamped
+        # application/json); Content-Length alone is recomputed.
+        from hops_tpu.modelrepo.fleet.router import _relayed_with_ctype
+
+        relayed = _relayed_with_ctype({
+            "Content-Length": "999", "Content-Type": "text/html",
+            "Connection": "close", "X-Custom": "kept",
+        })
+        assert relayed == {"Content-Type": "text/html", "X-Custom": "kept"}
+        assert _relayed_with_ctype({"X-Custom": "v"}) == {"X-Custom": "v"}
+        # HTTP header casing is not ours to assume.
+        lower = _relayed_with_ctype({"content-type": "text/plain"})
+        assert lower == {"Content-Type": "text/plain"}
+
+    def test_merge_debug_relays_non_object_json_bytes_untouched(self):
+        # Valid-JSON-but-not-an-object bodies have nothing to merge
+        # into: the ORIGINAL bytes relay (no parse→re-serialize drift).
+        r = self._router([])
+        try:
+            raw = b'[1,  2]'  # whitespace would not survive a re-dump
+            assert r._merge_debug(raw, None) is raw
+            assert r._merge_debug(b'not json', None) == b'not json'
+        finally:
+            r.stop()
 
     def test_views_pruned_for_vanished_replicas(self):
         # Every rollout/autoscale churn mints fresh rids; views for
@@ -960,7 +1107,10 @@ class TestProcessWorkers:
             # the TF-Serving path through the router.
             code, payload, _ = router.route(
                 json.dumps({"instances": [[8]]}).encode())
-            assert code == 200 and payload["predictions"] == [[16]]
+            # Zero-copy relay: the routed payload is the replica's
+            # verbatim bytes.
+            assert code == 200
+            assert json.loads(payload)["predictions"] == [[16]]
             # Its OWN process registry answers the scrape.
             router.scrape_once()
             assert router._view(rep.rid).scrape_ok

@@ -238,8 +238,11 @@ def test_int8_cache_decode_close_to_fp_cache():
         {"params": params}, tokens, decode=True, mutable=["cache"])
     q8_logits, q8_vars = q8.apply(
         {"params": params}, tokens, decode=True, mutable=["cache"])
-    # Prefill runs unquantized in both; logits identical.
-    np.testing.assert_allclose(q8_logits, fp_logits, atol=1e-5, rtol=1e-5)
+    # Prefill reads back through the quantized cache (round 12: the
+    # old unquantized flash shortcut made dense int8 numerics
+    # unreproducible by the paged engine's chunked prefill), so
+    # prefill logits track fp within the quantization envelope.
+    np.testing.assert_allclose(q8_logits, fp_logits, atol=0.15, rtol=0.05)
     caches = jax.tree_util.tree_leaves_with_path(q8_vars["cache"])
     assert any(leaf.dtype == jnp.int8 for _, leaf in caches)
 
@@ -329,7 +332,8 @@ def test_gqa_decode_matches_full_forward():
         {"params": params}, prompt, decode=True, mutable=["cache"])
     q8_logits, q8_vars = q8.apply(
         {"params": params}, prompt, decode=True, mutable=["cache"])
-    np.testing.assert_allclose(q8_logits, fp_logits, atol=1e-5)  # prefill: unquantized
+    # Prefill reads the quantized cache too (round 12) — int8 envelope.
+    np.testing.assert_allclose(q8_logits, fp_logits, atol=0.15, rtol=0.05)
     step_tok = jnp.argmax(fp_logits[:, -1:], axis=-1)
     fp_step, _ = model.apply(
         {"params": params, "cache": fp_vars["cache"]}, step_tok,

@@ -75,6 +75,7 @@ def test_analysis_registered_in_drift_guard():
         "hops_tpu.analysis.rules.swallowed_exception",
         "hops_tpu.analysis.rules.blocking_call",
         "hops_tpu.analysis.rules.debug_surfaces",
+        "hops_tpu.analysis.rules.relay_json_roundtrip",
     ):
         assert mod in names
 

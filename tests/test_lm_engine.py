@@ -1356,9 +1356,162 @@ def test_engine_paged_rejects_invalid_config():
         LMEngine(model, params, prefill_chunk=8)
     with pytest.raises(ValueError, match="kv_pool_blocks"):
         LMEngine(model, params, kv_page_size=8, kv_pool_blocks=1)
-    int8 = TransformerLM(**TINY, ragged_decode=True, kv_cache_dtype="int8")
-    with pytest.raises(ValueError, match="int8"):
-        LMEngine(int8, params, kv_page_size=8)
+    bogus = TransformerLM(**TINY, ragged_decode=True, kv_cache_dtype="fp8")
+    with pytest.raises(ValueError, match="None or 'int8'"):
+        LMEngine(bogus, params, kv_page_size=8)
+
+
+# --- int8 paged KV: quantized-at-rest pool + per-block scale tables ----------
+# Block-scaled int8 at rest ≈ 4x blocks per byte of pool; the contract:
+# greedy streams BIT-IDENTICAL to the dense engine at the SAME
+# kv_cache_dtype (both layouts read identical quantized bytes — the
+# dense int8 prefill reads back through the cache exactly like the
+# paged chunked prefill), sampled/fp within the int8 error envelope.
+
+TINY8 = dict(TINY)
+
+
+def _int8_model():
+    return TransformerLM(**TINY8, ragged_decode=True, kv_cache_dtype="int8")
+
+
+def test_engine_paged_int8_matches_dense_int8_greedy():
+    model = _int8_model()
+    params = _params(TransformerLM(**TINY8))
+    rs = np.random.RandomState(21)
+    prompts = _mixed_prompts(rs)
+    d, p, _, paged = _run_both(
+        model, params, prompts,
+        submit_kwargs=[{"max_new_tokens": 10} for _ in prompts],
+    )
+    assert d == p  # bit-identical token streams, quantized pool
+    assert paged._pool.used == 0
+    assert paged.prefill_chunks > len(prompts)
+
+
+def test_engine_paged_int8_matches_dense_int8_sampled_and_spec():
+    """Sampled rows and the speculative path compose with the int8
+    pool — streams identical to the dense int8 engine (the sampling
+    key chain and accept logic are layout-independent)."""
+    model = _int8_model()
+    plain = TransformerLM(**TINY8)
+    params = _params(plain)
+    rs = np.random.RandomState(22)
+    prompts = _mixed_prompts(rs, n=4)
+    kws = [
+        {"max_new_tokens": 8, "temperature": 0.8, "top_k": 8, "seed": 31},
+        {"max_new_tokens": 6, "temperature": 1.1, "top_p": 0.9, "seed": 32},
+        {"max_new_tokens": 9},
+        {"max_new_tokens": 7, "eos_id": 5},
+    ]
+    d, p, *_ = _run_both(model, params, prompts, submit_kwargs=kws)
+    assert d == p
+    # Speculative: int8 target + int8 draft share the page table.
+    spec = dict(draft_model=model, draft_params=_params(plain, seed=5),
+                spec_k=3)
+    d, p, _, paged = _run_both(
+        model, params, prompts,
+        submit_kwargs=[{"max_new_tokens": 8} for _ in prompts],
+        dense_kw=spec, paged_kw=spec,
+    )
+    assert d == p
+    assert paged.spec_offered > 0
+    assert paged._pool.used == 0
+
+
+def test_engine_paged_int8_prefix_cow_and_preemption_compose():
+    """CoW prefix sharing and preemption replay are page-table
+    mechanics — quantization (write-once per position) does not
+    perturb them: shared-prefix and preempted streams stay identical
+    to dense int8."""
+    model = _int8_model()
+    params = _params(TransformerLM(**TINY8))
+    rs = np.random.RandomState(23)
+    prefix = rs.randint(1, 64, (20,))
+    s1, s2 = rs.randint(1, 64, (5,)), rs.randint(1, 64, (7,))
+
+    dense = LMEngine(model, params, slots=2, prefill_buckets=(8, 16, 32))
+    dense.register_prefix("sys", prefix)
+    d1 = dense.submit(s1, max_new_tokens=8, prefix_id="sys")
+    d2 = dense.submit(s2, max_new_tokens=8, prefix_id="sys")
+    dres = dense.run()
+    paged = LMEngine(model, params, slots=2, **PAGED)
+    paged.register_prefix("sys", prefix)
+    u1 = paged.submit(s1, max_new_tokens=8, prefix_id="sys")
+    u2 = paged.submit(s2, max_new_tokens=8, prefix_id="sys")
+    pres = paged.run()
+    assert dres[d1] == pres[u1] and dres[d2] == pres[u2]
+    entry = paged._prefixes["sys"]
+    assert entry.blocks and all(
+        paged._pool.refcount(b) == 1 for b in entry.blocks)
+
+    # Preemption: dry pool forces preempt-newest; replay bit-identical.
+    p1, p2 = rs.randint(1, 64, (20,)), rs.randint(1, 64, (20,))
+    tight = LMEngine(model, params, slots=2, kv_page_size=8,
+                     kv_pool_blocks=9, prefill_chunk=8)
+    a = tight.submit(p1, max_new_tokens=20)
+    b = tight.submit(p2, max_new_tokens=20)
+    tres = tight.run()
+    dd = LMEngine(model, params, slots=2, prefill_buckets=(8, 32))
+    da = dd.submit(p1, max_new_tokens=20)
+    db = dd.submit(p2, max_new_tokens=20)
+    ddres = dd.run()
+    assert tres[a] == ddres[da] and tres[b] == ddres[db]
+    assert tight.preemptions > 0
+    assert tight._pool.used == 0
+
+
+def test_engine_paged_int8_tensor_parallel_matches_single():
+    """TP composes: int8 pools AND their scale tables shard on the
+    head axis (tp_cache_specs covers 4-D value and 3-D scale pools
+    alike); streams identical to the single-device int8 engines."""
+    from hops_tpu.parallel import mesh as mesh_lib
+
+    model = _int8_model()
+    params = _params(TransformerLM(**TINY8))
+    rs = np.random.RandomState(24)
+    prompts = _mixed_prompts(rs, n=4)
+    mesh = mesh_lib.make_mesh({"model": 2}, devices=jax.devices()[:2])
+    tp = LMEngine(model, params, slots=2, **PAGED, mesh=mesh)
+    single = LMEngine(model, params, slots=2, **PAGED)
+    outs = []
+    for engine in (tp, single):
+        ts = [engine.submit(p, max_new_tokens=8) for p in prompts]
+        res = engine.run()
+        outs.append([res[t] for t in ts])
+    assert outs[0] == outs[1]
+    kpool = tp._cache["block_0"]["attn"]["k"]
+    kscale = tp._cache["block_0"]["attn"]["k_scale"]
+    assert kpool.dtype == jnp.int8
+    assert kpool.sharding.spec == jax.sharding.PartitionSpec("model")
+    assert kscale.sharding.spec == jax.sharding.PartitionSpec("model")
+
+
+def test_engine_paged_int8_pool_capacity_at_equal_memory():
+    """The memory story: at the SAME cache-byte budget the int8 pool
+    (1-byte values + one fp32 scale per position per k/v) holds ≥ 1.5x
+    the blocks of the fp32 pool, and the utilization gauge's
+    denominator reflects the grown capacity."""
+    model = _int8_model()
+    params = _params(TransformerLM(**TINY8))
+    page = 8
+    head_dim = TINY8["d_model"] // 4  # num_heads=4, MHA
+    fp_bytes_per_tok = head_dim * 4 * 2            # fp32 k+v
+    q8_bytes_per_tok = (head_dim + 4) * 2          # int8 k+v + fp32 scales
+    budget = 64 * fp_bytes_per_tok                 # 64 fp tokens worth
+    fp_blocks = 1 + budget // (fp_bytes_per_tok * page)
+    q8_blocks = 1 + budget // (q8_bytes_per_tok * page)
+    assert (q8_blocks - 1) >= 1.5 * (fp_blocks - 1)
+    engine = LMEngine(model, params, slots=2, kv_page_size=page,
+                      kv_pool_blocks=int(q8_blocks), prefill_chunk=8)
+    assert engine._pool.stats()["blocks_total"] == q8_blocks - 1
+    # The pool really is int8 + scale tables of the declared shapes.
+    kpool = engine._cache["block_0"]["attn"]["k"]
+    kscale = engine._cache["block_0"]["attn"]["k_scale"]
+    assert kpool.dtype == jnp.int8
+    assert kpool.shape == (4, q8_blocks, page, head_dim)
+    assert kscale.shape == (4, q8_blocks, page)
+    assert kscale.dtype == jnp.float32
 
 
 def test_bench_lm_serving_smoke_e2e():
@@ -1395,6 +1548,12 @@ def test_bench_lm_serving_smoke_e2e():
     assert line["dense_tokens_per_sec_per_chip"] > 0
     assert line["dense_ttft_p99_ms"] > 0
     assert line["speedup_vs_dense"] > 0
+    # int8 leg at the same byte budget: the acceptance pin — ≥1.5x
+    # live tokens per pool vs fp blocks.
+    assert line["int8_live_tokens_ratio"] >= 1.5
+    assert line["int8_pool_blocks"] > line["fp_pool_blocks"]
+    assert line["int8_tokens_per_sec_per_chip"] > 0
+    assert 0.0 <= line["int8_block_pool_peak_util"] <= 1.0
 
 
 def test_engine_paged_admission_evicts_idle_prefix_instead_of_deadlock():

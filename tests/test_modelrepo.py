@@ -298,6 +298,42 @@ class TestBatchInference:
         direct = np.asarray(apply_fn(jnp.asarray(inputs)))
         np.testing.assert_allclose(preds, direct, rtol=2e-4, atol=2e-4)
 
+    def test_assembly_pool_reuses_buffers(self):
+        pool = batch.AssemblyPool(depth=2)
+        a = pool.take((4, 3), np.float32)
+        pool.give(a)
+        b = pool.take((4, 3), np.float32)
+        assert b is a  # second checkout of the spec reuses the buffer
+        assert pool.take((4, 3), np.float32) is not a  # pool drained: fresh
+        assert pool.take((8, 3), np.float32).shape == (8, 3)  # new spec
+        assert 0.0 <= pool.hit_rate() <= 1.0
+
+    def test_assembly_pool_depth_cap(self):
+        pool = batch.AssemblyPool(depth=1)
+        a = pool.take((2,), np.float32)
+        b = pool.take((2,), np.float32)
+        pool.give(a)
+        pool.give(b)  # over depth: dropped, not hoarded
+        assert pool.take((2,), np.float32) is a
+        assert pool.take((2,), np.float32) is not b
+
+    def test_batch_predict_tail_pad_rides_the_pool(self, trained_ffn):
+        # Two ragged runs: the second run's tail pad must hit the pool
+        # (same chunk spec), and results stay correct.
+        from hops_tpu.telemetry.metrics import REGISTRY
+
+        model, params = trained_ffn
+        apply_fn = lambda x: model.apply({"params": params}, x)  # noqa: E731
+        hit_counter = REGISTRY.counter(
+            "hops_tpu_batch_assembly_reuse_total", labels=("site", "result"))
+        hits0 = hit_counter.value(site="batch", result="hit")
+        inputs = np.random.randn(9, 28, 28, 1).astype(np.float32)
+        p1 = batch.batch_predict(apply_fn, inputs, per_chip_batch=4)
+        p2 = batch.batch_predict(apply_fn, inputs, per_chip_batch=4)
+        np.testing.assert_allclose(p1, p2, rtol=1e-6)
+        # The second run's tail pad reused the first run's buffer.
+        assert hit_counter.value(site="batch", result="hit") >= hits0 + 1
+
     @pytest.mark.slow  # TransformerLM compiles (round-5 re-tiering)
     def test_lm_generate_with_model_offline(self):
         """LM batch inference from the registry rides the offline drain

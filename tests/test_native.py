@@ -69,6 +69,25 @@ class TestNativeKV:
         assert kv.get("uni") == "héllo wörld ✓"
         kv.close()
 
+    def test_get_many_matches_single_gets(self, tmp_path):
+        # One FFI crossing for the whole batch; order and miss
+        # semantics identical to a get() loop.
+        kv = kvstore.NativeKV(str(tmp_path / "m.hkv"))
+        for i in range(100):
+            kv.put(f"k{i}", f"v{i}" * (i % 7 + 1))
+        kv.delete("k50")
+        keys = [f"k{i}" for i in range(0, 120, 3)] + ["k50", "absent", "k1"]
+        assert kv.get_many(keys) == [kv.get(k) for k in keys]
+        assert kv.get_many([]) == []
+        kv.close()
+
+    def test_get_many_unicode_and_empty_values(self, tmp_path):
+        kv = kvstore.NativeKV(str(tmp_path / "mu.hkv"))
+        kv.put("uni", "héllo ✓")
+        kv.put("empty", "")
+        assert kv.get_many(["uni", "empty", "nope"]) == ["héllo ✓", "", None]
+        kv.close()
+
 
 class TestRecordIO:
     @pytest.mark.parametrize("force_python", [False, True])
@@ -119,8 +138,53 @@ class TestOnlineStoreBackends:
         for store in (native_store, sqlite_store):
             store.put_dataframe(df, ["id"])
             assert store.get([2])["v"] == 1.5
+            assert store.get_many([[1], [2], [3]]) == [
+                store.get([1]), store.get([2]), None]
             assert store.count() == 2
             store.close()
+
+    def test_backend_env_forcing(self, tmp_path, monkeypatch):
+        from hops_tpu.featurestore import online
+        from hops_tpu.native.kvstore import NativeKV
+
+        monkeypatch.setenv("HOPS_TPU_ONLINE_BACKEND", "sqlite")
+        s = online.OnlineStore(tmp_path / "forced_sql")
+        assert isinstance(s._impl, online._SqliteKV)
+        s.close()
+        monkeypatch.setenv("HOPS_TPU_ONLINE_BACKEND", "native")
+        s = online.OnlineStore(tmp_path / "forced_nat")
+        assert isinstance(s._impl, NativeKV)
+        s.close()
+        monkeypatch.setenv("HOPS_TPU_ONLINE_BACKEND", "bogus")
+        with pytest.raises(ValueError, match="auto|native|sqlite"):
+            online.OnlineStore(tmp_path / "bad")
+
+    def test_backend_native_required_but_unbuilt_raises(
+            self, tmp_path, monkeypatch):
+        from hops_tpu.featurestore import online
+
+        monkeypatch.setattr(kvstore, "available", lambda: False)
+        monkeypatch.setenv("HOPS_TPU_ONLINE_BACKEND", "native")
+        with pytest.raises(RuntimeError, match="not built"):
+            online.OnlineStore(tmp_path / "need_native")
+
+    def test_existing_shard_file_pins_backend(self, tmp_path, monkeypatch):
+        # A store created under sqlite keeps reading its own data even
+        # after the env flips to auto/native (formats differ on disk).
+        import pandas as pd
+
+        from hops_tpu.featurestore import online
+
+        df = pd.DataFrame({"id": [1], "v": [9.0]})
+        monkeypatch.setenv("HOPS_TPU_ONLINE_BACKEND", "sqlite")
+        s = online.OnlineStore(tmp_path / "pin")
+        s.put_dataframe(df, ["id"])
+        s.close()
+        monkeypatch.delenv("HOPS_TPU_ONLINE_BACKEND")
+        s2 = online.OnlineStore(tmp_path / "pin")
+        assert isinstance(s2._impl, online._SqliteKV)
+        assert s2.get([1])["v"] == 9.0
+        s2.close()
 
 
 class TestTornWrite:

@@ -326,6 +326,114 @@ def test_paged_decode_attention_sub_sublane_page_falls_back():
     np.testing.assert_allclose(out, ref, atol=1e-6)
 
 
+def _q8_pool_inputs(hkv=2, nblocks=10, page=8, d=32, seed=3):
+    """fp pools + their per-position int8 quantization (pool layout:
+    values (hkv, nblocks, page, d), scales (hkv, nblocks, page))."""
+    from hops_tpu.ops.attention import quantize_kv
+
+    k, v = _pool_inputs(hkv, nblocks, page, d, seed)
+    kq, ks = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    return k, v, kq, ks, vq, vs
+
+
+@pytest.mark.parametrize("s", [1, 4])
+def test_paged_decode_q8_kernel_matches_reference(s):
+    """The int8 paged kernel (scale tables riding the same page-table
+    translation as the blocks, forced via interpret=True off-TPU)
+    equals the gathered-dequantize reference twin, GQA + ragged rows
+    included."""
+    from hops_tpu.ops.attention import (
+        paged_decode_attention,
+        paged_decode_attention_reference,
+    )
+
+    _, _, kq, ks, vq, vs = _q8_pool_inputs()
+    pages = jnp.asarray([[1, 2, 3, 4], [5, 6, 0, 0], [7, 8, 9, 0]], jnp.int32)
+    vl = jnp.asarray([30, 9, 17], jnp.int32)
+    rs = np.random.RandomState(4)
+    q = jnp.asarray(rs.randn(3, 4, s, 32), jnp.float32)
+    out = paged_decode_attention(
+        q, kq, vq, vl, pages, k_scale=ks, v_scale=vs, interpret=True)
+    ref = paged_decode_attention_reference(
+        q, kq, vq, vl, pages, k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(out, ref, atol=2e-6, rtol=2e-6)
+
+
+def test_paged_decode_q8_close_to_fp_pool():
+    """Quantized-pool attention tracks the fp pool within the int8
+    error envelope (the accuracy story behind ~4x blocks per byte)."""
+    from hops_tpu.ops.attention import paged_decode_attention
+
+    k, v, kq, ks, vq, vs = _q8_pool_inputs()
+    pages = jnp.asarray([[1, 2, 3, 4], [5, 6, 0, 0]], jnp.int32)
+    vl = jnp.asarray([30, 12], jnp.int32)
+    rs = np.random.RandomState(7)
+    q = jnp.asarray(rs.randn(2, 4, 1, 32), jnp.float32)
+    fp = paged_decode_attention(q, k, v, vl, pages, interpret=True)
+    q8 = paged_decode_attention(
+        q, kq, vq, vl, pages, k_scale=ks, v_scale=vs, interpret=True)
+    np.testing.assert_allclose(q8, fp, atol=0.05, rtol=0.05)
+
+
+def test_paged_decode_q8_zero_row_and_scratch_block():
+    """Free-slot convention holds for the quantized pool too: a vl==0
+    row emits zeros and scratch-block garbage (values AND scales) is
+    unreachable."""
+    from hops_tpu.ops.attention import paged_decode_attention
+
+    _, _, kq, ks, vq, vs = _q8_pool_inputs()
+    pages = jnp.asarray([[0, 0, 0, 0], [5, 6, 0, 0], [7, 8, 9, 0]], jnp.int32)
+    vl = jnp.asarray([0, 9, 17], jnp.int32)
+    rs = np.random.RandomState(5)
+    q = jnp.asarray(rs.randn(3, 4, 1, 32), jnp.float32)
+    clean = paged_decode_attention(
+        q, kq, vq, vl, pages, k_scale=ks, v_scale=vs, interpret=True)
+    assert np.allclose(np.asarray(clean)[0], 0.0)
+    dirty = paged_decode_attention(
+        q, kq.at[:, 0].set(127), vq.at[:, 0].set(-127), vl, pages,
+        k_scale=ks.at[:, 0].set(1e30), v_scale=vs.at[:, 0].set(1e30),
+        interpret=True)
+    np.testing.assert_array_equal(np.asarray(clean)[1:], np.asarray(dirty)[1:])
+
+
+def test_paged_decode_q8_sub_sublane_page_falls_back():
+    """page % 8 != 0 routes the quantized pool to the gathered
+    reference, same contract as fp."""
+    from hops_tpu.ops.attention import (
+        paged_decode_attention,
+        paged_decode_attention_reference,
+    )
+
+    _, _, kq, ks, vq, vs = _q8_pool_inputs(page=6)
+    pages = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    vl = jnp.asarray([7, 12], jnp.int32)
+    rs = np.random.RandomState(6)
+    q = jnp.asarray(rs.randn(2, 2, 1, 32), jnp.float32)
+    out = paged_decode_attention(
+        q, kq, vq, vl, pages, k_scale=ks, v_scale=vs)
+    ref = paged_decode_attention_reference(
+        q, kq, vq, vl, pages, k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_paged_decode_q8_scale_arg_validation():
+    from hops_tpu.ops.attention import paged_decode_attention
+
+    _, _, kq, ks, vq, vs = _q8_pool_inputs()
+    pages = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    vl = jnp.asarray([7, 12], jnp.int32)
+    q = jnp.zeros((2, 2, 1, 32), jnp.float32)
+    with pytest.raises(ValueError, match="both k_scale and v_scale"):
+        paged_decode_attention(q, kq, vq, vl, pages, k_scale=ks)
+    with pytest.raises(ValueError, match="scale pool k_scale shape"):
+        paged_decode_attention(
+            q, kq, vq, vl, pages, k_scale=ks[:, :, :4], v_scale=vs[:, :, :4])
+    with pytest.raises(ValueError, match="scale pool v_scale shape"):
+        paged_decode_attention(
+            q, kq, vq, vl, pages, k_scale=ks, v_scale=vs[:, :, :4])
+
+
 # -- int8-quantized decode cache ---------------------------------------------
 
 
