@@ -945,6 +945,233 @@ def run_serving_fleet_bench(
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_continuous_loop_bench(
+    smoke: bool = False,
+    *,
+    records: int = 2_000,
+    publish_rps: float = 600.0,
+    min_records: int = 16,
+    eval_every: int = 10,
+    clients: int = 4,
+    work_ms: float = 2.0,
+) -> dict:
+    """The ``--continuous-loop`` tier: the whole closed loop under load.
+
+    Host-only (JAX pinned to CPU — the checkpoint layer initializes a
+    backend; no relay lock). One process runs all four layers at once:
+
+    1. a **producer thread** publishes ``records`` training rows onto a
+       pubsub topic at ``publish_rps``;
+    2. the **continuous trainer** (``pipeline.run_continuous``) tails
+       the topic through a ``StreamingSource`` + ``SpanStream``,
+       training a linear model under the exactly-once span ledger with
+       an eval gate every ``eval_every`` steps — ONE transient
+       ``pubsub.poll`` fault is armed so a supervisor recovery is part
+       of the measured run, and ONE mid-run gate is poisoned (the eval
+       returns a regressed metric) to force an automatic rollback: that
+       candidate must never reach the fleet;
+    3. passing candidates are pushed to the model registry and rolled
+       into an **in-process serving fleet** (breaker-judged canary +
+       capacity-neutral shift);
+    4. closed-loop **clients** hammer the router throughout; the
+       cutover blip is the longest gap between consecutive successful
+       completions while any rollout ran, and the tier asserts zero
+       client-visible errors in its JSON.
+
+    Smoke: short topic, 2 full eval gates, the forced rollback, same
+    code path end to end.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from hops_tpu.featurestore.loader import StreamingSource
+    from hops_tpu.messaging import pubsub
+    from hops_tpu.modelrepo import fleet, registry, serving
+    from hops_tpu.pipeline import continuous as cont
+    from hops_tpu.runtime import config as rtconfig
+    from hops_tpu.runtime import faultinject
+    from hops_tpu.runtime.preemption import PreemptionGuard
+    from hops_tpu.runtime.resilience import RetryPolicy
+
+    if smoke:
+        records, publish_rps = 240, 40.0
+        min_records, eval_every = 8, 5
+        clients, work_ms = 2, 1.0
+    steps_total = records // min_records
+
+    tmp = Path(tempfile.mkdtemp(prefix="hops_tpu_contbench_"))
+    rtconfig.configure(workspace=str(tmp / "ws"), project="bench")
+    try:
+        topic = "contbench-train"
+        pubsub.create_topic(topic)
+
+        # -- model artifact: the served predictor bakes in the trained
+        # weights, so every published version is distinguishable.
+        def export_version(state, step, metric):
+            art = tmp / f"art_{step}"
+            art.mkdir()
+            w = [float(v) for v in state["w"]]
+            (art / "p.py").write_text(
+                "import threading, time\n"
+                f"_W = {w!r}\n"
+                "class Predict:\n"
+                "    def __init__(self):\n"
+                "        self._lock = threading.Lock()\n"
+                "    def predict(self, instances):\n"
+                "        with self._lock:\n"
+                f"            time.sleep({work_ms / 1e3})\n"
+                "        return [[sum(wi * xi for wi, xi in zip(_W, v)),\n"
+                f"                 {step}] for v in instances]\n"
+            )
+            return registry.export(art, "contbench",
+                                   metrics={"eval": metric, "step": step})
+
+        # v1 (untrained) so the fleet has something to serve from t=0.
+        meta0 = export_version({"w": np.zeros(4)}, 0, 0.0)
+        serving.create_or_update("contbench", model_name="contbench",
+                                 model_version=meta0["version"],
+                                 model_server="PYTHON")
+
+        # -- producer ---------------------------------------------------------
+        def produce():
+            prod = pubsub.Producer(topic)
+            rs = np.random.RandomState(0)
+            t0 = time.perf_counter()
+            for i in range(records):
+                target = t0 + (i + 1) / publish_rps
+                lag = target - time.perf_counter()
+                if lag > 0:
+                    time.sleep(lag)
+                prod.send({"x": [float(v) for v in rs.rand(4)], "seq": i})
+
+        producer = threading.Thread(target=produce, daemon=True)
+
+        # -- trainer + gate ---------------------------------------------------
+        def train_step(state, batch):
+            return ({"w": state["w"] + batch["x"].sum(axis=0),
+                     "n": np.asarray(state["n"] + len(batch["seq"]))},
+                    {"rows": float(len(batch["seq"]))})
+
+        gate_calls = []
+        freshness_samples: list[float] = []
+
+        errors = [0]
+        done_log: list[float] = []
+        done_lock = threading.Lock()
+        stop_load = threading.Event()
+
+        def client(f):
+            while not stop_load.is_set():
+                try:
+                    f.predict([[1.0, 1.0, 1.0, 1.0]], timeout_s=30.0)
+                    with done_lock:
+                        done_log.append(time.perf_counter())
+                except Exception:  # noqa: BLE001 — counted, asserted zero
+                    # Under done_lock: += on a shared cell is a racy
+                    # read-modify-write, and an undercounted error
+                    # would fake the tier's zero-errors claim.
+                    with done_lock:
+                        errors[0] += 1
+
+        faultinject.arm(
+            f"pubsub.poll=error:OSError@times=1,after={min_records * 2}")
+        rollout_windows: list[tuple[float, float]] = []
+
+        with fleet.start_fleet("contbench", 2, inprocess=True,
+                               scrape_interval_s=0.05) as f:
+            threads = [threading.Thread(target=client, args=(f,), daemon=True)
+                       for _ in range(clients)]
+            for t in threads:
+                t.start()
+            producer.start()
+
+            class _TimedFleet:
+                """Fleet facade recording each rollout's wall window so
+                the blip is measured only where a blip could occur."""
+
+                def roll_out(self, version, **kw):
+                    t0 = time.perf_counter()
+                    try:
+                        return f.roll_out(version, canary_requests=2,
+                                          canary_window_s=5.0, **kw)
+                    finally:
+                        rollout_windows.append((t0, time.perf_counter()))
+
+            publisher = cont.RegistryFleetPublisher(
+                "contbench", export_version, fleet=_TimedFleet())
+            src = StreamingSource(topic, group="contbench-trainer",
+                                  from_beginning=True, name="contbench")
+
+            def eval_fn(state):
+                # Sampled at the gate = right after a segment drained:
+                # the steady-state freshness of what training has seen.
+                freshness_samples.append(src.watermark_lag_s())
+                gate_calls.append(1)
+                if len(gate_calls) == 2:  # the poisoned candidate
+                    return -1.0
+                return float(state["n"])  # monotone: honest gates pass
+
+            stream = cont.SpanStream(
+                src, tmp / "ck", collate=cont.collate_column_batch(
+                    ["x", "seq"]),
+                min_records=min_records, max_records=min_records,
+                eval_every=eval_every, stop_on_idle=True, idle_grace_s=1.0)
+            t_train0 = time.perf_counter()
+            res = cont.run_continuous(
+                train_step, {"w": np.zeros(4), "n": np.asarray(0)}, stream,
+                directory=str(tmp / "ck"), eval_fn=eval_fn,
+                save_every=max(2, eval_every // 2),
+                max_recoveries=3,
+                recovery_policy=RetryPolicy(base_delay_s=0.01, seed=0),
+                publisher=publisher, guard=PreemptionGuard(install=False))
+            train_s = time.perf_counter() - t_train0
+            faultinject.disarm()
+            freshness_lag_s = float(np.median(freshness_samples)) \
+                if freshness_samples else 0.0
+            time.sleep(0.2)
+            stop_load.set()
+            for t in threads:
+                t.join(timeout=10)
+        producer.join(timeout=10)
+
+        blip_ms = 0.0
+        with done_lock:
+            done_sorted = sorted(done_log)
+        for t0, t1 in rollout_windows:
+            window = [t for t in done_sorted if t0 - 0.5 <= t <= t1 + 0.5]
+            for a, b in zip(window, window[1:]):
+                blip_ms = max(blip_ms, (b - a) * 1e3)
+        gate_latency_ms = (
+            float(np.mean([g["latency_s"] for g in res.gates])) * 1e3
+            if res.gates else 0.0)
+        failed_gates = [g for g in res.gates if g["outcome"] == "fail"]
+        return {
+            "spans_per_sec": round(res.ledger["entries"] / train_s, 2),
+            "records_per_sec": round(res.ledger["records"] / train_s, 1),
+            "steps": res.steps,
+            "steps_expected": steps_total,
+            "records_trained": res.ledger["records"],
+            "records_published": records,
+            "ledger_entries": res.ledger["entries"],
+            "ledger_contiguous": bool(
+                res.ledger["contiguous"] and res.ledger["disjoint"]),
+            "freshness_lag_s": round(freshness_lag_s, 3),
+            "eval_gates": len(res.gates),
+            "eval_gate_rollbacks": len(failed_gates),
+            "eval_gate_latency_ms": round(gate_latency_ms, 3),
+            "cutovers_completed": sum(
+                1 for c in res.cutovers if c["outcome"] == "completed"),
+            "cutover_blip_ms": round(blip_ms, 1),
+            "recoveries": res.recoveries,
+            "client_requests": len(done_sorted),
+            "client_errors": int(errors[0]),
+        }
+    finally:
+        faultinject.disarm()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_hot_path_bench(smoke: bool = False) -> dict:
     """The ``--hot-path`` micro tier: per-operation costs of the four
     serving hot-path layers this round attacked, measured as tight
@@ -1033,6 +1260,24 @@ def run_hot_path_bench(smoke: bool = False) -> dict:
     sqlite_ns = time_backend("sqlite")
     native_ns = time_backend("native") if native_kv.available() else None
 
+    # -- 2b. multi-get row decode: per-key json.loads vs one batched
+    # array parse (the remaining Python-side per-key cost after the
+    # native backend took the lookup itself to ~10us) ----------------------
+    raw_rows = [
+        json.dumps({"id": int(i), "v": float(i) / 3.0, "name": f"row-{i}"})
+        for i in range(64)
+    ]
+    decode_reps = max(1, iters // 40)
+    t0 = time.perf_counter()
+    for _ in range(decode_reps):
+        _ = [json.loads(r) for r in raw_rows]
+    per_key_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(decode_reps):
+        _ = online._decode_rows(raw_rows)
+    batched_s = time.perf_counter() - t0
+    decode_keys = decode_reps * len(raw_rows)
+
     # -- 3. KV quantize/dequantize per cache block --------------------------
     from hops_tpu.ops.attention import dequantize_kv, quantize_kv
 
@@ -1080,6 +1325,12 @@ def run_hot_path_bench(smoke: bool = False) -> dict:
             round(native_ns, 1) if native_ns is not None else None),
         "online_native_speedup": (
             round(sqlite_ns / native_ns, 2) if native_ns else None),
+        "online_row_decode_per_key_ns": round(
+            per_key_s / decode_keys * 1e9, 1),
+        "online_row_decode_batched_ns": round(
+            batched_s / decode_keys * 1e9, 1),
+        "online_row_decode_speedup": round(
+            per_key_s / max(batched_s, 1e-12), 2),
         "kv_quant_ns_per_block": round(quant_ns_block, 1),
         "kv_dequant_ns_per_block": round(dequant_ns_block, 1),
         "assembly_reuse_hit_rate": round(hit_rate, 4),
@@ -1759,6 +2010,16 @@ def main() -> None:
         "rollout blip; host-only (no accelerator, no relay lock)",
     )
     parser.add_argument(
+        "--continuous-loop", action="store_true",
+        help="continuous-training tier: pubsub topic -> streaming "
+        "trainer under the exactly-once span ledger -> eval gate -> "
+        "registry push -> breaker-judged fleet rollout, with client "
+        "load throughout, one injected transient broker fault, and one "
+        "poisoned eval gate (forced rollback); reports spans/s "
+        "trained, freshness lag, eval-gate latency, cutover blip, and "
+        "recovery counts; host-only (JAX pinned to CPU, no relay lock)",
+    )
+    parser.add_argument(
         "--fault-overhead", action="store_true",
         help="measure the DISARMED faultinject.fire() cost on the hot "
         "paths (ns/call vs an empty loop); host-only, guards the "
@@ -1901,6 +2162,21 @@ def main() -> None:
             "metric": "workload_replay_requests_per_sec",
             "value": result["replayed"]["rps"],
             "unit": "req/s",
+            **result,
+        }))
+        return
+
+    if args.continuous_loop:
+        # Host-side loop, but the checkpoint layer initializes a JAX
+        # backend — pin it to CPU so this tier never touches an
+        # accelerator (and needs no relay lock).
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _note("continuous-loop bench: stream -> train -> gate -> cutover")
+        result = run_continuous_loop_bench(smoke=args.smoke)
+        print(json.dumps({
+            "metric": "continuous_loop_spans_per_sec",
+            "value": result["spans_per_sec"],
+            "unit": "spans/s",
             **result,
         }))
         return

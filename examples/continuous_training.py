@@ -1,0 +1,109 @@
+"""The platform's closed loop, end to end in one process.
+
+PAPER.md's L3→L4 spine (Kafka → experiment → model repo → serving) as
+a continuously-running system: a producer streams training rows onto a
+pubsub topic; the continuous trainer (``hops_tpu.pipeline``) tails it
+through a ``StreamingSource``, trains each span exactly once under the
+span ledger, gates every ``eval_every`` steps on a held-out eval, and
+pushes passing candidates into the model registry — where a serving
+fleet would pick them up via the breaker-judged rollout
+(tests/test_continuous.py and ``bench.py --continuous-loop`` run that
+full serving leg; this example keeps to the training half so it stays
+seconds-fast).
+
+One gate is deliberately poisoned: the regressed candidate is held
+back (that IS the rollback — the incumbent keeps serving), visible in
+the returned gate history and on the flight recorder's ``eval_gate``
+events.
+
+Run: python examples/continuous_training.py
+"""
+
+from __future__ import annotations
+
+import tempfile
+
+
+def main(records: int = 48, span_records: int = 6, eval_every: int = 3) -> dict:
+    import numpy as np
+
+    from hops_tpu.featurestore.loader import StreamingSource
+    from hops_tpu.messaging import pubsub
+    from hops_tpu.modelrepo import registry
+    from hops_tpu.pipeline import (
+        RegistryFleetPublisher,
+        SpanStream,
+        run_continuous,
+    )
+    from hops_tpu.pipeline.continuous import collate_column_batch
+    from hops_tpu.runtime import config
+    from hops_tpu.runtime.preemption import PreemptionGuard
+
+    workspace = tempfile.mkdtemp(prefix="hops_tpu_continuous_example_")
+    config.configure(workspace=workspace, project="continuous-example")
+
+    # -- L3 ingest: the "Kafka" topic ---------------------------------------
+    topic = pubsub.create_topic("training-rows")
+    producer = pubsub.Producer(topic)
+    rs = np.random.RandomState(0)
+    for i in range(records):
+        producer.send({"x": [float(v) for v in rs.rand(4)], "seq": i})
+
+    # -- the model + held-out eval ------------------------------------------
+    def train_step(state, batch):
+        return ({"w": state["w"] + batch["x"].sum(axis=0),
+                 "n": np.asarray(state["n"] + len(batch["seq"]))},
+                {"rows": float(len(batch["seq"]))})
+
+    gates = []
+
+    def eval_fn(state):
+        gates.append(1)
+        if len(gates) == 2:
+            return -1.0  # the poisoned candidate: must be held back
+        return float(state["n"])
+
+    # -- L4 publish: every passing gate becomes a registry version ----------
+    def export_fn(state, step, metric):
+        import json
+        from pathlib import Path
+
+        art = Path(workspace) / f"candidate_{step}"
+        art.mkdir()
+        (art / "weights.json").write_text(
+            json.dumps({"w": [float(v) for v in state["w"]], "step": step}))
+        return registry.export(art, "continuous-example",
+                               metrics={"eval": metric})
+
+    stream = SpanStream(
+        StreamingSource(topic, group="example-trainer", from_beginning=True),
+        f"{workspace}/checkpoints",
+        collate=collate_column_batch(["x", "seq"]),
+        min_records=span_records, max_records=span_records,
+        eval_every=eval_every, stop_on_idle=True, idle_grace_s=0.3)
+    result = run_continuous(
+        train_step, {"w": np.zeros(4), "n": np.asarray(0)}, stream,
+        directory=f"{workspace}/checkpoints", eval_fn=eval_fn, save_every=2,
+        publisher=RegistryFleetPublisher("continuous-example", export_fn),
+        guard=PreemptionGuard(install=False))
+
+    versions = registry.list_models("continuous-example")
+    summary = {
+        "steps": result.steps,
+        "records_trained": result.ledger["records"],
+        "ledger": result.ledger,
+        "gates": [(g["step"], g["outcome"]) for g in result.gates],
+        "published_versions": len(versions),
+        "held_back": sum(1 for g in result.gates if g["outcome"] == "fail"),
+    }
+    print(f"trained {summary['records_trained']} records in "
+          f"{summary['steps']} spans — ledger contiguous="
+          f"{result.ledger['contiguous']} disjoint="
+          f"{result.ledger['disjoint']}")
+    print(f"gates: {summary['gates']} -> {summary['published_versions']} "
+          f"version(s) published, {summary['held_back']} held back")
+    return summary
+
+
+if __name__ == "__main__":
+    main()

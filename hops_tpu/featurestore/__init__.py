@@ -33,6 +33,8 @@ from hops_tpu.featurestore.loader import (  # noqa: F401
     DataLoader,
     RecordIOSource,
     Source,
+    StreamingSource,
+    StreamSpan,
 )
 from hops_tpu.featurestore.online_serving import (  # noqa: F401
     FeatureJoinPredictor,
@@ -53,6 +55,8 @@ __all__ = [
     "DataLoader",
     "RecordIOSource",
     "Source",
+    "StreamingSource",
+    "StreamSpan",
     "Feature",
     "Filter",
     "Logic",

@@ -43,6 +43,7 @@ each global batch, so host shards are disjoint by construction.
 from __future__ import annotations
 
 import collections
+import dataclasses
 import threading
 import time
 from concurrent.futures import Future, ThreadPoolExecutor
@@ -52,7 +53,10 @@ from typing import Any, Callable, Iterator, Sequence
 import numpy as np
 
 from hops_tpu.runtime import faultinject
+from hops_tpu.runtime.logging import get_logger
 from hops_tpu.telemetry.metrics import REGISTRY
+
+log = get_logger(__name__)
 
 _STATE_VERSION = 1
 
@@ -181,6 +185,162 @@ class ArraySource(Source):
                 self.arrays, out,
             )
         return _tree_map(lambda a: np.take(a, idx, axis=0), self.arrays)
+
+
+@dataclasses.dataclass
+class StreamSpan:
+    """One polled span of a streaming topic: decoded examples plus the
+    byte-offset bookkeeping the exactly-once span ledger keys on."""
+
+    values: list  #: decoded example values, poison records already skipped
+    offsets: list[int]  #: per-record starting byte offset in the topic log
+    first: int  #: span start (the pre-poll byte offset; poison bytes count)
+    last: int  #: span end (exclusive byte offset — the next poll's start)
+    watermark: float  #: newest event/producer timestamp in the span
+
+    @property
+    def records(self) -> int:
+        return len(self.values)
+
+
+class StreamingSource:
+    """Unbounded pubsub-topic source for continuous training.
+
+    Where the batch sources above are random-access over a FIXED index
+    space, this tails a :mod:`~hops_tpu.messaging.pubsub` topic with a
+    durable consumer group and yields :class:`StreamSpan`s — batches of
+    decoded records annotated with their byte-offset range. The offset
+    discipline is the write-through Materializer's, inverted for
+    training: delivery is **at-least-once** (the group offset commits
+    only after the trained span is durably recorded in the checkpoint
+    sidecar ledger — see ``hops_tpu.pipeline.continuous``), and
+    convergence to **effectively-once** comes from the span ledger
+    deduping replayed offsets, not from the broker.
+
+    Telemetry: ``hops_tpu_streaming_watermark_lag_seconds{stream}``
+    (now minus the newest consumed event timestamp — the freshness of
+    what training has seen; it rises while the trainer stalls or the
+    topic idles) and ``hops_tpu_streaming_records_total{stream}``;
+    byte lag rides the consumer's own
+    ``hops_tpu_pubsub_consumer_lag{topic,group}`` gauge.
+
+    ``decode(value)`` maps one record's ``value`` payload to an example
+    (default: identity). Unparsable records were already skipped (and
+    counted) by the consumer; records whose decode RAISES are skipped
+    and counted as poison here — a poison record must stall neither the
+    stream nor the offset.
+    """
+
+    def __init__(
+        self,
+        topic: str,
+        group: str = "continuous-trainer",
+        *,
+        decode: Callable[[Any], Any] | None = None,
+        event_time: str | None = None,
+        from_beginning: bool = True,
+        name: str | None = None,
+    ):
+        from hops_tpu.messaging import pubsub
+
+        self.topic = topic
+        self.group = group
+        self.name = name or topic
+        self._consumer = pubsub.Consumer(
+            topic, group=group, from_beginning=from_beginning)
+        self._decode = decode
+        self._event_time = event_time
+        self._watermark = 0.0
+        labels = {"stream": self.name}
+        self._m_watermark = REGISTRY.gauge(
+            "hops_tpu_streaming_watermark_lag_seconds",
+            "Now minus the newest event timestamp a streaming source has "
+            "consumed — the training-side freshness twin of the online "
+            "store's materialization lag",
+            labels=("stream",)).labels(**labels)
+        self._m_records = REGISTRY.counter(
+            "hops_tpu_streaming_records_total",
+            "Records a streaming source decoded and handed to training",
+            labels=("stream",)).labels(**labels)
+        self._m_poison = REGISTRY.counter(
+            "hops_tpu_streaming_poison_decodes_total",
+            "Records whose decode raised and were skipped by a streaming "
+            "source (parse-level poison is counted by the consumer)",
+            labels=("stream",)).labels(**labels)
+
+    # -- offset discipline (the span ledger drives these) ---------------------
+
+    @property
+    def offset(self) -> int:
+        """The consumer's in-memory position (uncommitted)."""
+        return self._consumer.offset
+
+    @offset.setter
+    def offset(self, value: int) -> None:
+        self._consumer.offset = int(value)
+
+    def commit(self) -> None:
+        """Durably commit the group offset — call ONLY after the spans
+        up to :attr:`offset` are recorded in the span ledger."""
+        self._consumer.commit()
+
+    def lag(self) -> int:
+        """Topic bytes not yet consumed (0 = caught up)."""
+        return self._consumer.lag()
+
+    def watermark(self) -> float:
+        """Newest event timestamp consumed so far (0.0 = nothing yet)."""
+        return self._watermark
+
+    def watermark_lag_s(self) -> float:
+        if not self._watermark:
+            return 0.0
+        return max(0.0, time.time() - self._watermark)
+
+    # -- polling --------------------------------------------------------------
+
+    def poll_span(self, max_records: int = 256) -> StreamSpan | None:
+        """Poll the next span (None when nothing was consumed).
+        ``first`` is the PRE-poll offset and ``last`` the post-poll
+        offset, so ``[first, last)`` covers every consumed byte —
+        including parse-level poison records the consumer skipped. A
+        poll that consumed ONLY poison returns an empty span (zero
+        values, nonzero byte range) rather than None: the caller's
+        coverage bookkeeping must still see those bytes."""
+        start = self._consumer.offset
+        recs = self._consumer.poll_records(max_records)
+        if not recs and self._consumer.offset == start:
+            self._m_watermark.set(self.watermark_lag_s())
+            return None
+        first = start
+        last = self._consumer.offset
+        values: list = []
+        offsets: list[int] = []
+        for at, rec in recs:
+            value = rec.get("value")
+            ts = None
+            if self._event_time is not None and isinstance(value, dict):
+                ts = value.get(self._event_time)
+            if ts is None:
+                ts = rec.get("ts")
+            if isinstance(ts, (int, float)):
+                self._watermark = max(self._watermark, float(ts))
+            if self._decode is not None:
+                try:
+                    value = self._decode(value)
+                except Exception as e:  # noqa: BLE001 — poison must not wedge the stream
+                    self._m_poison.inc()
+                    log.warning(
+                        "stream %s: skipping record at offset %d whose "
+                        "decode raised (%s: %s)", self.name, at,
+                        type(e).__name__, e)
+                    continue
+            values.append(value)
+            offsets.append(at)
+        self._m_records.inc(len(values))
+        self._m_watermark.set(self.watermark_lag_s())
+        return StreamSpan(values=values, offsets=offsets, first=first,
+                          last=last, watermark=self._watermark)
 
 
 class RecordIOSource(Source):

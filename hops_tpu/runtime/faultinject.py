@@ -42,6 +42,11 @@ docs/operations.md "Failure handling & fault injection"):
 ``search.trial``        ``TrialDriver._run_trial``, around the train fn
 ``pubsub.publish``      ``pubsub.Producer.send`` (corrupt: mangles the
                         encoded record)
+``pubsub.poll``         ``pubsub.Consumer.poll_records``, per record
+                        (error/latency abort the poll with the offset
+                        restored — a retry re-delivers the batch;
+                        corrupt mangles the record consumer-side into
+                        a poison record, the durable topic untouched)
 ``lm_engine.dispatch``  ``LMEngine.step``, before the iteration's device
                         dispatch wave (an error fails only the in-flight
                         requests; the scheduler keeps serving)
@@ -91,6 +96,7 @@ POINTS = (
     "serving.handle",
     "search.trial",
     "pubsub.publish",
+    "pubsub.poll",
     "lm_engine.dispatch",
     "online.lookup",
     "online.materialize",

@@ -160,6 +160,18 @@ def test_preemptible_training_example():
     assert result["second"]["optimizer_steps"] == 8  # 3 restored + 5 new
 
 
+def test_continuous_training_example():
+    from examples import continuous_training
+
+    result = continuous_training.main(records=24, span_records=4,
+                                      eval_every=2)
+    assert result["records_trained"] == 24
+    assert result["ledger"]["contiguous"] and result["ledger"]["disjoint"]
+    assert result["held_back"] == 1  # the poisoned gate
+    outcomes = [o for _, o in result["gates"]]
+    assert result["published_versions"] == outcomes.count("pass")
+
+
 def test_batch_inference_example():
     from examples import batch_inference
 

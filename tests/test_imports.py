@@ -166,6 +166,19 @@ def test_workload_registered_in_drift_guard():
     assert "hops_tpu.telemetry.workload.synthesize" in names
 
 
+def test_continuous_pipeline_registered_in_drift_guard():
+    """The continuous-training loop is the integration layer over the
+    streaming source, span ledger, preemption supervisor, registry,
+    and fleet rollout; if it (or the streaming consumer surface it
+    rides) stops importing, the platform's closed loop silently
+    disappears from the sweep — pin the package and its module."""
+    names = _module_names()
+    assert "hops_tpu.pipeline" in names
+    assert "hops_tpu.pipeline.continuous" in names
+    assert "hops_tpu.messaging.pubsub" in names
+    assert "hops_tpu.featurestore.loader" in names
+
+
 @pytest.mark.parametrize("name", _module_names())
 def test_module_imports(name):
     try:

@@ -286,6 +286,30 @@ class TestShardedStore:
         s.close()
 
 
+class TestBatchedRowDecode:
+    """The multi-get row decode parses the whole batch in ONE
+    json.loads of the joined rows; malformed rows must degrade to the
+    per-row path, never silently misalign rows to keys."""
+
+    def test_batched_decode_matches_per_row(self):
+        raws = ['{"a": 1}', None, '{"b": [2, 3]}', '{"c": "x,y"}', None]
+        assert online._decode_rows(raws) == [
+            {"a": 1}, None, {"b": [2, 3]}, {"c": "x,y"}, None]
+        assert online._decode_rows([None, None]) == [None, None]
+
+    def test_malformed_row_raises_instead_of_misaligning(self):
+        # '1,2' is NOT valid JSON on its own, but joined into the batch
+        # array it parses as TWO elements — the batched path must
+        # detect the count mismatch and fall back to per-row decode,
+        # which raises at the guilty row (the pre-batching behavior)
+        # instead of serving every later row under the wrong key.
+        with pytest.raises(ValueError):
+            online._decode_rows(['{"a": 1}', "1,2", '{"b": 2}'])
+        # A row that is simply unparsable takes the same fallback.
+        with pytest.raises(ValueError):
+            online._decode_rows(['{"a": 1}', '{"broken'])
+
+
 class TestOnlineStoreConcurrency:
     """Satellite: OnlineStore.get/scan/count used to bypass the writer
     lock and race put_dataframe's batched flush on both backends."""
@@ -492,6 +516,40 @@ class TestMaterializer:
         assert daemon.drain(10.0)  # two injected faults survived with backoff
         daemon.stop()
         assert store.get({"user_id": 7}) is not None
+        store.close()
+
+    def test_drain_converges_through_fault_storm(self, workspace):
+        """A sustained online.materialize fault storm: the backoff cap
+        must hold (a 12-failure streak converges in seconds, not
+        2^12 polls), drain() still converges once the storm clears,
+        and the freshness-lag gauge falls back to ~0 — the daemon never
+        dies, nothing is lost."""
+        store = ShardedOnlineStore("users", 1, primary_key=["user_id"],
+                                   shards=2)
+        topic = pubsub.create_topic("users-updates")
+        producer = pubsub.Producer(topic)
+        for i in range(8):
+            producer.send({"user_id": i, "score": float(i)})
+        # Every poll/flush cycle fails for the first 12 passages — a
+        # storm, not a blip (the capped backoff schedule for
+        # poll_interval_s=0.01 sums to ~3.3s; an uncapped 2^k would
+        # blow the drain budget by orders of magnitude).
+        faultinject.arm("online.materialize=error:OSError@times=12")
+        daemon = Materializer(store, topic, poll_interval_s=0.01).start()
+        t0 = time.monotonic()
+        assert daemon.drain(20.0)  # converges once the faults exhaust
+        elapsed = time.monotonic() - t0
+        assert elapsed < 15.0  # the backoff cap held
+        assert daemon.alive
+        # Late rows materialize at normal cadence: the error streak
+        # reset the backoff once a cycle succeeded.
+        producer.send({"user_id": 99, "score": 9.0})
+        assert daemon.drain(10.0)
+        daemon.stop()
+        assert store.count() == 9
+        assert store.get({"user_id": 99})["score"] == 9.0
+        # Freshness fell back to ~now-watermark (rows were just sent).
+        assert 0.0 <= store.freshness_lag_s() < 10.0
         store.close()
 
 
