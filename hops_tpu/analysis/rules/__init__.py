@@ -12,6 +12,7 @@ Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 - :mod:`.naked_retry` — ``naked-retry-loop``
 - :mod:`.blocking_call` — ``blocking-call-no-deadline``
 - :mod:`.relay_json_roundtrip` — ``relay-json-roundtrip``
+- :mod:`.unbounded_priority_queue` — ``unbounded-priority-queue``
 """
 
 from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effects
@@ -25,4 +26,5 @@ from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effect
     naked_retry,
     relay_json_roundtrip,
     swallowed_exception,
+    unbounded_priority_queue,
 )

@@ -30,12 +30,26 @@ layer for the TPU build, in three pieces:
   become one multi-get).
 
 Failure semantics: lookups run under the ``online.lookup`` fault point
-with an optional per-shard-batch deadline and a circuit breaker per
-shard — a dead shard degrades to missing keys (the policy decides what
-that means), it never fails the request. The daemon runs under
+with an optional deadline and a circuit breaker per shard — a dead
+shard degrades to missing keys (the policy decides what that means),
+it never fails the request. The daemon runs under
 ``online.materialize`` and outlives transient broker/store faults with
 computed backoff; while it is down the freshness-lag gauge keeps rising
 because lag is re-derived from the stalled watermark at every lookup.
+
+Tail semantics (docs/operations.md "Tail latency & QoS"): multi-shard
+lookups FAN OUT in parallel on the store's worker pool instead of
+probing shards sequentially — one slow shard no longer eats the whole
+deadline, it eats only its own keys. A shard attempt still unanswered
+after the store's recent p95 lookup latency is HEDGED (a second
+attempt on the same reader-safe backend races it; first result wins,
+the loser is abandoned without a breaker strike — injected stalls and
+page-cache hiccups lose to the hedge, a genuinely dead shard still
+feeds its breaker via the deadline). Each attempt passes the
+``shard.lookup`` fault point keyed by shard index, so a gray
+(slow-not-dead) shard is deterministically injectable. Under brownout
+(level >= DEGRADE) the feature-join layer shrinks the deadline it
+passes here, converting slow-shard waits into served defaults.
 
 Metrics (docs/operations.md "Online feature serving"):
 ``hops_tpu_online_lookup_seconds`` / ``hops_tpu_online_join_seconds`` /
@@ -62,7 +76,7 @@ import pandas as pd
 from hops_tpu.featurestore import storage
 from hops_tpu.featurestore.online import OnlineStore, _key_of
 from hops_tpu.messaging import pubsub
-from hops_tpu.runtime import faultinject
+from hops_tpu.runtime import faultinject, qos
 from hops_tpu.runtime.checkpoint import CheckpointCorruptError, _file_sha256
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.runtime.resilience import CircuitBreaker, with_deadline
@@ -121,6 +135,11 @@ _m_evicted = REGISTRY.counter(
     "Rows deleted by a TTL eviction sweep, per store",
     labels=("store",),
 )
+_m_shard_hedges = REGISTRY.counter(
+    "hops_tpu_online_shard_hedges_total",
+    "Straggler shard lookups hedged with a second attempt, per store",
+    labels=("store",),
+)
 
 
 def _shard_of(key: str, n: int) -> int:
@@ -151,6 +170,8 @@ class ShardedOnlineStore:
         root: str | Path | None = None,
         breaker_failures: int = 5,
         breaker_reset_s: float = 5.0,
+        fanout: bool = True,
+        hedge: bool = True,
     ):
         if not primary_key:
             raise ValueError("ShardedOnlineStore needs a primary_key")
@@ -206,6 +227,14 @@ class ShardedOnlineStore:
         # One per shard: serializes upsert_rows' read-check-merge-write
         # cycle (the shard's own writer lock covers only each put).
         self._upsert_locks = [threading.Lock() for _ in range(int(shards))]
+        # Parallel fan-out + straggler hedging for multi-shard reads.
+        self.fanout = bool(fanout) and int(shards) > 1
+        self.hedge_stragglers = bool(hedge)
+        self._pool_lock = threading.Lock()
+        self._pool = None  # guarded by: self._pool_lock (lazy: many stores never multi-shard-read)
+        # Recent successful shard-lookup latencies — the hedge timer's
+        # p95 source. guarded by: self._pool_lock.
+        self._recent_lookup_s: "list[float]" = []
         self._meta_lock = threading.Lock()
         self._watermark: float | None = None  # guarded by: self._meta_lock
         # (file value, monotonic read time): the persisted watermark is
@@ -364,6 +393,12 @@ class ShardedOnlineStore:
         keys into misses (``result="error"`` on the lookup counter) —
         serving degrades to the missing-key policy instead of failing
         the request.
+
+        With multiple shards touched (and ``fanout`` on, the default),
+        shard lookups run in PARALLEL under one shared deadline, and a
+        straggler shard is hedged with a second attempt after the
+        store's recent p95 lookup latency — see the module docstring's
+        tail semantics. Single-shard batches keep the inline path.
         """
         out: list[dict | None] = [None] * len(entries)
         buckets: dict[int, list[tuple[int, list[Any]]]] = {}
@@ -373,45 +408,188 @@ class ShardedOnlineStore:
                 (pos, pk)
             )
         now = time.time()
-        for idx in sorted(buckets):
-            items = buckets[idx]
-            shard, breaker = self._shards[idx], self._breakers[idx]
-            if not breaker.allow():
-                self._m_error.inc(len(items))
-                continue
+        if self.fanout and len(buckets) > 1:
+            self._multi_get_fanout(buckets, out, now, deadline_s)
+        else:
+            for idx in sorted(buckets):
+                items = buckets[idx]
+                shard, breaker = self._shards[idx], self._breakers[idx]
+                if not breaker.allow():
+                    self._m_error.inc(len(items))
+                    continue
+                t0 = time.perf_counter()
+                try:
+                    # Chaos points: a lookup error/latency here must
+                    # surface as missing keys + breaker pressure,
+                    # never a 5xx.
+                    faultinject.fire("online.lookup")
+                    faultinject.fire("shard.lookup", key=idx)
+                    pk_lists = [pk for _, pk in items]
+                    if deadline_s is not None:
+                        rows = with_deadline(
+                            self._shard_lookup, deadline_s, shard, pk_lists,
+                            op="online.lookup",
+                        )
+                    else:
+                        rows = self._shard_lookup(shard, pk_lists)
+                except Exception as e:  # noqa: BLE001 — a dead shard degrades, never raises
+                    breaker.record_failure()
+                    self._m_error.inc(len(items))
+                    log.warning(
+                        "online store %s shard %d lookup failed: %s: %s",
+                        self.label, idx, type(e).__name__, e,
+                    )
+                    continue
+                breaker.record_success()
+                elapsed = time.perf_counter() - t0
+                self._m_lookup.observe(elapsed)
+                self._note_lookup_latency(elapsed)
+                self._fill_rows(out, items, rows, now)
+        self._observe_freshness()
+        return out
+
+    def _fill_rows(self, out: list, items: list, rows: list,
+                   now: float) -> None:
+        for (pos, _), row in zip(items, rows):
+            if row is None:
+                self._m_miss.inc()
+            elif self._expired(row, now):
+                self._m_expired.inc()
+            else:
+                self._m_hit.inc()
+                out[pos] = self._strip(row)
+
+    # -- parallel fan-out with straggler hedging ------------------------------
+
+    def _executor(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._pool_lock:
+            if self._pool is None:
+                # 2x shards: a full fan-out plus one hedge per shard
+                # can run without queueing behind each other.
+                self._pool = ThreadPoolExecutor(
+                    max_workers=min(2 * self.n_shards, 16),
+                    thread_name_prefix=f"online-{self.label}",
+                )
+            return self._pool
+
+    def _note_lookup_latency(self, seconds: float) -> None:
+        with self._pool_lock:
+            self._recent_lookup_s.append(seconds)
+            if len(self._recent_lookup_s) > 256:
+                del self._recent_lookup_s[:128]
+
+    def _hedge_delay_s(self) -> float | None:
+        """p95 of recent successful shard lookups — the straggler
+        threshold. None (no hedging) until enough history exists."""
+        with self._pool_lock:
+            window = sorted(self._recent_lookup_s[-128:])
+        if len(window) < 8:
+            return None
+        return max(window[min(len(window) - 1, int(len(window) * 0.95))],
+                   0.002)
+
+    def _multi_get_fanout(
+        self,
+        buckets: dict[int, list[tuple[int, list[Any]]]],
+        out: list,
+        now: float,
+        deadline_s: float | None,
+    ) -> None:
+        cv = threading.Condition()
+        results: dict[int, tuple[bool, Any, float]] = {}  # guarded by: cv
+
+        def attempt(idx: int, pk_lists: list) -> None:
             t0 = time.perf_counter()
             try:
-                # Chaos point: a lookup error/latency here must surface
-                # as missing keys + breaker pressure, never a 5xx.
+                # Chaos points, per ATTEMPT: `online.lookup` keeps its
+                # error-degrades contract; `shard.lookup` (keyed by
+                # shard index) is the gray-shard injection site — a
+                # latency fault stalls exactly one attempt, which the
+                # hedge races.
                 faultinject.fire("online.lookup")
-                pk_lists = [pk for _, pk in items]
-                if deadline_s is not None:
-                    rows = with_deadline(
-                        self._shard_lookup, deadline_s, shard, pk_lists,
-                        op="online.lookup",
-                    )
-                else:
-                    rows = self._shard_lookup(shard, pk_lists)
-            except Exception as e:  # noqa: BLE001 — a dead shard degrades, never raises
-                breaker.record_failure()
+                faultinject.fire("shard.lookup", key=idx)
+                rows = self._shard_lookup(self._shards[idx], pk_lists)
+                ok = True
+            except Exception as e:  # noqa: BLE001 — degrade, never raise
+                rows, ok = e, False
+            elapsed = time.perf_counter() - t0
+            with cv:
+                if idx not in results:
+                    results[idx] = (ok, rows, elapsed)
+                    cv.notify_all()
+                # else: abandoned loser (hedge raced it) — discarded,
+                # no breaker/metric effects.
+
+        pool = self._executor()
+        pending: list[int] = []
+        started = time.perf_counter()
+        for idx in sorted(buckets):
+            if not self._breakers[idx].allow():
+                self._m_error.inc(len(buckets[idx]))
+                continue
+            pool.submit(attempt, idx, [pk for _, pk in buckets[idx]])
+            pending.append(idx)
+        hedge_delay = (
+            self._hedge_delay_s() if self.hedge_stragglers else None)
+        deadline = started + deadline_s if deadline_s is not None else None
+        hedged: set[int] = set()
+        while True:
+            with cv:
+                done = set(results)
+            live = [i for i in pending if i not in done]
+            if not live:
+                break
+            now_pc = time.perf_counter()
+            if deadline is not None and now_pc >= deadline:
+                break
+            waits = [] if deadline is None else [deadline - now_pc]
+            if hedge_delay is not None:
+                not_hedged = [i for i in live if i not in hedged]
+                if not_hedged:
+                    hedge_at = started + hedge_delay
+                    if now_pc >= hedge_at:
+                        for idx in not_hedged:
+                            hedged.add(idx)
+                            _m_shard_hedges.inc(store=self.label)
+                            pool.submit(
+                                attempt, idx,
+                                [pk for _, pk in buckets[idx]])
+                        continue
+                    waits.append(hedge_at - now_pc)
+            with cv:
+                if all(i in results for i in live):
+                    continue
+                cv.wait(timeout=min(waits) if waits else None)
+        with cv:
+            settled = dict(results)
+        for idx in pending:
+            items = buckets[idx]
+            res = settled.get(idx)
+            if res is None:
+                # Deadline overrun: the shard is slow past the budget —
+                # breaker pressure plus missing keys, exactly like the
+                # sequential path's with_deadline overrun.
+                self._breakers[idx].record_failure()
+                self._m_error.inc(len(items))
+                log.warning(
+                    "online store %s shard %d lookup missed the "
+                    "%.3fs deadline (hedged=%s)",
+                    self.label, idx, deadline_s or -1.0, idx in hedged)
+                continue
+            ok, rows, elapsed = res
+            if not ok:
+                self._breakers[idx].record_failure()
                 self._m_error.inc(len(items))
                 log.warning(
                     "online store %s shard %d lookup failed: %s: %s",
-                    self.label, idx, type(e).__name__, e,
-                )
+                    self.label, idx, type(rows).__name__, rows)
                 continue
-            breaker.record_success()
-            self._m_lookup.observe(time.perf_counter() - t0)
-            for (pos, _), row in zip(items, rows):
-                if row is None:
-                    self._m_miss.inc()
-                elif self._expired(row, now):
-                    self._m_expired.inc()
-                else:
-                    self._m_hit.inc()
-                    out[pos] = self._strip(row)
-        self._observe_freshness()
-        return out
+            self._breakers[idx].record_success()
+            self._m_lookup.observe(elapsed)
+            self._note_lookup_latency(elapsed)
+            self._fill_rows(out, items, rows, now)
 
     def scan(self) -> Iterator[dict]:
         """Every live (non-expired) row across all shards."""
@@ -585,6 +763,14 @@ class ShardedOnlineStore:
         return applied
 
     def close(self) -> None:
+        with self._pool_lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            # WAIT for in-flight attempts: an abandoned hedge loser may
+            # still be inside a native (mmap) read — closing the shards
+            # under it is a segfault, not an exception. The wait is
+            # bounded by the slowest real lookup still running.
+            pool.shutdown(wait=True)
         for shard in self._shards:
             shard.close()
 
@@ -793,8 +979,12 @@ class FeatureJoinPredictor:
     ``missing`` (``default`` — substitute ``defaults[f]`` or
     ``default_value``; ``reject`` — fail the request; ``passthrough`` —
     emit None), ``defaults`` / ``default_value``, ``lookup_deadline_s``
-    (per-shard-batch budget; overruns degrade to the missing policy),
-    ``shards`` / ``ttl_s`` / ``root`` (store defaults).
+    (the multi-get budget; overruns degrade to the missing policy),
+    ``brownout_lookup_deadline_s`` (the budget while the fleet is
+    browned out — under SLO burn joins stop waiting on slow shards and
+    serve defaults; not applied under the ``reject`` policy, which
+    would turn degradation into request failures), ``shards`` /
+    ``ttl_s`` / ``root`` / ``fanout`` / ``hedge`` (store defaults).
     """
 
     def __init__(
@@ -813,6 +1003,7 @@ class FeatureJoinPredictor:
         }
         self._default_value = cfg.get("default_value", 0.0)
         self._deadline_s = cfg.get("lookup_deadline_s")
+        self._brownout_deadline_s = cfg.get("brownout_lookup_deadline_s", 0.05)
         self._groups: list[tuple[ShardedOnlineStore, list[str]]] = []
         for g in cfg["groups"]:
             store = (stores or {}).get(g["name"])
@@ -824,6 +1015,8 @@ class FeatureJoinPredictor:
                     shards=int(g.get("shards", cfg.get("shards", 4))),
                     ttl_s=g.get("ttl_s", cfg.get("ttl_s")),
                     root=cfg.get("root"),
+                    fanout=bool(g.get("fanout", cfg.get("fanout", True))),
+                    hedge=bool(g.get("hedge", cfg.get("hedge", True))),
                 )
             feats = [str(f).lower() for f in (g.get("features") or [])]
             self._groups.append((store, feats))
@@ -847,12 +1040,20 @@ class FeatureJoinPredictor:
         # Child of the request trace when one is active (the batcher
         # runs the coalesced join under the carrier request's context);
         # a no-op outside one.
+        # Brownout degrade: stop waiting on slow shards — a tight
+        # deadline turns their keys into served defaults. Never under
+        # the `reject` policy (degradation must not become failures).
+        deadline = self._deadline_s
+        if (self._missing != "reject"
+                and qos.brownout_level() >= qos.DEGRADE):
+            deadline = (self._brownout_deadline_s if deadline is None
+                        else min(deadline, self._brownout_deadline_s))
         with tracing.child_span(
             "featurestore.join",
             entities=len(entries), groups=len(self._groups),
         ):
             for store, feats in self._groups:
-                rows = store.multi_get(entries, deadline_s=self._deadline_s)
+                rows = store.multi_get(entries, deadline_s=deadline)
                 for m, row in zip(merged, rows):
                     if row is None:
                         continue

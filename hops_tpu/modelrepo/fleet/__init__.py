@@ -40,12 +40,20 @@ from hops_tpu.modelrepo.fleet.replicas import (
     ReplicaManager,
 )
 from hops_tpu.modelrepo.fleet.rollout import RolloutError, roll_out
-from hops_tpu.modelrepo.fleet.router import Router, TenantRateLimiter, TokenBucket
+from hops_tpu.modelrepo.fleet.router import (
+    EjectionPolicy,
+    HedgePolicy,
+    Router,
+    TenantRateLimiter,
+    TokenBucket,
+)
 
 __all__ = [
     "Autoscaler",
     "AutoscalePolicy",
+    "EjectionPolicy",
     "FleetSpawnError",
+    "HedgePolicy",
     "Replica",
     "ReplicaManager",
     "RolloutError",
@@ -99,11 +107,14 @@ class ServingFleet:
         return self.router.endpoint
 
     def predict(self, instances: list[Any], *, tenant: str | None = None,
+                priority: str | None = None,
                 timeout_s: float = 30.0) -> dict[str, Any]:
         """POST ``/predict`` through the router (convenience client)."""
         headers = {"Content-Type": "application/json"}
         if tenant is not None:
             headers["X-Tenant"] = tenant
+        if priority is not None:
+            headers["X-Priority"] = priority
         req = urllib.request.Request(
             f"{self.endpoint}/predict",
             data=json.dumps({"instances": instances}).encode(),

@@ -65,9 +65,17 @@ class AutoscalePolicy:
     breaches_to_scale: int = 2
     up_cooldown_s: float = 5.0
     down_cooldown_s: float = 15.0
-    #: Optional latency trigger: scale up when the router's recent p99
-    #: exceeds this (None = load-only).
+    #: Optional latency trigger: scale up when the router's p99 exceeds
+    #: this (None = load-only). The signal is SLO-driven: the router's
+    #: windowed estimate from the ``hops_tpu_fleet_latency_seconds``
+    #: histogram (``Router.histogram_p99_ms``), falling back to the
+    #: rolling-window ``recent_p99_ms`` until enough bucket data lands.
     p99_target_ms: float | None = None
+    #: An active brownout (the router's SLO-burn controller at level
+    #: >= 1) counts as an up-breach: sustained burn means the fleet is
+    #: under-provisioned, and capacity is the durable fix brownout is
+    #: buying time for.
+    scale_on_brownout: bool = True
 
     def __post_init__(self) -> None:
         if self.min_replicas < 1:
@@ -122,12 +130,15 @@ class Autoscaler:
             self._spawn_one()
             return "heal"
         load = self._load_fn()
-        p99 = self.router.recent_p99_ms() if self.router is not None else None
+        p99 = self._p99_ms()
         up_breach = False
         if load is not None and load > self.policy.target_load * self.policy.high_factor:
             up_breach = True
         if (self.policy.p99_target_ms is not None and p99 is not None
                 and p99 > self.policy.p99_target_ms):
+            up_breach = True
+        if (self.policy.scale_on_brownout
+                and getattr(self.router, "brownout_level", 0) >= 1):
             up_breach = True
         down_breach = (
             load is not None
@@ -165,6 +176,19 @@ class Autoscaler:
                 self.manager.drain(victim.rid)
             return "down"
         return None
+
+    def _p99_ms(self) -> float | None:
+        """The latency trigger's signal: the router's histogram-derived
+        windowed p99 when available (SLO truth from bucket deltas),
+        else its rolling window. Tolerates routers without the
+        histogram surface (tests drive stubs)."""
+        if self.router is None:
+            return None
+        hist = getattr(self.router, "histogram_p99_ms", None)
+        p99 = hist() if hist is not None else None
+        if p99 is None:
+            p99 = self.router.recent_p99_ms()
+        return p99
 
     def _reap_drained(self) -> str | None:
         for rep in self.manager.replicas():

@@ -31,6 +31,39 @@ Every forward passes through the ``router.forward`` fault point and an
 explicit timeout (the ``blocking-call-no-deadline`` lint rule holds
 this module to that).
 
+**Gray-failure tolerance** (docs/operations.md "Tail latency & QoS").
+Crash failures were already routed around (breakers, retries); the
+mechanisms below keep the p99 honest when a component is *slow but
+alive* — answering 200s at 20x the fleet median, which no breaker ever
+sees:
+
+- **Adaptive hedging** (:class:`HedgePolicy`): when a forward is still
+  unanswered after an adaptive timer — the median across replicas of
+  each replica's recent-latency p95, so one gray replica cannot
+  inflate the timer that defends against it — a second attempt fires
+  at the next-best replica; first response wins, the loser is
+  abandoned WITHOUT a breaker strike (slow is not down). A hard hedge
+  budget (``budget_frac``, default ≤5% of traffic, small burst) means
+  hedging can never amplify an overload into a retry storm.
+- **Outlier ejection** (:class:`EjectionPolicy`): each replica's
+  latency EWMA is compared against the median of its peers; a replica
+  answering far above the fleet (slow-but-200) is EJECTED into
+  *probation* — distinct from breaker-open: the breaker opens on
+  failures and heals on half-open successes, probation opens on
+  latency and heals only when periodic **shadow probes** (copies of
+  live requests, responses discarded) come back at fleet-normal
+  latency ``readmit_probes`` times in a row. Ejections are capped
+  (``max_ejected_frac``, never the last replica) so the detector can
+  never empty the fleet.
+- **QoS classes + brownout**: requests resolve to ``interactive`` or
+  ``batch`` (``X-Priority`` header / tenant config, header can only
+  demote — see :mod:`hops_tpu.runtime.qos`); per-class token buckets
+  gate admission, and under sustained SLO burn a
+  :class:`~hops_tpu.runtime.qos.BrownoutController` walks the fleet
+  through *degrade* (downstream layers serve defaults / shrink decode
+  budgets; forwards carry ``X-Hops-Brownout``) into *shed* (batch
+  refused at the front door) — lowest class always sheds first.
+
 **Zero-copy relay.** The forward path streams request and response
 bodies through as raw bytes: the client's body goes onto the replica
 wire unparsed, and the replica's response body returns to the client
@@ -47,18 +80,21 @@ the framing headers ``_relay_headers`` already owned.
 
 from __future__ import annotations
 
+import collections
+import dataclasses
 import json
 import math
+import statistics
 import threading
 import time
 import urllib.error
-import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
-from hops_tpu.runtime import faultinject, flight
+from hops_tpu.runtime import faultinject, flight, qos
+from hops_tpu.runtime.httpclient import HTTPPool
 from hops_tpu.runtime.logging import get_logger
-from hops_tpu.runtime.resilience import CircuitBreaker
+from hops_tpu.runtime.resilience import CircuitBreaker, with_deadline
 from hops_tpu.telemetry import export as telemetry_export
 from hops_tpu.telemetry import tracing
 from hops_tpu.telemetry import workload
@@ -92,6 +128,48 @@ _m_unrouted = REGISTRY.counter(
     "hops_tpu_fleet_unrouted_total",
     "Requests that exhausted every replica (503/5xx to the client)",
     labels=("model",),
+)
+_m_hedges = REGISTRY.counter(
+    "hops_tpu_fleet_hedges_total",
+    "Hedged forwards per endpoint and outcome (won = the hedge "
+    "answered first, lost = the primary did, denied = the hedge "
+    "budget refused to fire one)",
+    labels=("model", "outcome"),
+)
+_m_ejections = REGISTRY.counter(
+    "hops_tpu_fleet_ejections_total",
+    "Replicas ejected into latency probation (gray-failure outliers), "
+    "per endpoint",
+    labels=("model",),
+)
+_m_readmissions = REGISTRY.counter(
+    "hops_tpu_fleet_readmissions_total",
+    "Probation replicas re-admitted after healthy shadow probes, per "
+    "endpoint",
+    labels=("model",),
+)
+_m_probation = REGISTRY.gauge(
+    "hops_tpu_fleet_probation_replicas",
+    "Replicas currently in latency probation, per endpoint",
+    labels=("model",),
+)
+_m_qos_shed = REGISTRY.counter(
+    "hops_tpu_fleet_qos_shed_total",
+    "Requests refused by QoS policy, per endpoint, class, and reason "
+    "(rate = class token bucket, brownout = batch shed under SLO burn)",
+    labels=("model", "priority", "reason"),
+)
+_m_brownout = REGISTRY.gauge(
+    "hops_tpu_fleet_brownout_level",
+    "Current brownout level per endpoint (0 normal, 1 degrade, "
+    "2 shed-batch)",
+    labels=("model",),
+)
+_m_request_seconds = REGISTRY.histogram(
+    "hops_tpu_fleet_latency_seconds",
+    "Router end-to-end request latency per endpoint and QoS class "
+    "(the SLO histogram the autoscaler's p99 signal reads)",
+    labels=("model", "priority"),
 )
 
 
@@ -202,7 +280,9 @@ class TenantRateLimiter:
     def acquire(self, tenant: str) -> float:
         """0.0 = admitted, else seconds until this tenant has a token."""
         spec = self._limits.get(tenant, self._limits.get("default"))
-        if spec is None:
+        if spec is None or not spec.get("rate_rps"):
+            # No entry — or a QoS-only entry ({"priority": ...} with no
+            # rate): unlimited here, the class buckets still apply.
             return 0.0
         with self._lock:
             bucket = self._buckets.get(tenant)
@@ -230,6 +310,117 @@ class TenantRateLimiter:
         ``X-Tenant`` spray must not mint unbounded counter children in
         the registry the router itself exports."""
         return tenant if tenant in self._limits else "default"
+
+    def priority_for(self, tenant: str) -> str | None:
+        """The tenant's configured QoS class (``{"priority": "batch"}``
+        in its limit spec), or None when unconfigured — the header /
+        default resolution in :func:`hops_tpu.runtime.qos.
+        parse_priority` takes over."""
+        spec = self._limits.get(tenant, self._limits.get("default"))
+        return spec.get("priority") if spec else None
+
+
+@dataclasses.dataclass(frozen=True)
+class HedgePolicy:
+    """Adaptive request hedging (docs/operations.md "Tail latency &
+    QoS"). The budget is the safety property: hedges consume a token
+    bucket refilled at ``budget_frac`` tokens per routed request, so
+    over any window hedges stay ≤ ``budget_frac`` of traffic (plus the
+    small ``budget_burst``) — hedging can never amplify an overload."""
+
+    enabled: bool = True
+    #: Hard hedge budget as a fraction of routed requests.
+    budget_frac: float = 0.05
+    #: Tokens the budget may bank (burst headroom at cold start).
+    budget_burst: float = 5.0
+    #: Recent latency samples the fleet needs before hedging arms
+    #: (an adaptive timer from no data is a guess).
+    min_samples: int = 16
+    #: Clamp on the adaptive timer (median-across-replicas of p95s).
+    delay_floor_s: float = 0.001
+    delay_cap_s: float = 1.0
+
+    def __post_init__(self) -> None:
+        if not 0.0 < self.budget_frac <= 0.5:
+            raise ValueError("budget_frac must be in (0, 0.5]")
+        if self.delay_floor_s > self.delay_cap_s:
+            raise ValueError("delay_floor_s must be <= delay_cap_s")
+
+
+@dataclasses.dataclass(frozen=True)
+class EjectionPolicy:
+    """Gray-failure outlier ejection. A replica whose latency EWMA sits
+    above ``factor`` × the median of its peers (and above ``floor_ms``
+    absolutely, so microsecond jitter on an idle fleet never ejects) is
+    moved to probation; shadow probes re-admit it once it answers at
+    ≤ ``readmit_factor`` × the healthy median + ``readmit_slack_ms``
+    for ``readmit_probes`` consecutive probes."""
+
+    enabled: bool = True
+    factor: float = 3.0
+    floor_ms: float = 25.0
+    min_samples: int = 20
+    #: Never leave fewer than one replica, never eject more than this
+    #: fraction of the ready fleet.
+    max_ejected_frac: float = 0.5
+    probe_interval_s: float = 0.5
+    probe_timeout_s: float = 10.0
+    readmit_probes: int = 3
+    readmit_factor: float = 2.0
+    readmit_slack_ms: float = 10.0
+
+    def __post_init__(self) -> None:
+        if self.factor <= 1.0:
+            raise ValueError("ejection factor must be > 1")
+        if not 0.0 < self.max_ejected_frac < 1.0:
+            raise ValueError("max_ejected_frac must be in (0, 1)")
+        if self.readmit_probes < 1:
+            raise ValueError("readmit_probes must be >= 1")
+
+
+class _LatencyStats:
+    """Per-replica forward-latency tracker: EWMA (the ejection signal)
+    plus a recent-sample ring (the hedge timer's p95 source)."""
+
+    def __init__(self, window: int = 256, alpha: float = 0.2):
+        self._lock = threading.Lock()
+        self._ring: collections.deque[float] = collections.deque(maxlen=window)  # guarded by: self._lock
+        self._ewma: float | None = None  # guarded by: self._lock
+        self._alpha = alpha
+        self.count = 0  # guarded by: self._lock
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._ring.append(seconds)
+            self.count += 1
+            self._ewma = (
+                seconds if self._ewma is None
+                else self._alpha * seconds + (1 - self._alpha) * self._ewma
+            )
+
+    @property
+    def ewma_ms(self) -> float | None:
+        with self._lock:
+            return self._ewma * 1e3 if self._ewma is not None else None
+
+    def p95_ms(self) -> float | None:
+        with self._lock:
+            window = sorted(self._ring)
+        if not window:
+            return None
+        return window[min(len(window) - 1, int(len(window) * 0.95))] * 1e3
+
+    def sample_count(self) -> int:
+        with self._lock:
+            return self.count
+
+    def reset(self) -> None:
+        """Forget history (on readmission: the probation-era samples
+        must not immediately re-eject a healed replica)."""
+        with self._lock:
+            self._ring.clear()
+            self._ewma = None
+            self.count = 0
 
 
 class _ReplicaView:
@@ -262,6 +453,14 @@ class _ReplicaView:
         # Scraped hops_tpu_workload_capture_active: `GET /fleet`
         # reports which replica processes are capturing their streams.
         self.capture_active = 0.0
+        # Gray-failure state: forward latencies feed the EWMA/p95; a
+        # latency outlier moves to PROBATION (unroutable, distinct
+        # from breaker-open) until shadow probes heal it.
+        self.latency = _LatencyStats()
+        self.probation = False
+        self.probation_since: float | None = None
+        self.probe_oks = 0
+        self.last_probe_mono = 0.0
 
     def inflight_inc(self) -> None:
         with self._count_lock:
@@ -281,6 +480,57 @@ class _ReplicaView:
         return s
 
 
+class _HedgeRace:
+    """First-response-wins coordination between a primary forward and
+    its hedge. Attempts ``post()`` their outcome; the first *terminal*
+    one (kind ``"ok"``) becomes the winner, later posts learn they were
+    abandoned (``post`` returns False) and skip all breaker/retry
+    bookkeeping."""
+
+    def __init__(self) -> None:
+        self._cv = threading.Condition()
+        self._winner: tuple | None = None  # guarded by: self._cv
+        self._failures: list[tuple] = []  # guarded by: self._cv
+        self._launched = 0  # guarded by: self._cv
+        self._finished = 0  # guarded by: self._cv
+
+    def register_launch(self) -> None:
+        with self._cv:
+            self._launched += 1
+
+    def post(self, outcome: tuple) -> bool:
+        """Record an attempt's outcome; True = this post was LIVE (no
+        winner existed yet — its bookkeeping counts)."""
+        with self._cv:
+            self._finished += 1
+            live = self._winner is None
+            if live and outcome[0] == "ok":
+                self._winner = outcome
+            elif live and outcome[0] == "fail":
+                self._failures.append(outcome)
+            self._cv.notify_all()
+            return live
+
+    def wait(self, timeout: float | None) -> tuple | None:
+        """Block until a winner exists or every launched attempt has
+        finished (or ``timeout``); returns the winner if any."""
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._winner is not None
+                or (self._launched > 0 and self._finished >= self._launched),
+                timeout=timeout,
+            )
+            return self._winner
+
+    def settled(self) -> bool:
+        with self._cv:
+            return self._finished >= self._launched
+
+    def last_failure(self) -> tuple | None:
+        with self._cv:
+            return self._failures[-1] if self._failures else None
+
+
 class Router:
     """The fleet's front HTTP server (``POST /predict``).
 
@@ -295,11 +545,16 @@ class Router:
         manager: Any,
         *,
         rate_limits: dict[str, dict[str, float]] | None = None,
+        class_limits: dict[str, dict[str, float]] | None = None,
         scrape_interval_s: float = 0.25,
         forward_timeout_s: float = 30.0,
         max_attempts: int | None = None,
         breaker_failures: int = 3,
         breaker_reset_s: float = 5.0,
+        hedge: HedgePolicy | dict[str, Any] | None = None,
+        ejection: EjectionPolicy | dict[str, Any] | None = None,
+        brownout: qos.BrownoutPolicy | dict[str, Any] | None = None,
+        attempt_workers: int = 128,
         port: int = 0,
         clock=time.monotonic,
     ):
@@ -311,11 +566,57 @@ class Router:
         self.breaker_failures = breaker_failures
         self.breaker_reset_s = breaker_reset_s
         self.limiter = TenantRateLimiter(rate_limits, clock=clock)
+        # Per-QoS-class token buckets: a flooded batch class runs out of
+        # tokens while interactive traffic keeps flowing — the first
+        # shed-lowest-first layer, ahead of any replica capacity.
+        self._class_buckets: dict[str, TokenBucket] = {
+            cls: TokenBucket(spec["rate_rps"],
+                             spec.get("burst", spec["rate_rps"]), clock=clock)
+            for cls, spec in (class_limits or {}).items()
+            if spec.get("rate_rps")
+        }
+        if isinstance(hedge, dict):
+            hedge = HedgePolicy(**hedge)
+        self.hedge = hedge if hedge is not None else HedgePolicy(enabled=False)
+        if isinstance(ejection, dict):
+            ejection = EjectionPolicy(**ejection)
+        self.ejection = (
+            ejection if ejection is not None else EjectionPolicy(enabled=False))
+        if isinstance(brownout, dict):
+            brownout = qos.BrownoutPolicy(**brownout)
+        self._brownout = (
+            qos.BrownoutController(brownout) if brownout is not None else None)
+        self._m_brownout = _m_brownout.labels(model=self.name)
+        self._m_probation = _m_probation.labels(model=self.name)
+        # Hedge budget: tokens accrue per routed request, capped —
+        # guarded by: self._hedge_lock.
+        self._hedge_lock = threading.Lock()
+        self._hedge_tokens = self.hedge.budget_burst
+        #: Keep-alive connection pool for every router->replica hop
+        #: (forwards, hedges, scrapes, shadow probes): a hedge must not
+        #: pay a fresh handshake on top of the latency it is rescuing.
+        self.pool = HTTPPool()
+        # Worker pools for raced attempts (a thread per forward would
+        # be creation churn at request rate; lazily built because
+        # un-hedged routers never race attempts). Hedges get their OWN
+        # small pool: under a load spike that saturates the primary
+        # pool, the rescue path must not queue behind the very
+        # primaries it exists to rescue.
+        self.attempt_workers = int(attempt_workers)
+        self._attempt_pool = None  # guarded by: self._hedge_lock
+        self._hedge_pool = None  # guarded by: self._hedge_lock
         self._views_lock = threading.Lock()
         self._views: dict[str, _ReplicaView] = {}  # guarded by: self._views_lock
         self._rr = 0  # guarded by: self._views_lock
         self._lat_lock = threading.Lock()
         self._latencies: list[float] = []  # guarded by: self._lat_lock
+        # Per-QoS-class rolling windows (guarded by: self._lat_lock).
+        self._class_latencies: dict[str, list[float]] = {}
+        # Periodic bucket-count snapshots of the per-class SLO
+        # histogram; histogram_p99_ms() takes deltas against the oldest
+        # in-window snapshot.
+        self._hist_lock = threading.Lock()
+        self._hist_ring: collections.deque = collections.deque(maxlen=64)  # guarded by: self._hist_lock
         self._stop = threading.Event()
         name = self.name
         router = self
@@ -324,6 +625,11 @@ class Router:
         m_unrouted = _m_unrouted.labels(model=name)
 
         class Handler(BaseHTTPRequestHandler):
+            # Keep-alive: the pool on the other side of this server
+            # (benches, sibling services) reuses connections; every
+            # reply frames itself with an explicit Content-Length.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *args: Any) -> None:  # silence stderr spam
                 pass
 
@@ -423,6 +729,42 @@ class Router:
                         )
                         capture(429)
                         return
+                    # QoS class: tenant config is authoritative; the
+                    # untrusted header can only demote relative to it.
+                    priority = qos.parse_priority(
+                        self.headers.get(qos.PRIORITY_HEADER),
+                        router.limiter.priority_for(tenant),
+                    )
+                    # Brownout shed BEFORE the class bucket is charged:
+                    # a request that will be refused anyway must not
+                    # drain batch tokens — the bucket would sit empty
+                    # when the brownout lifts, turning recovery into a
+                    # burst of spurious 429s.
+                    if (router.brownout_level >= qos.SHED
+                            and qos.rank(priority) > 0):
+                        # Brownout shed: the lowest class yields first
+                        # so the interactive SLO survives the burn.
+                        _m_qos_shed.inc(model=name, priority=priority,
+                                        reason="brownout")
+                        self._reply(
+                            503,
+                            {"error": f"{priority} traffic shed "
+                                      "(brownout; SLO burn)"},
+                            headers={"Retry-After": "1"},
+                        )
+                        capture(503)
+                        return
+                    cwait = router._class_acquire(priority)
+                    if cwait > 0:
+                        _m_qos_shed.inc(model=name, priority=priority,
+                                        reason="rate")
+                        self._reply(
+                            429,
+                            {"error": f"{priority} class rate limited"},
+                            headers={"Retry-After": f"{math.ceil(cwait)}"},
+                        )
+                        capture(429)
+                        return
                     t0 = time.perf_counter()
                     # The trace starts (or, with an incoming
                     # `traceparent`, extends) at the fleet's front
@@ -430,8 +772,16 @@ class Router:
                     # and the chosen sampling decision rides the
                     # injected header to the replicas.
                     debug = (self.headers.get(tracing.DEBUG_HEADER) or "")
-                    relay_headers = (
-                        {tracing.DEBUG_HEADER: debug} if debug else None)
+                    # The resolved class rides every forward (replicas
+                    # must not re-derive it from the untrusted client
+                    # header); a brownout level rides too so
+                    # subprocess replicas degrade with the fleet.
+                    relay_headers = {qos.PRIORITY_HEADER: priority}
+                    if debug:
+                        relay_headers[tracing.DEBUG_HEADER] = debug
+                    lvl = router.brownout_level
+                    if lvl > 0:
+                        relay_headers[qos.BROWNOUT_HEADER] = str(lvl)
                     # An explicit timeline ask force-samples: the
                     # operator debugging a request must get the
                     # breakdown whatever the ambient sample rate.
@@ -448,15 +798,20 @@ class Router:
                             # own spans into the replica's breakdown.
                             payload = router._merge_debug(payload, tspan)
                     # Rolling window behind recent_p99_ms(): the
-                    # autoscaler's latency trigger reads this, the
-                    # histogram above is for dashboards.
-                    router.observe_latency(time.perf_counter() - t0)
+                    # autoscaler's latency trigger reads this; the
+                    # per-class SLO histogram feeds histogram_p99_ms()
+                    # and the brownout controller.
+                    dt = time.perf_counter() - t0
+                    router.observe_latency(dt, priority=priority)
+                    _m_request_seconds.observe(
+                        dt, model=name, priority=priority)
                     if code >= 500:
                         m_unrouted.inc()
                     self._reply(code, payload, headers=headers)
                     # After the write — capture must not delay the
-                    # response.
+                    # response, and neither may a shadow probe.
                     capture(code, tspan)
+                    router._maybe_shadow_probe(body, relay_headers)
                 except Exception as e:  # noqa: BLE001 — server must stay up
                     self._reply(500, {"error": f"{type(e).__name__}: {e}"})
                     # A handler crash is a client-visible 500: it
@@ -516,6 +871,11 @@ class Router:
                 self.scrape_once()
             except Exception:  # noqa: BLE001 — the scraper must survive
                 log.exception("fleet %s: scrape cycle failed", self.name)
+            try:
+                self._eject_tick()
+                self._brownout_tick()
+            except Exception:  # noqa: BLE001 — detectors must not kill the loop
+                log.exception("fleet %s: gray-failure tick failed", self.name)
 
     def scrape_once(self) -> None:
         """One pass over every routable replica's ``/metrics.json``.
@@ -561,14 +921,29 @@ class Router:
 
     def _scrape_replica(self, port: int) -> dict[str, float] | None:
         timeout = max(0.5, self.scrape_interval_s * 2)
-        try:
-            with urllib.request.urlopen(
+
+        def fetch() -> tuple[int, bytes, dict[str, str]]:
+            # Chaos point: latency here models a gray metrics path.
+            faultinject.fire("router.scrape", key=port)
+            return self.pool.request(
+                "GET",
                 f"http://127.0.0.1:{port}/metrics.json"
                 f"?families={','.join(self._SCRAPE_FAMILIES)}",
-                timeout=timeout,
-            ) as resp:
-                families = json.loads(resp.read()).get("metrics", {})
-        except (OSError, ValueError):
+                timeout_s=timeout,
+            )
+
+        try:
+            # The WHOLE fetch runs under the deadline (not just the
+            # socket): a wedged scrape path — injected or real — makes
+            # this scrape fail, the view goes stale (deprioritized by
+            # score, age surfaced on GET /fleet), and routing itself
+            # never stalls. DeadlineExceeded is a TimeoutError, which
+            # the OSError arm catches.
+            code, raw, _ = with_deadline(fetch, timeout, op="router.scrape")
+            if code != 200:
+                return None
+            families = json.loads(raw).get("metrics", {})
+        except (OSError, ValueError, RuntimeError):
             return None
 
         def gauge(family: str) -> float:
@@ -597,12 +972,13 @@ class Router:
 
     def routable(self) -> list[Any]:
         """Replicas a request may go to right now: ready, with a port,
-        breaker not open."""
+        breaker not open, not in latency probation."""
         out = []
         for rep in self.manager.replicas():
             if rep.state != "ready" or rep.port is None:
                 continue
-            if self._view(rep.rid).breaker.state == "open":
+            view = self._view(rep.rid)
+            if view.breaker.state == "open" or view.probation:
                 continue
             out.append(rep)
         return out
@@ -634,16 +1010,27 @@ class Router:
         byte-for-byte as the replica sent them. Only the router's own
         no-replica 503 is a dict (it authored it).
 
+        With hedging enabled (and latency data + budget available),
+        each attempt may race a second forward at the next-best replica
+        after the adaptive timer: first response wins, the loser is
+        abandoned — it still finishes on its own thread (latency
+        recorded: an abandoned-slow completion is exactly the gray
+        signal the ejector wants) but never strikes a breaker, never
+        counts a retry, and never double-answers the client.
+
         Tracing: each forward attempt is a ``fleet.forward`` child span
         of the caller's active trace, tagged with the replica id, the
         attempt index, and the replica breaker's state at selection
         time — so retries read as SIBLING hops under one request, and
         the ``traceparent`` injected on the wire makes the replica's
         own ``serving.request`` span a child of the hop that reached
-        it."""
+        it. Hedge attempts additionally carry ``hedge=True``."""
         attempts = self.max_attempts or max(3, len(self.manager.replicas()) + 1)
+        hedging = self.hedge.enabled
+        if hedging:
+            self._hedge_accrue()
         tried: set[str] = set()
-        last: tuple[int, dict[str, Any], dict[str, str]] | None = None
+        last: tuple[int, Any, dict[str, str]] | None = None
         for attempt in range(attempts):
             rep = self.pick(exclude=tried)
             if rep is None:
@@ -652,62 +1039,19 @@ class Router:
             view = self._view(rep.rid)
             if not view.breaker.allow():
                 continue  # raced open, or half-open probe budget spent
-            _m_forwards.inc(model=self.name, replica=rep.rid)
-            view.inflight_inc()
-            fspan = tracing.child_span(
-                "fleet.forward", replica=rep.rid, attempt=attempt,
-                breaker=view.breaker.state,
-            )
-            try:
-                with fspan:
-                    try:
-                        # Chaos point. ANY armed error class models a
-                        # transport failure on this hop (the catalog
-                        # promises a retry, and the fault grammar defaults
-                        # to RuntimeError) — only the real forward below
-                        # narrows to transport exception types.
-                        faultinject.fire("router.forward")
-                    except Exception as e:
-                        raise urllib.error.URLError(e) from e
-                    code, payload, headers = self._forward(
-                        rep.port, body, extra_headers)
-                    fspan.annotate(status=code)
-            except (OSError, urllib.error.URLError) as e:
-                # Transport failure: the replica is gone or wedged —
-                # breaker strike, retry elsewhere. The request has NOT
-                # been answered, so this retry is invisible to the
-                # client beyond latency.
-                view.breaker.record_failure()
-                _m_retries.inc(model=self.name, reason="connect")
-                flight.record("retry", op="router.forward",
-                              reason="connect", replica=rep.rid,
-                              model=self.name,
-                              error=type(getattr(e, "reason", e)).__name__)
-                continue
-            finally:
-                view.inflight_dec()
-            if code < 400:
-                view.breaker.record_success()
-                # Non-framing replica headers relay on success too —
-                # the same contract the 4xx path already kept.
+            delay = self._hedge_delay_s() if hedging else None
+            if delay is None:
+                kind, code, payload, headers = self._attempt_sync(
+                    rep, view, body, extra_headers, attempt)
+            else:
+                kind, code, payload, headers = self._attempt_hedged(
+                    rep, view, body, extra_headers, attempt, tried, delay)
+            if kind == "ok":
                 return code, payload, headers
-            if code in (429, 503):
-                # Shedding/draining: load, not failure. Don't strike
-                # the breaker; try a less-loaded replica.
-                _m_retries.inc(model=self.name, reason="shed")
-                flight.record("retry", op="router.forward", reason="shed",
-                              replica=rep.rid, model=self.name)
+            if kind == "fail":
                 last = (code, payload, headers)
-                continue
-            if code >= 500:
-                view.breaker.record_failure()
-                _m_retries.inc(model=self.name, reason="error")
-                flight.record("retry", op="router.forward", reason="error",
-                              replica=rep.rid, model=self.name, status=code)
-                last = (code, payload, headers)
-                continue
-            # 4xx: the client's request is bad everywhere — relay as-is.
-            return code, payload, headers
+            # kind == "transport": unanswered — retry invisible to the
+            # client beyond latency.
         if last is not None:
             return last
         return (
@@ -715,6 +1059,204 @@ class Router:
             {"error": f"no routable replicas for {self.name!r}"},
             {"Retry-After": "1"},
         )
+
+    # -- attempt machinery ----------------------------------------------------
+    #
+    # Outcome kinds: "ok" = terminal, relay to the client (2xx and
+    # plain 4xx alike); "fail" = answered but retryable (shed-503/429,
+    # replica 5xx) — remembered as `last`, retried elsewhere;
+    # "transport" = never answered, retried with nothing client-visible.
+
+    @staticmethod
+    def _classify(code: int) -> str:
+        if code < 400:
+            return "ok"
+        if code in (429, 503) or code >= 500:
+            return "fail"
+        return "ok"  # other 4xx: the client's request is bad everywhere
+
+    def _account_live(self, view: _ReplicaView, code: int) -> None:
+        """Breaker/retry bookkeeping for a LIVE (non-abandoned) answered
+        attempt — abandoned hedge losers never reach this."""
+        if code < 400:
+            view.breaker.record_success()
+        elif code in (429, 503):
+            # Shedding/draining: load, not failure. Don't strike the
+            # breaker; the route loop tries a less-loaded replica.
+            _m_retries.inc(model=self.name, reason="shed")
+            flight.record("retry", op="router.forward", reason="shed",
+                          replica=view.rid, model=self.name)
+        elif code >= 500:
+            view.breaker.record_failure()
+            _m_retries.inc(model=self.name, reason="error")
+            flight.record("retry", op="router.forward", reason="error",
+                          replica=view.rid, model=self.name, status=code)
+
+    def _account_transport(self, view: _ReplicaView, e: Exception) -> None:
+        view.breaker.record_failure()
+        _m_retries.inc(model=self.name, reason="connect")
+        flight.record("retry", op="router.forward",
+                      reason="connect", replica=view.rid,
+                      model=self.name,
+                      error=type(getattr(e, "reason", e)).__name__)
+
+    def _attempt_sync(
+        self, rep: Any, view: _ReplicaView, body: bytes,
+        extra_headers: dict[str, str] | None, attempt: int,
+    ) -> tuple[str, int, Any, dict[str, str]]:
+        """One un-hedged forward attempt on the caller's thread."""
+        _m_forwards.inc(model=self.name, replica=rep.rid)
+        view.inflight_inc()
+        fspan = tracing.child_span(
+            "fleet.forward", replica=rep.rid, attempt=attempt,
+            breaker=view.breaker.state,
+        )
+        t0 = time.perf_counter()
+        try:
+            with fspan:
+                try:
+                    # Chaos point. ANY armed error class models a
+                    # transport failure on this hop (the catalog
+                    # promises a retry, and the fault grammar defaults
+                    # to RuntimeError) — only the real forward below
+                    # narrows to transport exception types.
+                    faultinject.fire("router.forward")
+                except Exception as e:
+                    raise urllib.error.URLError(e) from e
+                code, payload, headers = self._forward(
+                    rep.port, body, extra_headers)
+                fspan.annotate(status=code)
+        except (OSError, urllib.error.URLError) as e:
+            # Transport failure: the replica is gone or wedged —
+            # breaker strike, retry elsewhere.
+            self._account_transport(view, e)
+            return "transport", 0, None, {}
+        finally:
+            view.inflight_dec()
+        view.latency.observe(time.perf_counter() - t0)
+        self._account_live(view, code)
+        return self._classify(code), code, payload, headers
+
+    def _attempt_hedged(
+        self, rep: Any, view: _ReplicaView, body: bytes,
+        extra_headers: dict[str, str] | None, attempt: int,
+        tried: set[str], delay: float,
+    ) -> tuple[str, int, Any, dict[str, str]]:
+        """One possibly-hedged attempt: the primary forward runs on a
+        worker thread; if it is still unanswered after ``delay`` and
+        the hedge budget allows, a second forward races it at the
+        next-best replica. First terminal response wins."""
+        race = _HedgeRace()
+        ctx = tracing.current_context()
+        self._launch_attempt(race, rep, view, body, extra_headers,
+                             attempt, ctx, role="primary")
+        if race.wait(delay) is None and not race.settled():
+            hedge_rep = self.pick(exclude=tried)
+            if hedge_rep is not None:
+                hview = self._view(hedge_rep.rid)
+                if not hview.breaker.allow():
+                    pass  # raced open; the primary stands alone
+                elif self._hedge_take():
+                    tried.add(hedge_rep.rid)
+                    flight.record("hedge", model=self.name,
+                                  replica=hedge_rep.rid, primary=rep.rid,
+                                  delay_ms=round(delay * 1e3, 2))
+                    self._launch_attempt(
+                        race, hedge_rep, hview, body, extra_headers,
+                        attempt, ctx, role="hedge")
+                else:
+                    _m_hedges.inc(model=self.name, outcome="denied")
+        winner = race.wait(None)  # bounded by forward_timeout_s per leg
+        if winner is not None:
+            return winner
+        fail = race.last_failure()
+        if fail is not None:
+            return fail
+        return "transport", 0, None, {}
+
+    def _launch_attempt(
+        self, race: "_HedgeRace", rep: Any, view: _ReplicaView,
+        body: bytes, extra_headers: dict[str, str] | None, attempt: int,
+        ctx: Any, role: str,
+    ) -> None:
+        race.register_launch()
+        _m_forwards.inc(model=self.name, replica=rep.rid)
+        # Inflight counts from the COORDINATOR thread, before the
+        # worker exists: the score must see the attempt immediately.
+        view.inflight_inc()
+
+        def run() -> None:
+            err: Exception | None = None
+            code, payload, headers = 0, None, {}
+            t0 = time.perf_counter()
+            try:
+                with tracing.use_context(ctx):
+                    fspan = tracing.child_span(
+                        "fleet.forward", replica=rep.rid, attempt=attempt,
+                        breaker=view.breaker.state, hedge=(role == "hedge"),
+                    )
+                    try:
+                        with fspan:
+                            try:
+                                faultinject.fire("router.forward")
+                            except Exception as e:
+                                raise urllib.error.URLError(e) from e
+                            code, payload, headers = self._forward(
+                                rep.port, body, extra_headers)
+                            fspan.annotate(status=code)
+                    except (OSError, urllib.error.URLError) as e:
+                        err = e
+            finally:
+                view.inflight_dec()
+            if err is None:
+                # Abandoned losers observe too: an abandoned-slow
+                # completion is exactly the gray-latency signal the
+                # ejection detector feeds on.
+                view.latency.observe(time.perf_counter() - t0)
+                outcome = (self._classify(code), code, payload, headers)
+            else:
+                outcome = ("transport", 0, None, {})
+            live = race.post(outcome)
+            if live:
+                # The race was undecided when this attempt landed: it
+                # carries normal breaker/retry semantics.
+                if err is not None:
+                    self._account_transport(view, err)
+                else:
+                    self._account_live(view, code)
+                if role == "hedge":
+                    _m_hedges.inc(
+                        model=self.name,
+                        outcome="won" if outcome[0] == "ok" else "lost")
+            else:
+                # Abandoned loser: no breaker strike, no retry counter
+                # — slow is not down, and the client was already
+                # answered by the winner.
+                if role == "hedge":
+                    _m_hedges.inc(model=self.name, outcome="lost")
+
+        self._attempt_executor(role).submit(run)
+
+    def _attempt_executor(self, role: str):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._hedge_lock:
+            if role == "hedge":
+                if self._hedge_pool is None:
+                    # Sized by the budget: hedges are <= ~5% of
+                    # traffic, so a quarter of the primary pool is
+                    # already generous headroom.
+                    self._hedge_pool = ThreadPoolExecutor(
+                        max_workers=max(8, self.attempt_workers // 4),
+                        thread_name_prefix=f"fleet-hedge-{self.name}",
+                    )
+                return self._hedge_pool
+            if self._attempt_pool is None:
+                self._attempt_pool = ThreadPoolExecutor(
+                    max_workers=self.attempt_workers,
+                    thread_name_prefix=f"fleet-attempt-{self.name}",
+                )
+            return self._attempt_pool
 
     def _forward(
         self, port: int, body: bytes,
@@ -725,26 +1267,21 @@ class Router:
         # span here is this hop's fleet.forward, so the replica's
         # serving.request parents to exactly the hop that reached it.
         tracing.inject_headers(headers)
-        req = urllib.request.Request(
+        # Persistent-connection pool: no per-hop handshake, and 4xx/5xx
+        # come back as data (the zero-copy relay treats status codes as
+        # routing input, never exceptions). Bodies stay raw bytes.
+        code, data, resp_headers = self.pool.request(
+            "POST",
             f"http://127.0.0.1:{port}/v1/models/{self.name}:predict",
-            data=body, headers=headers,
+            body=body, headers=headers, timeout_s=self.forward_timeout_s,
         )
-        try:
-            with urllib.request.urlopen(
-                req, timeout=self.forward_timeout_s
-            ) as resp:
-                # Zero-copy: the replica's body relays as raw bytes —
-                # no json.loads/json.dumps round-trip on the hot path.
-                return resp.status, resp.read(), _relayed_with_ctype(resp.headers)
-        except urllib.error.HTTPError as e:
-            body = e.read()
-            if body:
-                return e.code, body, _relayed_with_ctype(e.headers)
+        if code >= 400 and not data:
             return (
-                e.code,
-                json.dumps({"error": f"replica answered {e.code}"}).encode(),
-                _relay_headers(e.headers),
+                code,
+                json.dumps({"error": f"replica answered {code}"}).encode(),
+                _relay_headers(resp_headers),
             )
+        return code, data, _relayed_with_ctype(resp_headers)
 
     def _merge_debug(
         self, payload: dict[str, Any] | bytes, tspan: Any
@@ -780,6 +1317,270 @@ class Router:
             dbg.setdefault("trace_id", merged[0].get("trace_id"))
         return payload
 
+    # -- hedging --------------------------------------------------------------
+
+    def _class_acquire(self, priority: str) -> float:
+        bucket = self._class_buckets.get(priority)
+        return bucket.acquire() if bucket is not None else 0.0
+
+    def _hedge_accrue(self) -> None:
+        with self._hedge_lock:
+            self._hedge_tokens = min(
+                self.hedge.budget_burst,
+                self._hedge_tokens + self.hedge.budget_frac)
+
+    def _hedge_take(self) -> bool:
+        with self._hedge_lock:
+            if self._hedge_tokens >= 1.0:
+                self._hedge_tokens -= 1.0
+                return True
+            return False
+
+    def _hedge_delay_s(self) -> float | None:
+        """The adaptive hedge timer: the MEDIAN across replicas of each
+        replica's recent-latency p95, clamped to the policy bounds. The
+        median (not a merged-window p95) is what keeps one gray replica
+        from inflating the very timer that defends against it. None
+        until the fleet has ``min_samples`` observations — hedging from
+        no data is a guess."""
+        p95s: list[float] = []
+        total = 0
+        for rep in self.manager.replicas():
+            if rep.state != "ready":
+                continue
+            view = self._view(rep.rid)
+            n = view.latency.sample_count()
+            if n >= 8:
+                p = view.latency.p95_ms()
+                if p is not None:
+                    p95s.append(p)
+                    total += n
+        if not p95s or total < self.hedge.min_samples:
+            return None
+        delay = statistics.median(p95s) / 1e3
+        return min(max(delay, self.hedge.delay_floor_s),
+                   self.hedge.delay_cap_s)
+
+    # -- gray-failure ejection / probation ------------------------------------
+
+    def _healthy_median_ms(self) -> float | None:
+        """Median latency EWMA across non-probation ready replicas —
+        the reference a probe result is judged against."""
+        vals: list[float] = []
+        for rep in self.manager.replicas():
+            if rep.state != "ready" or rep.port is None:
+                continue
+            view = self._view(rep.rid)
+            if view.probation or view.latency.sample_count() < 4:
+                continue
+            e = view.latency.ewma_ms
+            if e is not None:
+                vals.append(e)
+        return statistics.median(vals) if vals else None
+
+    def _eject_tick(self) -> None:
+        """One ejection pass (scrape-loop cadence): compare every ready
+        replica's latency EWMA to the median of its PEERS (excluding
+        itself — a 2-replica fleet must still see the gray one) and
+        move outliers to probation, capped so the detector can never
+        empty the fleet."""
+        pol = self.ejection
+        if not pol.enabled:
+            return
+        ready = [r for r in self.manager.replicas()
+                 if r.state == "ready" and r.port is not None]
+        views = [self._view(r.rid) for r in ready]
+        in_probation = sum(1 for v in views if v.probation)
+        candidates = []
+        for v in views:
+            if v.probation or v.latency.sample_count() < pol.min_samples:
+                continue
+            e = v.latency.ewma_ms
+            if e is not None:
+                candidates.append((v, e))
+        if len(candidates) >= 2:
+            max_ejected = min(
+                len(views) - 1, int(len(views) * pol.max_ejected_frac))
+            for view, ewma in sorted(candidates, key=lambda t: -t[1]):
+                if in_probation >= max_ejected:
+                    break
+                peers = [e for v, e in candidates if v is not view]
+                med = statistics.median(peers)
+                if ewma > max(pol.factor * med, pol.floor_ms):
+                    view.probation = True
+                    view.probation_since = time.monotonic()
+                    view.probe_oks = 0
+                    view.last_probe_mono = 0.0
+                    in_probation += 1
+                    _m_ejections.inc(model=self.name)
+                    flight.record("replica_ejected", model=self.name,
+                                  replica=view.rid, ewma_ms=round(ewma, 1),
+                                  peer_median_ms=round(med, 1))
+                    log.warning(
+                        "fleet %s: ejected %s into latency probation "
+                        "(ewma %.1f ms vs peer median %.1f ms)",
+                        self.name, view.rid, ewma, med)
+        self._m_probation.set(
+            sum(1 for v in views if v.probation))
+
+    def _maybe_shadow_probe(
+        self, body: bytes, extra_headers: dict[str, str] | None
+    ) -> None:
+        """Probation replicas are re-judged with SHADOW traffic: a copy
+        of a live (idempotent) request, fired after the real reply went
+        out, response discarded. Probe cadence per replica is
+        ``probe_interval_s``."""
+        if not self.ejection.enabled:
+            return
+        now = time.monotonic()
+        for rep in self.manager.replicas():
+            if rep.state != "ready" or rep.port is None:
+                continue
+            view = self._view(rep.rid)
+            if not view.probation:
+                continue
+            if now - view.last_probe_mono < self.ejection.probe_interval_s:
+                continue
+            view.last_probe_mono = now
+            threading.Thread(
+                target=self._shadow_probe, args=(rep, view, body,
+                                                 extra_headers),
+                daemon=True, name=f"fleet-probe-{self.name}-{rep.rid}",
+            ).start()
+
+    def _shadow_probe(
+        self, rep: Any, view: _ReplicaView, body: bytes,
+        extra_headers: dict[str, str] | None,
+    ) -> None:
+        headers = {"Content-Type": "application/json", **(extra_headers or {})}
+        t0 = time.perf_counter()
+        try:
+            code, _, _ = self.pool.request(
+                "POST",
+                f"http://127.0.0.1:{rep.port}/v1/models/{self.name}:predict",
+                body=body, headers=headers,
+                timeout_s=self.ejection.probe_timeout_s,
+            )
+        except OSError:
+            view.probe_oks = 0  # still unreachable — stay in probation
+            return
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        view.latency.observe(dt_ms / 1e3)
+        ref = self._healthy_median_ms()
+        limit = (
+            self.ejection.readmit_factor * ref
+            + self.ejection.readmit_slack_ms
+            if ref is not None else None
+        )
+        if code < 500 and (limit is None or dt_ms <= limit):
+            view.probe_oks += 1
+        else:
+            view.probe_oks = 0
+        if view.probe_oks >= self.ejection.readmit_probes:
+            view.probation = False
+            view.probation_since = None
+            view.probe_oks = 0
+            # Forget the probation-era samples: the gray history must
+            # not immediately re-eject a healed replica.
+            view.latency.reset()
+            _m_readmissions.inc(model=self.name)
+            flight.record("replica_readmitted", model=self.name,
+                          replica=rep.rid, probe_ms=round(dt_ms, 1))
+            log.info("fleet %s: readmitted %s from probation "
+                     "(probe %.1f ms)", self.name, rep.rid, dt_ms)
+
+    # -- brownout / SLO signals -----------------------------------------------
+
+    @property
+    def brownout_level(self) -> int:
+        return self._brownout.level if self._brownout is not None else 0
+
+    def _brownout_tick(self) -> None:
+        self._hist_snapshot_tick()
+        if self._brownout is None:
+            return
+        p99 = self.histogram_p99_ms(priority=qos.PRIORITIES[0])
+        if p99 is None:
+            p99 = self.recent_p99_ms(priority=qos.PRIORITIES[0])
+        prev = self._brownout.level
+        level = self._brownout.observe(p99)
+        if level != prev:
+            flight.record(
+                "brownout", model=self.name, level=level,
+                p99_ms=None if p99 is None else round(p99, 1))
+            log.warning(
+                "fleet %s: brownout level %d -> %d (interactive p99 "
+                "%s ms vs slo %.0f)", self.name, prev, level,
+                "?" if p99 is None else f"{p99:.1f}",
+                self._brownout.policy.slo_p99_ms)
+        self._m_brownout.set(level)
+        if level > 0:
+            # Raise/refresh only; level 0 arrives by TTL expiry so one
+            # fleet's recovery never stomps another's active brownout
+            # in a shared process.
+            qos.set_brownout(
+                level, hold_s=max(1.0, 6 * self.scrape_interval_s))
+
+    def _hist_snapshot_tick(self) -> None:
+        snap = {
+            prio: _m_request_seconds.labels(
+                model=self.name, priority=prio).snapshot()
+            for prio in qos.PRIORITIES
+        }
+        with self._hist_lock:
+            self._hist_ring.append((time.monotonic(), snap))
+
+    def histogram_p99_ms(
+        self, priority: str | None = None, window_s: float = 10.0,
+        min_count: int = 20,
+    ) -> float | None:
+        """p99 estimated from the ``hops_tpu_fleet_latency_seconds``
+        histogram's bucket deltas over the recent window — the SLO
+        signal the autoscaler and the brownout controller read (None
+        until enough observations land). Linear interpolation within
+        the bucket; an overflow-bucket p99 reports the top bound (a
+        lower bound on the truth, still a breach of any target below
+        it)."""
+        with self._hist_lock:
+            ring = list(self._hist_ring)
+        now = time.monotonic()
+        base = None
+        for t, snap in ring:
+            if now - t <= window_s:
+                base = snap  # oldest snapshot still inside the window
+                break
+        prios = [priority] if priority is not None else list(qos.PRIORITIES)
+        bounds: tuple[float, ...] | None = None
+        delta: list[int] | None = None
+        total = 0
+        for prio in prios:
+            b, counts, n = _m_request_seconds.labels(
+                model=self.name, priority=prio).snapshot()
+            if base is not None and prio in base:
+                base_counts, base_n = base[prio][1], base[prio][2]
+            else:
+                base_counts, base_n = [0] * len(counts), 0
+            d = [c - bc for c, bc in zip(counts, base_counts)]
+            bounds = b
+            delta = d if delta is None else [x + y for x, y in zip(delta, d)]
+            total += n - base_n
+        if bounds is None or delta is None or total < min_count:
+            return None
+        target = 0.99 * total
+        cum = 0
+        lo = 0.0
+        for i, c in enumerate(delta):
+            hi = bounds[i] if i < len(bounds) else None
+            cum += c
+            if cum >= target:
+                if hi is None:
+                    return bounds[-1] * 1e3
+                frac = (target - (cum - c)) / c if c else 1.0
+                return (lo + frac * (hi - lo)) * 1e3
+            if hi is not None:
+                lo = hi
+        return bounds[-1] * 1e3
+
     # -- surface --------------------------------------------------------------
 
     @property
@@ -793,17 +1594,27 @@ class Router:
     def breaker_state(self, rid: str) -> str:
         return self._view(rid).breaker.state
 
-    def observe_latency(self, seconds: float) -> None:
+    def observe_latency(self, seconds: float,
+                        priority: str | None = None) -> None:
         with self._lat_lock:
             self._latencies.append(seconds)
             if len(self._latencies) > 2048:
                 del self._latencies[:1024]
+            if priority is not None:
+                lst = self._class_latencies.setdefault(priority, [])
+                lst.append(seconds)
+                if len(lst) > 2048:
+                    del lst[:1024]
 
-    def recent_p99_ms(self) -> float | None:
-        """p99 of the most recent window of router-observed latencies
-        (the autoscaler's optional latency trigger)."""
+    def recent_p99_ms(self, priority: str | None = None) -> float | None:
+        """p99 of the most recent window of router-observed latencies,
+        optionally restricted to one QoS class (the autoscaler's
+        fallback latency trigger; the primary signal is
+        :meth:`histogram_p99_ms`)."""
         with self._lat_lock:
-            window = list(self._latencies[-512:])
+            src = (self._latencies if priority is None
+                   else self._class_latencies.get(priority, []))
+            window = list(src[-512:])
         if not window:
             return None
         window.sort()
@@ -822,6 +1633,7 @@ class Router:
         now = time.monotonic()
         for rep in self.manager.replicas():
             view = self._view(rep.rid)
+            ewma = view.latency.ewma_ms
             reps.append({
                 "rid": rep.rid,
                 "state": rep.state,
@@ -829,6 +1641,12 @@ class Router:
                 "version": getattr(rep, "version", None),
                 "score": round(view.score(), 3),
                 "breaker": view.breaker.state,
+                # Gray-failure state, DISTINCT from the breaker: a
+                # probation replica answers 200s — it is slow, not
+                # down — and heals by shadow probes, not half-open.
+                "probation": view.probation,
+                "latency_ewma_ms": (
+                    round(ewma, 2) if ewma is not None else None),
                 # How long the breaker has sat in that state, and how
                 # stale the scraped load numbers are (None = never
                 # scraped): without the ages a wedged replica whose
@@ -844,12 +1662,31 @@ class Router:
                 # process-global recorder, so these agree).
                 "capture": bool(view.capture_active),
             })
+        with self._hedge_lock:
+            hedge_tokens = self._hedge_tokens
         return {"model": self.name, "replicas": reps,
                 "ready": sum(1 for r in reps if r["state"] == "ready"),
-                "capture": workload.status()}
+                "capture": workload.status(),
+                "qos": {
+                    "brownout_level": self.brownout_level,
+                    "hedging": self.hedge.enabled,
+                    "hedge_tokens": round(hedge_tokens, 3),
+                    "ejection": self.ejection.enabled,
+                    "probation": sum(
+                        1 for r in reps if r.get("probation")),
+                }}
 
     def stop(self) -> None:
         self._stop.set()
         self._server.shutdown()
         self._server.server_close()
         self._scraper.join(timeout=5)
+        with self._hedge_lock:
+            pools = [p for p in (self._attempt_pool, self._hedge_pool)
+                     if p is not None]
+        for p in pools:
+            # In-flight abandoned losers finish against the live pool;
+            # waiting bounds teardown by the forward timeout instead of
+            # racing socket close under a worker.
+            p.shutdown(wait=True)
+        self.pool.close()

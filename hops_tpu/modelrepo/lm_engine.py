@@ -48,7 +48,7 @@ from jax.sharding import PartitionSpec as P
 
 from hops_tpu.models.generation import top_p_mask
 from hops_tpu.modelrepo.paged import BlockPool
-from hops_tpu.runtime import faultinject, flight
+from hops_tpu.runtime import faultinject, flight, qos
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.telemetry.metrics import REGISTRY
 
@@ -185,6 +185,9 @@ class _Request:
     prefix_key: str | None = None
     # monotonic submit time — the TTFT histogram's start mark.
     submitted_at: float = 0.0
+    # QoS class (interactive | batch): admission serves interactive
+    # first under the engine's starvation guard.
+    priority: str = "interactive"
     # Preemption restarts a request from scratch (deterministic
     # sampling makes the replayed stream identical); its TTFT was
     # already observed the first time around.
@@ -529,6 +532,11 @@ class LMEngine:
         self._slot_state: list[_SlotState | None] = [None] * slots
         self._results: dict[int, list[int]] = {}
         self._next_ticket = 0
+        # Priority admission: interactive requests claim free slots
+        # first, with the guard forcing a batch admission after at most
+        # `starvation_limit` consecutive interactive ones — batch makes
+        # progress under ANY sustained interactive load.
+        self._admission_guard = qos.StarvationGuard(limit=8)
 
         # --- the compiled programs (see module docstring) ---------------
         def _admit_tail(logits, variables, true_len, end_len, temp, topk,
@@ -1507,13 +1515,18 @@ class LMEngine:
         top_p: float | None = None,
         seed: int = 0,
         prefix_id: str | None = None,
+        priority: str = "interactive",
     ) -> int:
         """Enqueue a request. ``temperature=0`` is greedy; otherwise
         tokens draw from the (optionally top-k- and/or top-p-truncated)
         scaled distribution, with a key chain that depends only on ``seed``
         and token index — reproducible regardless of slot placement or
         batch company. With ``prefix_id``, ``prompt`` is the SUFFIX
-        after a prefix registered via :meth:`register_prefix`."""
+        after a prefix registered via :meth:`register_prefix`.
+        ``priority`` (``interactive`` | ``batch``): admission serves
+        interactive first, starvation-guarded (per-ticket token streams
+        are placement-independent, so priority reordering never changes
+        any request's output)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("empty prompt")
@@ -1577,6 +1590,8 @@ class LMEngine:
                 temperature=float(temperature), top_k=int(top_k or 0),
                 top_p=float(top_p or 0.0), seed=int(seed), prefix=prefix,
                 prefix_key=prefix_id, submitted_at=time.monotonic(),
+                priority=priority if priority in qos.PRIORITIES
+                else "batch",
             )
         )
         return ticket
@@ -1609,6 +1624,32 @@ class LMEngine:
             return self._fail_inflight(e)
         finally:
             self._admitting.clear()
+
+    def _promote_next_admission(self) -> None:
+        """Move the priority-admission winner to the queue head, so the
+        existing head-FIFO admission paths (dense wave build, paged
+        pool-pressure gate) stay untouched. FIFO within a class; the
+        starvation guard bounds how long batch work can be passed
+        over. No-op when one class is queued — bit-identical to plain
+        FIFO for single-class workloads.
+
+        Interaction with prefix-wave ordering (which ran just before):
+        promotion picks FIFO *within* the chosen class, so a same-class
+        prefix group stays adjacent across consecutive promotions and
+        still admits as one wave; only a guard-forced cross-class pick
+        (at most 1 in `starvation_limit` admissions) can split a wave —
+        the bounded price of batch never starving."""
+        if len(self._queue) <= 1:
+            return
+        ranks = [qos.rank(r.priority) for r in self._queue]
+        if len(set(ranks)) <= 1:
+            return
+        want = self._admission_guard.pick_rank(ranks)
+        idx = next(i for i, r in enumerate(ranks) if r == want)
+        if idx:
+            req = self._queue[idx]
+            del self._queue[idx]
+            self._queue.appendleft(req)
 
     def _order_queue_for_prefix_waves(self) -> None:
         """Prefix-aware admission ordering: stable-group the queue so
@@ -1668,6 +1709,7 @@ class LMEngine:
         wave: list[tuple[int, _Request]] = []
         for row in range(self.slots):
             if self._slot_state[row] is None and self._queue:
+                self._promote_next_admission()
                 req = self._queue.popleft()
                 self._admitting.append(req)
                 if req.prefix is not None:
@@ -2354,6 +2396,7 @@ class LMEngine:
         finished: list[int] = []
         for row in range(self.slots):
             if self._queue and self._slot_state[row] is None:
+                self._promote_next_admission()
                 if not self._admit_paged(row):
                     break  # FIFO: pool pressure queues, never reorders
         live = [

@@ -35,7 +35,7 @@ import numpy as np
 
 from hops_tpu.messaging import pubsub
 from hops_tpu.modelrepo import registry
-from hops_tpu.runtime import faultinject, flight, fs
+from hops_tpu.runtime import faultinject, flight, fs, qos
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.runtime.resilience import (
     CircuitBreaker,
@@ -239,6 +239,9 @@ class LMEnginePredictor:
         # startup; instances opt in with {"prefix_id": name}.
         for pname, ptokens in (cfg.get("prefixes") or {}).items():
             self._engine.register_prefix(pname, ptokens)
+        # Brownout degrade: under SLO burn (qos.DEGRADE+) decode
+        # budgets clamp to this — shorter answers beat shed answers.
+        self._brownout_max_new = int(cfg.get("brownout_max_new_tokens", 16))
         self._cv = threading.Condition()
         self._stopping = False  # guarded by: self._cv
         self._thread = threading.Thread(target=self._loop, daemon=True)
@@ -291,6 +294,20 @@ class LMEnginePredictor:
 
     def predict(self, instances: list[Any]) -> list[Any]:
         parsed = [self._parse(i) for i in instances]
+        # QoS: the handler's class rides the contextvar into the
+        # engine's priority admission; an active brownout shrinks
+        # decode budgets (shorter answers beat shed answers).
+        priority = qos.request_priority()
+        if qos.brownout_level() >= qos.DEGRADE:
+            for kw in parsed:
+                # .get: a bare-prompt instance parses without the key
+                # (submit() defaults it to 32) — brownout must shorten
+                # its answer, never 500 it.
+                kw["max_new_tokens"] = max(
+                    1, min(kw.get("max_new_tokens", 32),
+                           self._brownout_max_new))
+        for kw in parsed:
+            kw["priority"] = priority
         # The engine steps on ITS driver thread; attribute each
         # ticket's submit→finish window back to this request's trace
         # retroactively (with per-ticket TTFT, the queue/prefill vs
@@ -406,13 +423,19 @@ class DynamicBatcher:
     """
 
     def __init__(self, predict_fn, max_batch_size: int = 64,
-                 timeout_ms: float = 5.0, model: str = ""):
-        import queue
-
+                 timeout_ms: float = 5.0, model: str = "",
+                 queue_bound: int = 1024, starvation_limit: int = 8):
         self._predict = predict_fn
         self.max_batch_size = max_batch_size
         self.timeout_s = timeout_ms / 1e3
-        self._queue: "queue.Queue" = queue.Queue()
+        # Priority-aware and HARD-bounded (the unbounded-priority-queue
+        # lint rule's contract): interactive requests coalesce ahead of
+        # batch-class ones, FIFO within a class, batch never starves
+        # (the queue's starvation guard), and a full queue sheds the
+        # newest lowest-class item — its waiter gets qos.ShedError,
+        # which the handler answers as a 503 shed.
+        self._queue = qos.BoundedPriorityQueue(
+            queue_bound, starvation_limit=starvation_limit)
         self._stop_lock = threading.Lock()
         self._stopped = False  # guarded by: self._stop_lock
         self.batches_run = 0
@@ -443,10 +466,20 @@ class DynamicBatcher:
         # every item the queue ever holds precedes the sentinel, so the
         # loop (or its stop-time drain) resolves every future — no
         # handler can block forever on a straggler enqueued after it.
+        # (The sentinel rides the negative control lane, which get()
+        # serves first — its short-circuit drain still answers every
+        # queued item, whatever class order says.)
         with self._stop_lock:
             if self._stopped:
                 raise RuntimeError("serving stopped")
-            self._queue.put(item)
+            evicted = self._queue.put(
+                item, rank=qos.rank(qos.request_priority()))
+        if evicted is not None:
+            # Shed-lowest-first under a full queue: the evicted waiter
+            # is answered NOW (503 at the handler), not left to starve.
+            evicted[1].set_exception(
+                qos.ShedError("shed from the batch queue by "
+                              "higher-priority work"))
         self._m_queue_depth.set(self._queue.qsize())
         return fut.result()
 
@@ -455,7 +488,7 @@ class DynamicBatcher:
             if self._stopped:
                 return
             self._stopped = True
-            self._queue.put(None)
+            self._queue.put(None, rank=-1)  # control lane: served first
         self._thread.join(timeout=30)
         # The enqueue lock means nothing lands after the sentinel: once
         # the loop thread exits, every queued future has been resolved.
@@ -659,6 +692,10 @@ class _RunningServing:
         rcfg = cfg.get("resilience_config") or {}
         self.max_inflight = rcfg.get("max_inflight")
         self.deadline_s = rcfg.get("deadline_s")
+        # Shed-lowest-class-first: batch traffic stops being admitted
+        # once in-flight work crosses this fraction of max_inflight —
+        # the headroom above it is reserved for interactive requests.
+        self.batch_admit_frac = float(rcfg.get("batch_admit_frac", 0.75))
         self.breaker = CircuitBreaker(
             name=f"serving-{name}",
             failure_threshold=int(rcfg.get("breaker_failures", 5)),
@@ -682,6 +719,8 @@ class _RunningServing:
                 max_batch_size=int(bc.get("max_batch_size", 64)),
                 timeout_ms=float(bc.get("timeout_ms", 5.0)),
                 model=name,
+                queue_bound=int(bc.get("queue_bound", 1024)),
+                starvation_limit=int(bc.get("starvation_limit", 8)),
             )
         predictor = self.batcher or self.predictor
         raw_predictor = self.predictor
@@ -707,13 +746,18 @@ class _RunningServing:
         m_shed = REGISTRY.counter(
             "hops_tpu_serving_shed_total",
             "Requests shed with 503, per serving endpoint and reason "
-            "(overload | breaker | draining)",
+            "(overload | breaker | draining | qos — batch class shed "
+            "first under load or evicted from the batch queue)",
             labels=("model", "reason"),
         )
         running = self
         breaker = self.breaker
 
         class Handler(BaseHTTPRequestHandler):
+            # Keep-alive for the router's persistent-connection pool:
+            # every reply frames itself with an explicit Content-Length.
+            protocol_version = "HTTP/1.1"
+
             def log_message(self, *args: Any) -> None:  # silence stderr spam
                 pass
 
@@ -843,6 +887,15 @@ class _RunningServing:
                     # forward hop — makes this request span a child of
                     # that hop; a bare request starts a fresh trace
                     # under the tracer's sampling decision.
+                    # QoS: the fleet router stamps the RESOLVED class
+                    # on its forwards (clients of a bare endpoint may
+                    # also claim one); a relayed brownout level is
+                    # adopted with a TTL so this replica degrades with
+                    # the fleet.
+                    priority = qos.parse_priority(
+                        self.headers.get(qos.PRIORITY_HEADER))
+                    qos.note_remote_brownout(
+                        self.headers.get(qos.BROWNOUT_HEADER))
                     want_debug = (
                         self.headers.get(tracing.DEBUG_HEADER) or ""
                     ).strip().lower() == "timeline"
@@ -850,7 +903,7 @@ class _RunningServing:
                         "serving.request", headers=self.headers, model=name,
                         force_sample=want_debug)
                     self._capture_span = tspan
-                    with tspan:
+                    with tspan, qos.priority_scope(priority):
                         # Shedding BEFORE any model work — draining (stop
                         # ADMITTING, keep finishing; the admission check is
                         # atomic with the in-flight count inside _enter, so
@@ -862,24 +915,21 @@ class _RunningServing:
                         # latency, not just the excess). One 503 shape for
                         # both: clients and the fleet router share a single
                         # retry path.
-                        slot = running._enter()
+                        slot, shed_reason = running._enter(priority)
                         if slot is None:
-                            if running.draining:
-                                m_shed.inc(model=name, reason="draining")
-                                tspan.annotate(shed="draining")
-                                self._reply(
-                                    503,
-                                    {"error": "draining; endpoint is going away"},
-                                    headers={"Retry-After": "1"},
-                                )
+                            m_shed.inc(model=name, reason=shed_reason)
+                            tspan.annotate(shed=shed_reason)
+                            if shed_reason == "draining":
+                                msg = "draining; endpoint is going away"
+                            elif shed_reason == "qos":
+                                msg = ("batch traffic shed; interactive "
+                                       "headroom reserved")
                             else:
-                                m_shed.inc(model=name, reason="overload")
-                                tspan.annotate(shed="overload")
-                                self._reply(
-                                    503,
-                                    {"error": "overloaded; retry later"},
-                                    headers={"Retry-After": "1"},
-                                )
+                                msg = "overloaded; retry later"
+                            self._reply(
+                                503, {"error": msg},
+                                headers={"Retry-After": "1"},
+                            )
                             return
                         try:
                             self._predict_and_reply(
@@ -928,7 +978,10 @@ class _RunningServing:
                     # even when predict raises — error latency is
                     # latency; the error counter increments below.
                     with span("hops_tpu_serving_request", model=name):
-                        faultinject.fire("serving.handle")  # chaos point
+                        # Chaos point, keyed by this endpoint's port so
+                        # a gray (slow-not-dead) fault can target ONE
+                        # replica of an in-process fleet.
+                        faultinject.fire("serving.handle", key=running.port)
                         if running.deadline_s:
                             # The worker owns the slot from here: a
                             # deadline overrun abandons the predict but
@@ -947,6 +1000,18 @@ class _RunningServing:
                                 instances, op="serving.handle")
                         else:
                             preds = predictor.predict(instances)
+                except qos.ShedError as e:
+                    # Evicted from the batch queue by higher-priority
+                    # work: a shed, not a failure — no breaker strike,
+                    # same 503 retry shape as every other shed.
+                    m_shed.inc(model=name, reason="qos")
+                    tspan.annotate(shed="qos")
+                    self._reply(
+                        503, self._maybe_debug(
+                            {"error": f"{type(e).__name__}: {e}"}, tspan),
+                        headers={"Retry-After": "1"},
+                    )
+                    return
                 except DeadlineExceeded as e:
                     breaker.record_failure()
                     m_errors.inc()
@@ -1008,23 +1073,34 @@ class _RunningServing:
         self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
         self.thread.start()
 
-    def _enter(self) -> "_InflightSlot | None":
+    def _enter(
+        self, priority: str = "interactive"
+    ) -> "tuple[_InflightSlot | None, str | None]":
         """Admit a request unless the endpoint is draining or
         ``max_inflight`` concurrent predictor executions are already in
         flight (None = no cap). The draining check lives HERE, under
         the same lock as the count, so ``drain()``'s returned inflight
         (and ``/healthz``'s) can never miss a request that had passed
-        an earlier check but not yet been admitted. Returns a one-shot
-        slot the caller must release."""
+        an earlier check but not yet been admitted. Batch-class
+        requests stop being admitted at ``batch_admit_frac`` of the cap
+        — the lowest class sheds first, the headroom above the fraction
+        stays interactive-only. Returns ``(slot, None)`` when admitted
+        (a one-shot slot the caller must release) or ``(None, reason)``
+        — reason ``draining`` | ``qos`` | ``overload``."""
         with self._inflight_lock:
             if self._draining:
-                return None
-            if (self.max_inflight is not None
-                    and self._inflight >= self.max_inflight):
-                return None
+                return None, "draining"
+            if self.max_inflight is not None:
+                if self._inflight >= self.max_inflight:
+                    return None, "overload"
+                if (qos.rank(priority) > 0
+                        and self._inflight >= max(
+                            1, int(self.max_inflight
+                                   * self.batch_admit_frac))):
+                    return None, "qos"
             self._inflight += 1
             self._m_inflight.set(self._inflight)
-        return _InflightSlot(self)
+        return _InflightSlot(self), None
 
     def _exit(self) -> None:
         with self._inflight_lock:

@@ -136,6 +136,21 @@ class SpanLedger:
       the orphaned ``step > N`` entries (their updates are not in the
       restored state) and the spans re-train, re-appending once.
 
+    **Compaction** (:meth:`compact`): the ledger would otherwise grow
+    one line per span for the loop's lifetime. Fully-committed history
+    — entries no restorable checkpoint can ever roll back behind — is
+    folded into a single *base line* at the top of the file
+    (``{"compact": 1, first, last, records, step, entries}``): the
+    covered range, record count, and provability survive (``verify``
+    still proves contiguity ACROSS the compaction boundary: the first
+    retained entry must continue exactly at the base's ``last``), only
+    the per-span granularity of the folded prefix is given up. The
+    caller chooses the fold horizon; it must be ≤ the oldest step a
+    checkpoint restore could land on (``truncate_to_step`` below a
+    compacted base cannot un-fold — it warns loudly and keeps the
+    base, because the folded spans' updates are in every restorable
+    checkpoint by the caller's own contract).
+
     Single-writer by contract (the training loop); readers (tests,
     accounting) may open their own instance against the same file.
     """
@@ -145,6 +160,8 @@ class SpanLedger:
         self.directory.mkdir(parents=True, exist_ok=True)
         self.path = self.directory / LEDGER_FILENAME
         self._entries: list[SpanEntry] = []
+        self._base: SpanEntry | None = None  # folded history (compaction)
+        self._base_folded = 0  # entries the base line stands for
         self._load()
 
     def _load(self) -> None:
@@ -152,11 +169,22 @@ class SpanLedger:
             return
         raw = self.path.read_bytes()
         good_bytes = 0
-        for line in raw.splitlines(keepends=True):
+        for i, line in enumerate(raw.splitlines(keepends=True)):
             if not line.endswith(b"\n"):
                 break  # torn tail: the append died mid-line
             try:
                 d = json.loads(line)
+                if d.get("compact"):
+                    # The base-offset line: valid only as line 0 (it is
+                    # written only by the atomic compaction rewrite).
+                    if i != 0:
+                        break
+                    self._base = SpanEntry(
+                        first=int(d["first"]), last=int(d["last"]),
+                        records=int(d["records"]), step=int(d["step"]))
+                    self._base_folded = int(d.get("entries", 0))
+                    good_bytes += len(line)
+                    continue
                 entry = SpanEntry(first=int(d["first"]), last=int(d["last"]),
                                   records=int(d["records"]),
                                   step=int(d["step"]))
@@ -181,25 +209,39 @@ class SpanLedger:
     def entries(self) -> list[SpanEntry]:
         return list(self._entries)
 
+    @property
+    def base(self) -> SpanEntry | None:
+        """The compaction base: folded fully-committed history, or None
+        when the ledger has never been compacted."""
+        return self._base
+
     def __len__(self) -> int:
         return len(self._entries)
 
     def start_offset(self) -> int | None:
+        if self._base is not None:
+            return self._base.first
         return self._entries[0].first if self._entries else None
 
     def end_offset(self) -> int | None:
         """The exclusive end of the covered range — the offset training
         is durably caught up to (commit target)."""
-        return self._entries[-1].last if self._entries else None
+        if self._entries:
+            return self._entries[-1].last
+        return self._base.last if self._base is not None else None
 
     def covered(self, offset: int) -> bool:
         """Is a record starting at ``offset`` inside a trained span?"""
+        if (self._base is not None
+                and self._base.first <= offset < self._base.last):
+            return True
         firsts = [e.first for e in self._entries]
         i = bisect.bisect_right(firsts, offset) - 1
         return i >= 0 and offset < self._entries[i].last
 
     def records_total(self) -> int:
-        return sum(e.records for e in self._entries)
+        base = self._base.records if self._base is not None else 0
+        return base + sum(e.records for e in self._entries)
 
     # -- writes --------------------------------------------------------------
 
@@ -223,35 +265,90 @@ class SpanLedger:
             os.fsync(f.fileno())
         self._entries.extend(entries)
 
+    def _rewrite(self) -> None:
+        """Atomically rewrite the file from memory (base line first)."""
+        tmp = self.path.with_suffix(".jsonl.tmp")
+        with tmp.open("wb") as f:
+            if self._base is not None:
+                b = self._base
+                f.write(json.dumps(
+                    {"compact": 1, "first": b.first, "last": b.last,
+                     "records": b.records, "step": b.step,
+                     "entries": self._base_folded},
+                    separators=(",", ":")).encode() + b"\n")
+            for e in self._entries:
+                f.write(e.to_json().encode() + b"\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
     def truncate_to_step(self, step: int) -> int:
         """Drop entries trained after checkpoint ``step`` (their updates
         are not in the restored state and their spans will replay).
         Returns the number of entries dropped."""
+        if self._base is not None and step < self._base.step:
+            # The restore landed BEHIND compacted history. Compaction's
+            # contract (fold only steps every restorable checkpoint
+            # already contains) makes this unreachable in a correct
+            # deployment; if it happens anyway, the folded spans cannot
+            # be un-folded — keep the base, shout, and let the stream
+            # resume from its end rather than double-train the fold.
+            log.error(
+                "span ledger %s: restore at step %d is behind the "
+                "compaction base (step %d) — compacted spans cannot "
+                "replay; resuming from the base boundary",
+                self.path, step, self._base.step)
+            step = self._base.step
         keep = [e for e in self._entries if e.step <= step]
         dropped = len(self._entries) - len(keep)
         if dropped:
-            tmp = self.path.with_suffix(".jsonl.tmp")
-            with tmp.open("wb") as f:
-                for e in keep:
-                    f.write(e.to_json().encode() + b"\n")
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.path)
             self._entries = keep
+            self._rewrite()
             log.warning(
                 "span ledger %s: truncated %d entr%s past step %d — their "
                 "spans replay against the restored state",
                 self.path, dropped, "y" if dropped == 1 else "ies", step)
         return dropped
 
+    def compact(self, up_to_step: int, retain_entries: int = 8) -> int:
+        """Fold entries with ``step <= up_to_step`` into the base line
+        (always retaining the newest ``retain_entries`` for span-level
+        forensics). ``up_to_step`` MUST be at most the oldest step a
+        checkpoint restore can land on — folded spans can never be
+        truncated back out. Returns entries folded. Crash-safe: the
+        rewrite is atomic (tmp + fsync + rename), so a crash leaves
+        either the old or the new file, both self-consistent."""
+        foldable = [e for e in self._entries if e.step <= int(up_to_step)]
+        if retain_entries > 0:
+            foldable = foldable[:max(0, len(self._entries) - retain_entries)]
+        if not foldable:
+            return 0
+        first = self._base.first if self._base is not None else foldable[0].first
+        records = (self._base.records if self._base is not None else 0)
+        records += sum(e.records for e in foldable)
+        self._base = SpanEntry(
+            first=first, last=foldable[-1].last, records=records,
+            step=foldable[-1].step)
+        self._base_folded += len(foldable)
+        self._entries = self._entries[len(foldable):]
+        self._rewrite()
+        log.info(
+            "span ledger %s: compacted %d entr%s into base [%d, %d) "
+            "(%d live lines remain)",
+            self.path, len(foldable), "y" if len(foldable) == 1 else "ies",
+            self._base.first, self._base.last, len(self._entries))
+        return len(foldable)
+
     def reset(self) -> None:
         """Fresh start (step 0 with no checkpoint): nothing trained is
         durable, so nothing may stay accounted."""
-        if self._entries:
+        if self._entries or self._base is not None:
             log.warning("span ledger %s: reset discarded %d entries (fresh "
                         "start with no restorable checkpoint)", self.path,
-                        len(self._entries))
+                        len(self._entries) + self._base_folded)
         self._entries = []
+        self._base = None
+        self._base_folded = 0
         if self.path.exists():
             self.path.unlink()
 
@@ -260,11 +357,16 @@ class SpanLedger:
     def verify(self) -> dict[str, Any]:
         """The exactly-once accounting: entries must be contiguous
         (every byte of the consumed range in exactly one span),
-        disjoint (no byte twice), and step-monotonic. The chaos e2e
-        asserts this plus external coverage (every published record's
-        offset inside the range, counts matching)."""
+        disjoint (no byte twice), and step-monotonic — INCLUDING across
+        the compaction boundary: the first retained entry must continue
+        exactly at the base's end, at a step not before the base's. The
+        chaos e2e asserts this plus external coverage (every published
+        record's offset inside the range, counts matching)."""
         contiguous = disjoint = steps_monotonic = True
-        for a, b in zip(self._entries, self._entries[1:]):
+        chain = (
+            [self._base] if self._base is not None else []
+        ) + self._entries
+        for a, b in zip(chain, chain[1:]):
             if b.first != a.last:
                 contiguous = False
             if b.first < a.last:
@@ -273,6 +375,7 @@ class SpanLedger:
                 steps_monotonic = False
         return {
             "entries": len(self._entries),
+            "compacted_entries": self._base_folded,
             "records": self.records_total(),
             "start": self.start_offset(),
             "end": self.end_offset(),
@@ -328,6 +431,8 @@ class SpanStream:
         stop_when: Callable[[], bool] | None = None,
         stop_on_idle: bool = False,
         idle_grace_s: float = 1.0,
+        compact_after: int | None = 1024,
+        compact_keep_steps: int | None = None,
     ):
         if min_records < 1 or max_records < min_records:
             raise ValueError(
@@ -335,6 +440,9 @@ class SpanStream:
                 f"{min_records}/{max_records}")
         if eval_every < 1:
             raise ValueError(f"eval_every must be >= 1, got {eval_every}")
+        if compact_after is not None and compact_after < 1:
+            raise ValueError(
+                f"compact_after must be >= 1 or None, got {compact_after}")
         self.source = source
         self.ledger = SpanLedger(directory)
         self.collate = collate
@@ -346,6 +454,15 @@ class SpanStream:
         self.stop_when = stop_when
         self.stop_on_idle = stop_on_idle
         self.idle_grace_s = idle_grace_s
+        # Ledger compaction: once the ledger holds more than
+        # `compact_after` live lines, history older than
+        # `compact_keep_steps` (default: generous — 20x the checkpoint
+        # retention window of max_to_keep=3 eval segments) folds into
+        # the base line. None disables.
+        self.compact_after = compact_after
+        self.compact_keep_steps = (
+            compact_keep_steps if compact_keep_steps is not None
+            else 60 * eval_every)
         self._initial_offset = source.offset
         self._step = 0
         self._segment_end = eval_every
@@ -397,6 +514,13 @@ class SpanStream:
             self.ledger.append(self._pending)
             _m_spans.inc(len(self._pending))
             self._pending.clear()
+        if (self.compact_after is not None
+                and len(self.ledger) > self.compact_after):
+            # Fold only steps far behind anything a checkpoint restore
+            # could land on (the compaction contract): the ledger stops
+            # growing a line per span forever, exactly-once stays
+            # provable across the fold.
+            self.ledger.compact(self._step - self.compact_keep_steps)
         end = self.ledger.end_offset()
         if end is not None:
             self.source.offset = max(int(self.source.offset), end)

@@ -22,7 +22,12 @@ Modes: ``error[:ExcName]`` raises (builtin exception, default
 fault point to damage its payload (bytes) or artifact (files) — points
 that have nothing to damage ignore it. Keys: ``p`` (probability,
 default 1), ``times`` (max firings, default unlimited), ``after``
-(passages to skip first, default 0), ``seed``.
+(passages to skip first, default 0), ``seed``, and ``key`` — a
+discriminator matched against the value the fault point passes to
+``fire(point, key=...)``, so a fault can target ONE replica port or
+ONE feature shard out of many sharing a process (gray failures are
+per-component by nature; a keyed spec counts passages only for its
+key, keeping replay deterministic per component).
 
 Determinism: each spec keeps a passage counter; probabilistic firing
 draws from ``random.Random((seed, point, passage))`` — a plan replays
@@ -60,6 +65,16 @@ docs/operations.md "Failure handling & fault injection"):
                         to its chosen replica (latency delays the hop;
                         an error is treated as a replica failure and
                         the request retries on another replica)
+``router.scrape``       the router's per-replica ``/metrics.json``
+                        scrape, keyed by replica port (latency models
+                        a gray metrics path: the scrape times out, the
+                        view goes stale and the replica is
+                        deprioritized, routing never stalls)
+``shard.lookup``        one shard-lookup *attempt* inside
+                        ``ShardedOnlineStore.multi_get``'s parallel
+                        fan-out, keyed by shard index (latency models
+                        a slow-but-alive shard: the per-shard hedge
+                        and the multi-get deadline contain it)
 ``fleet.spawn``         ``ReplicaManager.spawn``, before a replica
                         worker is created (an error fails that spawn
                         attempt; autoscaler/rollout retry policies own
@@ -101,6 +116,8 @@ POINTS = (
     "online.lookup",
     "online.materialize",
     "router.forward",
+    "router.scrape",
+    "shard.lookup",
     "fleet.spawn",
 )
 
@@ -128,6 +145,10 @@ class FaultSpec:
     times: int | None = None
     after: int = 0
     seed: int = 0
+    #: Optional discriminator: the spec fires only on passages whose
+    #: ``fire(point, key=...)`` value equals it (replica port, shard
+    #: index). None matches every passage.
+    key: str | None = None
     # runtime counters — guarded by: FaultPlan._lock
     passages: int = 0
     fired: int = 0
@@ -215,6 +236,8 @@ class FaultPlan:
                     kwargs["probability"] = float(v)
                 elif k in ("times", "after", "seed"):
                     kwargs[k] = int(v)
+                elif k == "key":
+                    kwargs["key"] = v.strip()
                 else:
                     raise FaultPlanError(f"unknown fault option {k!r}")
             specs.append(FaultSpec(point=point.strip(), mode=mode.strip(),
@@ -223,13 +246,19 @@ class FaultPlan:
             raise FaultPlanError(f"no fault specs in {text!r}")
         return cls(specs)
 
-    def evaluate(self, point: str) -> list[FaultSpec]:
-        """The specs that fire on this passage of ``point``."""
+    def evaluate(self, point: str, key: str | None = None) -> list[FaultSpec]:
+        """The specs that fire on this passage of ``point``. A keyed
+        spec sees (and counts) only passages carrying its key, so its
+        ``times``/``after``/``p`` schedule replays deterministically
+        per component regardless of how other keys interleave."""
         specs = self._by_point.get(point)
         if not specs:
             return []
         with self._lock:
-            return [s for s in specs if s._should_fire()]
+            return [
+                s for s in specs
+                if (s.key is None or s.key == key) and s._should_fire()
+            ]
 
     def describe(self) -> str:
         return "; ".join(
@@ -293,14 +322,16 @@ def _apply(spec: FaultSpec, point: str) -> bool:
     return True
 
 
-def fire(point: str) -> bool:
+def fire(point: str, key: Any = None) -> bool:
     """Evaluate ``point``. Raises / sleeps per the armed plan; returns
     True when a ``corrupt`` spec fired (the site decides what that
-    means for its artifact). Disarmed: returns False immediately."""
+    means for its artifact). Disarmed: returns False immediately.
+    ``key`` names the specific component this passage belongs to
+    (replica port, shard index) for ``@key=``-scoped specs."""
     if _PLAN is None:
         return False
     corrupt = False
-    for spec in _PLAN.evaluate(point):
+    for spec in _PLAN.evaluate(point, key=None if key is None else str(key)):
         corrupt |= _apply(spec, point)
     return corrupt
 

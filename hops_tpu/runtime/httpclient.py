@@ -1,0 +1,157 @@
+"""Pooled keep-alive HTTP client for the fleet's loopback control plane.
+
+The serving stack's cross-process hops (router→replica forwards,
+``/metrics.json`` scrapes, shadow probes, hedges) all used
+``urllib.request.urlopen``, which opens and tears down a TCP connection
+per call — ~5 ms per hop pair on the CPU tier, the dominant per-hop
+cost once the relay went zero-copy (ROADMAP item 4 follow-up). This
+module is the fix: a thread-safe pool of persistent
+``http.client.HTTPConnection`` objects keyed by ``(host, port)``.
+
+Semantics the callers rely on:
+
+- **An explicit timeout on every request** (keyword-only — the fleet's
+  ``blocking-call-no-deadline`` discipline). The timeout is applied to
+  the pooled socket per request, so a connection checked out for a
+  30 s forward and later reused for a 0.5 s scrape honors each budget.
+- **Status codes are data, not exceptions.** 4xx/5xx return like 2xx
+  — exactly the router relay's contract (urllib's ``HTTPError``
+  special-casing disappears). Only transport failures raise, and they
+  raise ``OSError`` subclasses (``http.client`` protocol errors are
+  wrapped), so every existing ``except (OSError, ...)`` retry path
+  catches pool errors unchanged.
+- **Stale keep-alives retry once.** A server may close an idle pooled
+  connection at any time; a send/recv failure on a REUSED connection
+  retries once on a fresh one before surfacing. A failure on a fresh
+  connection is real and raises immediately. Requests through this
+  pool must therefore stay idempotent (predict is; scrapes are) —
+  the same contract the router's retry-elsewhere policy already set.
+- **Hedging rides the same pool**: a hedge checks out its own
+  connection, so the second attempt never pays a handshake and never
+  shares a socket with the primary.
+
+The server side of the bargain: the router and serving handlers declare
+``protocol_version = "HTTP/1.1"`` and always send Content-Length, so
+connections actually survive between requests.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+from typing import Any, Mapping
+from urllib.parse import urlsplit
+
+from hops_tpu.runtime.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class HTTPPool:
+    """Persistent-connection pool; one instance per client (the router
+    owns one). ``max_idle_per_host`` bounds parked connections per
+    ``(host, port)`` — extras close instead of parking."""
+
+    def __init__(self, max_idle_per_host: int = 8):
+        self.max_idle_per_host = max_idle_per_host
+        self._lock = threading.Lock()
+        self._idle: dict[tuple[str, int], list[http.client.HTTPConnection]] = {}  # guarded by: self._lock
+        self._closed = False  # guarded by: self._lock
+        self.reused = 0  # connections served from the pool (telemetry)
+        self.created = 0
+
+    # -- connection checkout/checkin ------------------------------------------
+
+    def _checkout(self, host: str, port: int,
+                  timeout_s: float) -> tuple[http.client.HTTPConnection, bool]:
+        with self._lock:
+            stack = self._idle.get((host, port))
+            conn = stack.pop() if stack else None
+            if conn is not None:
+                self.reused += 1
+        if conn is not None:
+            conn.timeout = timeout_s
+            if conn.sock is not None:
+                conn.sock.settimeout(timeout_s)
+            return conn, True
+        with self._lock:
+            self.created += 1
+        return http.client.HTTPConnection(host, port, timeout=timeout_s), False
+
+    def _checkin(self, host: str, port: int,
+                 conn: http.client.HTTPConnection) -> None:
+        with self._lock:
+            if not self._closed:
+                stack = self._idle.setdefault((host, port), [])
+                if len(stack) < self.max_idle_per_host:
+                    stack.append(conn)
+                    return
+        conn.close()
+
+    # -- the request ----------------------------------------------------------
+
+    def request(
+        self,
+        method: str,
+        url: str,
+        body: bytes | None = None,
+        headers: Mapping[str, str] | None = None,
+        *,
+        timeout_s: float,
+    ) -> tuple[int, bytes, dict[str, str]]:
+        """One HTTP exchange; returns ``(status, body, headers)`` with
+        4xx/5xx as data. Transport failures raise OSError subclasses."""
+        parts = urlsplit(url)
+        host = parts.hostname or "127.0.0.1"
+        port = parts.port or 80
+        path = parts.path or "/"
+        if parts.query:
+            path = f"{path}?{parts.query}"
+        last_exc: Exception | None = None
+        for fresh_retry in (False, True):
+            conn, reused = self._checkout(host, port, timeout_s)
+            try:
+                conn.request(method, path, body=body, headers=dict(headers or {}))
+                resp = conn.getresponse()
+                data = resp.read()
+                hdrs = dict(resp.headers.items())
+                if resp.will_close:
+                    conn.close()
+                else:
+                    self._checkin(host, port, conn)
+                return resp.status, data, hdrs
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                if (reused and not fresh_retry
+                        and not isinstance(e, TimeoutError)):
+                    # A parked keep-alive the server closed under us:
+                    # not a peer failure — retry once on a fresh
+                    # connection before letting the caller's retry /
+                    # breaker policy see anything. A TIMEOUT is
+                    # excluded: that is the peer being slow, and a
+                    # retry would double the caller's deadline and
+                    # re-send the request to the very peer that is
+                    # already drowning.
+                    last_exc = e
+                    continue
+                if isinstance(e, http.client.HTTPException):
+                    raise ConnectionError(
+                        f"http protocol failure talking to "
+                        f"{host}:{port}: {type(e).__name__}: {e}"
+                    ) from e
+                raise
+        raise ConnectionError(  # pragma: no cover — loop always returns/raises
+            f"connection to {host}:{port} failed: {last_exc}"
+        ) from last_exc
+
+    def idle_count(self) -> int:
+        with self._lock:
+            return sum(len(s) for s in self._idle.values())
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            conns = [c for stack in self._idle.values() for c in stack]
+            self._idle.clear()
+        for c in conns:
+            c.close()

@@ -209,6 +209,13 @@ class _HistogramChild:
         # guarded by: self._lock
         self.exemplars: dict[int, tuple[str, float, float]] | None = None
 
+    def snapshot(self) -> tuple[tuple[float, ...], list[int], int]:
+        """Consistent ``(bounds, per-bucket counts, total count)`` view
+        — quantile estimators (the fleet router's windowed p99) diff
+        two snapshots instead of reaching into the fields unlocked."""
+        with self._lock:
+            return self.bounds, list(self.counts), self.count
+
     def observe(self, value: float, exemplar: str | None = None) -> None:
         value = float(value)
         # NaN compares false against every bound (bisect would file it

@@ -1271,3 +1271,58 @@ def test_make_renders_analysis_doc_pages():
         assert rendered is not None, f"{mod} rendered no docs page"
         page, first_line = rendered
         assert first_line, f"{mod} docstring first line empty"
+
+
+# -- unbounded-priority-queue -------------------------------------------------
+
+
+def test_unbounded_priority_queue_flags_boundless_constructions(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        import queue
+        from hops_tpu.runtime import qos
+
+        pq = queue.PriorityQueue()
+        bpq = qos.BoundedPriorityQueue(maxsize=None)
+        zero = qos.BoundedPriorityQueue(0)
+        """,
+        rule="unbounded-priority-queue",
+        filename=FLEET_FILE,
+    )
+    assert rule_names(findings) == ["unbounded-priority-queue"] * 3
+
+
+def test_unbounded_priority_queue_accepts_bounds_and_config_names(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        import queue
+        from hops_tpu.runtime import qos
+
+        ok1 = queue.PriorityQueue(128)
+        ok2 = qos.BoundedPriorityQueue(1024, starvation_limit=8)
+        bound = int(cfg.get("queue_bound", 1024))
+        ok3 = qos.BoundedPriorityQueue(bound)
+        """,
+        rule="unbounded-priority-queue",
+        filename="hops_tpu/modelrepo/serving.py",
+    )
+    assert findings == []
+
+
+def test_unbounded_priority_queue_scoped_to_serving_tiers(tmp_path):
+    code = """
+    import queue
+
+    pq = queue.PriorityQueue()
+    """
+    (tmp_path / "hops_tpu" / "jobs").mkdir(parents=True)
+    (tmp_path / "hops_tpu" / "modelrepo").mkdir(parents=True)
+    assert lint_code(tmp_path, code, rule="unbounded-priority-queue",
+                     filename="hops_tpu/jobs/dag_helper.py") == []
+    flagged = lint_code(tmp_path, code, rule="unbounded-priority-queue",
+                        filename="hops_tpu/modelrepo/lm_engine.py")
+    assert rule_names(flagged) == ["unbounded-priority-queue"]

@@ -135,6 +135,92 @@ class TestSpanLedger:
         # The file itself was repaired: a third load parses cleanly.
         assert len(SpanLedger(tmp_path)) == 1
 
+    def test_compaction_folds_history_into_base(self, tmp_path):
+        led = SpanLedger(tmp_path)
+        led.append([SpanEntry(i * 100, (i + 1) * 100, 2, i)
+                    for i in range(10)])
+        folded = led.compact(up_to_step=7, retain_entries=2)
+        assert folded == 8
+        assert len(led) == 2  # live lines capped
+        assert led.base is not None
+        assert led.base.first == 0 and led.base.last == 800
+        # The account is unchanged across the fold.
+        assert led.start_offset() == 0 and led.end_offset() == 1000
+        assert led.records_total() == 20
+        assert led.covered(0) and led.covered(799) and led.covered(950)
+        assert not led.covered(1000)
+        v = led.verify()
+        assert v["contiguous"] and v["disjoint"] and v["steps_monotonic"]
+        assert v["compacted_entries"] == 8 and v["entries"] == 2
+        # Appends keep tiling from the live end.
+        led.append([SpanEntry(1000, 1100, 1, 10)])
+        assert led.verify()["contiguous"]
+
+    def test_compaction_is_durable_and_idempotent(self, tmp_path):
+        led = SpanLedger(tmp_path)
+        led.append([SpanEntry(i * 10, (i + 1) * 10, 1, i) for i in range(6)])
+        led.compact(up_to_step=3, retain_entries=0)
+        reloaded = SpanLedger(tmp_path)
+        assert reloaded.base is not None and reloaded.base.last == 40
+        assert reloaded.verify() == led.verify()
+        # A second fold merges INTO the existing base.
+        reloaded.append([SpanEntry(60, 70, 1, 6)])
+        reloaded.compact(up_to_step=6, retain_entries=0)
+        again = SpanLedger(tmp_path)
+        assert again.base.first == 0 and again.base.last == 70
+        assert again.records_total() == 7
+        assert again.verify()["contiguous"]
+        # Nothing foldable -> no-op, same file.
+        assert again.compact(up_to_step=6) == 0
+
+    def test_verify_proves_contiguity_across_the_fold_boundary(self, tmp_path):
+        led = SpanLedger(tmp_path)
+        led.append([SpanEntry(0, 100, 1, 0), SpanEntry(100, 200, 1, 1),
+                    SpanEntry(200, 300, 1, 2)])
+        led.compact(up_to_step=1, retain_entries=0)
+        assert led.verify()["contiguous"]
+        # Corrupt the boundary on disk: the retained entry no longer
+        # continues at the base's end — verify must SEE it.
+        lines = led.path.read_text().splitlines()
+        import json as _json
+        base_line = _json.loads(lines[0])
+        base_line["last"] = 150  # lie about the folded range
+        led.path.write_text(
+            _json.dumps(base_line) + "\n" + "\n".join(lines[1:]) + "\n")
+        v = SpanLedger(tmp_path).verify()
+        assert not v["contiguous"]
+
+    def test_truncate_above_base_works_below_base_clamps(self, tmp_path):
+        led = SpanLedger(tmp_path)
+        led.append([SpanEntry(i * 10, (i + 1) * 10, 1, i) for i in range(8)])
+        led.compact(up_to_step=3, retain_entries=0)  # base covers steps 0-3
+        assert led.truncate_to_step(5) == 2  # steps 6,7 drop normally
+        assert led.end_offset() == 60
+        # A restore BEHIND the fold cannot un-fold: the ledger keeps
+        # the base (shouting) and resumes from its boundary.
+        assert led.truncate_to_step(1) == 2
+        assert led.base is not None and led.end_offset() == 40
+        assert SpanLedger(tmp_path).end_offset() == 40
+
+    def test_reset_discards_the_base_too(self, tmp_path):
+        led = SpanLedger(tmp_path)
+        led.append([SpanEntry(0, 100, 1, 0), SpanEntry(100, 200, 1, 1)])
+        led.compact(up_to_step=0, retain_entries=0)
+        led.reset()
+        assert led.base is None and len(led) == 0
+        assert led.start_offset() is None
+        assert not led.path.exists()
+
+    def test_stream_auto_compacts_past_threshold(self, tmp_path):
+        led = SpanLedger(tmp_path)
+        led.append([SpanEntry(i, i + 1, 1, i) for i in range(50)])
+        led.compact(up_to_step=30, retain_entries=4)
+        # The ledger is bounded: folded history is one line, live tail
+        # stays small, and the whole account still proves out.
+        raw_lines = led.path.read_text().splitlines()
+        assert len(raw_lines) == 1 + len(led)
+        assert led.verify()["contiguous"] and led.records_total() == 50
+
     def test_verify_flags_noncontiguous_history(self, tmp_path):
         p = tmp_path / "span_ledger.jsonl"
         p.write_text(

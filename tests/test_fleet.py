@@ -1146,3 +1146,520 @@ def test_bench_serving_fleet_smoke(workspace):
     assert result["rollout_outcome"] == "completed"
     assert result["speedup_vs_single"] > 0
     assert 0 < result["balance_min_over_max"] <= 1.0
+
+
+# -- gray-failure tolerance: hedging, ejection, QoS ---------------------------
+
+
+def _mini_server(delay_s=0.0, code=200, body=b'{"predictions": [[2]]}'):
+    """A one-trick replica: sleeps, then answers. HTTP/1.1 so the
+    router's connection pool exercises its keep-alive path."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(length)
+            time.sleep(delay_s)
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+def _seed_latency(router, rid, seconds, n=10):
+    view = router._view(rid)
+    for _ in range(n):
+        view.latency.observe(seconds)
+
+
+def _wait_until(pred, timeout_s=15.0, interval_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+class TestHedging:
+    """Adaptive hedging + the hedge/abandon races: the abandoned loser
+    completing (or transport-failing) after the winner must not strike
+    a breaker, leak an inflight count, or double-answer the client —
+    and capture/workload recording sits ABOVE route(), so one request
+    stays one recorded entry no matter how many attempts raced."""
+
+    def _router(self, reps, **hedge_kw):
+        from hops_tpu.modelrepo.fleet.router import HedgePolicy
+
+        hedge_kw.setdefault("min_samples", 8)
+        hedge_kw.setdefault("budget_frac", 0.5)
+        hedge_kw.setdefault("budget_burst", 5.0)
+        r = Router(_StubManager(reps), scrape_interval_s=30.0,
+                   forward_timeout_s=5.0, hedge=HedgePolicy(**hedge_kw))
+        for rep in reps:
+            _seed_latency(r, rep.rid, 0.01)
+        return r
+
+    def test_hedge_fires_after_adaptive_delay_and_wins(self):
+        slow = _mini_server(delay_s=0.4)
+        fast = _mini_server(body=b'{"predictions": [["fast"]]}')
+        reps = [_StubRep("slow", slow.server_address[1]),
+                _StubRep("fast", fast.server_address[1])]
+        r = self._router(reps)
+        try:
+            # Bias selection to the slow replica so the hedge has a
+            # rescue to perform.
+            r._view("fast").queue_depth = 0.5
+            won0 = REGISTRY.counter(
+                "hops_tpu_fleet_hedges_total", labels=("model", "outcome")
+            ).value(model="stub", outcome="won")
+            t0 = time.perf_counter()
+            code, payload, _ = r.route(b'{"instances": [[1]]}')
+            dt = time.perf_counter() - t0
+            assert code == 200
+            assert json.loads(payload) == {"predictions": [["fast"]]}
+            assert dt < 0.35  # the 0.4s primary did NOT gate the reply
+            assert REGISTRY.counter(
+                "hops_tpu_fleet_hedges_total", labels=("model", "outcome")
+            ).value(model="stub", outcome="won") - won0 == 1
+            # The abandoned loser finishes on its own thread: inflight
+            # drains to zero, the breaker takes no strike, and its slow
+            # completion lands in the latency stats (the ejection
+            # detector's gray signal).
+            n0 = r._view("slow").latency.sample_count()
+            assert _wait_until(lambda: r._view("slow").inflight == 0
+                               and r._view("fast").inflight == 0)
+            assert _wait_until(
+                lambda: r._view("slow").latency.sample_count() > n0, 5)
+            assert r._view("slow").breaker.state == "closed"
+            assert r._view("fast").breaker.state == "closed"
+        finally:
+            r.stop()
+            slow.shutdown()
+            slow.server_close()
+            fast.shutdown()
+            fast.server_close()
+
+    def test_abandoned_loser_transport_failure_never_strikes(self):
+        # The loser times out AFTER the hedge already answered: a
+        # breaker strike here would punish a replica for a request the
+        # client never missed.
+        wedged = _mini_server(delay_s=3.0)
+        fast = _mini_server()
+        reps = [_StubRep("wedged", wedged.server_address[1]),
+                _StubRep("fast", fast.server_address[1])]
+        from hops_tpu.modelrepo.fleet.router import HedgePolicy
+
+        r = Router(_StubManager(reps), scrape_interval_s=30.0,
+                   forward_timeout_s=0.5,
+                   hedge=HedgePolicy(min_samples=8, budget_frac=0.5,
+                                     budget_burst=5.0))
+        for rep in reps:
+            _seed_latency(r, rep.rid, 0.01)
+        retries = REGISTRY.counter(
+            "hops_tpu_fleet_retries_total", labels=("model", "reason"))
+        try:
+            r._view("fast").queue_depth = 0.5
+            connect0 = retries.value(model="stub", reason="connect")
+            code, payload, _ = r.route(b"{}")
+            assert code == 200
+            # Wait past the loser's forward timeout; its failure must
+            # be swallowed (abandoned), not accounted.
+            time.sleep(0.8)
+            assert r._view("wedged").breaker.state == "closed"
+            assert retries.value(model="stub", reason="connect") == connect0
+            assert _wait_until(lambda: r._view("wedged").inflight == 0)
+        finally:
+            r.stop()
+            wedged.shutdown()
+            wedged.server_close()
+            fast.shutdown()
+            fast.server_close()
+
+    def test_hedge_budget_denies_past_the_cap(self):
+        slow = _mini_server(delay_s=0.15)
+        fast = _mini_server()
+        reps = [_StubRep("slow", slow.server_address[1]),
+                _StubRep("fast", fast.server_address[1])]
+        r = self._router(reps, budget_frac=0.01, budget_burst=1.0)
+        try:
+            r._view("fast").queue_depth = 0.5
+            hedges = REGISTRY.counter(
+                "hops_tpu_fleet_hedges_total", labels=("model", "outcome"))
+            denied0 = hedges.value(model="stub", outcome="denied")
+            fired0 = (hedges.value(model="stub", outcome="won")
+                      + hedges.value(model="stub", outcome="lost"))
+            for _ in range(3):
+                code, _, _ = r.route(b"{}")
+                assert code == 200
+                # Let the abandoned loser drain so the slow replica is
+                # re-picked as primary (score = live inflight) and the
+                # next request needs a hedge again.
+                assert _wait_until(lambda: r._view("slow").inflight == 0)
+            # One token existed at start; once spent, refill at 0.01
+            # per request can never mint another inside this test.
+            fired = (hedges.value(model="stub", outcome="won")
+                     + hedges.value(model="stub", outcome="lost")) - fired0
+            assert fired <= 1
+            assert hedges.value(model="stub", outcome="denied") - denied0 >= 1
+        finally:
+            r.stop()
+            slow.shutdown()
+            slow.server_close()
+            fast.shutdown()
+            fast.server_close()
+
+    def test_hedging_disabled_without_latency_history(self):
+        # min_samples unmet -> _hedge_delay_s is None -> pure sync path.
+        fast = _mini_server()
+        reps = [_StubRep("only", fast.server_address[1])]
+        from hops_tpu.modelrepo.fleet.router import HedgePolicy
+
+        r = Router(_StubManager(reps), scrape_interval_s=30.0,
+                   hedge=HedgePolicy(min_samples=64))
+        try:
+            assert r._hedge_delay_s() is None
+            code, _, _ = r.route(b"{}")
+            assert code == 200
+        finally:
+            r.stop()
+            fast.shutdown()
+            fast.server_close()
+
+
+class TestEjection:
+    """Gray-failure outlier detection: latency probation is a DISTINCT
+    state machine from breaker-open — it opens on slow-but-200 evidence
+    and heals only on shadow-probe evidence."""
+
+    def _router(self, reps, **ej_kw):
+        from hops_tpu.modelrepo.fleet.router import EjectionPolicy
+
+        ej_kw.setdefault("min_samples", 4)
+        ej_kw.setdefault("floor_ms", 5.0)
+        ej_kw.setdefault("readmit_probes", 2)
+        ej_kw.setdefault("probe_interval_s", 0.01)
+        ej_kw.setdefault("readmit_slack_ms", 30.0)
+        return Router(_StubManager(reps), scrape_interval_s=30.0,
+                      ejection=EjectionPolicy(**ej_kw))
+
+    def test_latency_outlier_ejected_into_probation(self):
+        from hops_tpu.runtime import flight
+
+        reps = [_StubRep("a", 1), _StubRep("b", 2), _StubRep("c", 3)]
+        r = self._router(reps)
+        try:
+            base = REGISTRY.counter(
+                "hops_tpu_fleet_ejections_total", labels=("model",)
+            ).value(model="stub")
+            _seed_latency(r, "a", 0.005)
+            _seed_latency(r, "b", 0.006)
+            _seed_latency(r, "c", 0.2)  # 200 ms vs ~5-6 ms peers
+            r._eject_tick()
+            view = r._view("c")
+            assert view.probation is True
+            assert view.breaker.state == "closed"  # NOT the breaker
+            assert REGISTRY.counter(
+                "hops_tpu_fleet_ejections_total", labels=("model",)
+            ).value(model="stub") - base == 1
+            assert "c" not in [rep.rid for rep in r.routable()]
+            ejected = [e for e in flight.FLIGHT.events("replica_ejected")
+                       if e["data"].get("replica") == "c"]
+            assert ejected
+            desc = {d["rid"]: d for d in r.describe()["replicas"]}
+            assert desc["c"]["probation"] is True
+            assert desc["a"]["probation"] is False
+            assert r.describe()["qos"]["probation"] == 1
+        finally:
+            r.stop()
+
+    def test_ejection_capped_never_empties_the_fleet(self):
+        reps = [_StubRep("a", 1), _StubRep("b", 2)]
+        r = self._router(reps)
+        try:
+            _seed_latency(r, "a", 0.004)
+            _seed_latency(r, "b", 0.5)
+            r._eject_tick()
+            r._eject_tick()
+            in_probation = [rid for rid in ("a", "b")
+                            if r._view(rid).probation]
+            assert in_probation == ["b"]  # never the last healthy one
+            assert r.routable()
+        finally:
+            r.stop()
+
+    def test_idle_uniform_fleet_never_ejects(self):
+        reps = [_StubRep("a", 1), _StubRep("b", 2), _StubRep("c", 3)]
+        r = self._router(reps, floor_ms=25.0)
+        try:
+            # Microsecond-scale jitter on an idle fleet: 'c' is 3x its
+            # peers but far under the absolute floor.
+            _seed_latency(r, "a", 0.000005)
+            _seed_latency(r, "b", 0.000005)
+            _seed_latency(r, "c", 0.00002)
+            r._eject_tick()
+            assert not any(r._view(x).probation for x in ("a", "b", "c"))
+        finally:
+            r.stop()
+
+    def test_shadow_probes_readmit_a_healed_replica(self):
+        from hops_tpu.runtime import flight
+
+        healed = _mini_server(delay_s=0.0)
+        reps = [_StubRep("a", 1), _StubRep("b", 2),
+                _StubRep("c", healed.server_address[1])]
+        r = self._router(reps)
+        try:
+            _seed_latency(r, "a", 0.005)
+            _seed_latency(r, "b", 0.006)
+            _seed_latency(r, "c", 0.3)
+            r._eject_tick()
+            view = r._view("c")
+            assert view.probation is True
+            base = REGISTRY.counter(
+                "hops_tpu_fleet_readmissions_total", labels=("model",)
+            ).value(model="stub")
+            rep_c = reps[2]
+            for _ in range(2):
+                r._shadow_probe(rep_c, view, b'{"instances": [[1]]}', None)
+            assert view.probation is False
+            assert view.latency.sample_count() == 0  # history reset
+            assert REGISTRY.counter(
+                "hops_tpu_fleet_readmissions_total", labels=("model",)
+            ).value(model="stub") - base == 1
+            assert [e for e in flight.FLIGHT.events("replica_readmitted")
+                    if e["data"].get("replica") == "c"]
+            assert "c" in [rep.rid for rep in r.routable()]
+        finally:
+            r.stop()
+            healed.shutdown()
+            healed.server_close()
+
+    def test_slow_probe_does_not_readmit(self):
+        still_slow = _mini_server(delay_s=0.2)
+        reps = [_StubRep("a", 1), _StubRep("b", 2),
+                _StubRep("c", still_slow.server_address[1])]
+        r = self._router(reps, readmit_slack_ms=5.0, readmit_factor=1.5)
+        try:
+            _seed_latency(r, "a", 0.005)
+            _seed_latency(r, "b", 0.006)
+            _seed_latency(r, "c", 0.3)
+            r._eject_tick()
+            view = r._view("c")
+            for _ in range(3):
+                r._shadow_probe(reps[2], view, b"{}", None)
+            assert view.probation is True  # still gray, stays out
+            assert view.probe_oks == 0
+        finally:
+            r.stop()
+            still_slow.shutdown()
+            still_slow.server_close()
+
+
+class TestQoSRouting:
+    def test_batch_class_bucket_answers_429_before_replicas(self, fleet_model):
+        shed = REGISTRY.counter(
+            "hops_tpu_fleet_qos_shed_total",
+            labels=("model", "priority", "reason"))
+        base = shed.value(model="flt", priority="batch", reason="rate")
+        with _start(fleet_model, replicas=1,
+                    class_limits={"batch": {"rate_rps": 0.01,
+                                            "burst": 1.0}}) as f:
+            assert f.predict([[1]], priority="batch")["predictions"] == [[2]]
+            with pytest.raises(urllib.error.HTTPError) as e:
+                f.predict([[1]], priority="batch")
+            assert e.value.code == 429
+            assert float(e.value.headers["Retry-After"]) >= 1
+            # Interactive traffic is untouched by the batch bucket.
+            assert f.predict([[1]])["predictions"] == [[2]]
+        assert shed.value(
+            model="flt", priority="batch", reason="rate") - base == 1
+
+    def test_tenant_config_wins_header_can_only_demote(self, fleet_model):
+        # Tenant configured batch + an interactive header claim: the
+        # claim must NOT jump the queue — the batch bucket still
+        # applies.
+        with _start(fleet_model, replicas=1,
+                    rate_limits={"bt": {"priority": "batch"}},
+                    class_limits={"batch": {"rate_rps": 0.01,
+                                            "burst": 1.0}}) as f:
+            assert f.predict([[1]], tenant="bt", priority="interactive")[
+                "predictions"] == [[2]]
+            with pytest.raises(urllib.error.HTTPError) as e:
+                f.predict([[1]], tenant="bt", priority="interactive")
+            assert e.value.code == 429
+
+    def test_brownout_shed_level_refuses_batch_first(self, fleet_model):
+        shed = REGISTRY.counter(
+            "hops_tpu_fleet_qos_shed_total",
+            labels=("model", "priority", "reason"))
+        base = shed.value(model="flt", priority="batch", reason="brownout")
+        with _start(fleet_model, replicas=1,
+                    brownout={"slo_p99_ms": 50.0}) as f:
+            f.router._brownout.level = 2  # force SHED (controller-owned)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                f.predict([[1]], priority="batch")
+            assert e.value.code == 503
+            # Interactive rides through a full brownout.
+            assert f.predict([[1]])["predictions"] == [[2]]
+        assert shed.value(
+            model="flt", priority="batch", reason="brownout") - base == 1
+
+    def test_histogram_p99_estimates_from_bucket_deltas(self):
+        from hops_tpu.modelrepo.fleet import router as router_mod
+
+        reps = [_StubRep("a", 1)]
+        r = Router(_StubManager(reps), scrape_interval_s=30.0)
+        try:
+            child = router_mod._m_request_seconds.labels(
+                model="stub", priority="interactive")
+            for _ in range(99):
+                child.observe(0.010)
+            child.observe(5.0)
+            p99 = r.histogram_p99_ms(priority="interactive")
+            assert p99 is not None
+            # The mass sits in the ~10ms bucket; the single 5s outlier
+            # pulls the estimate above the p50 region but the answer
+            # must stay in the low-latency bucket's range.
+            assert 5.0 <= p99 <= 100.0
+        finally:
+            r.stop()
+
+
+class TestGrayFailureChaos:
+    def test_gray_replica_ejection_probation_readmission_mid_traffic(
+            self, fleet_model):
+        """The acceptance chaos scenario: a replica turns gray (slow,
+        every answer still a 200) MID-TRAFFIC; the fleet hedges around
+        it, ejects it into probation, keeps serving with zero
+        client-visible errors, and — once it heals — shadow probes
+        readmit it."""
+        ejections = REGISTRY.counter(
+            "hops_tpu_fleet_ejections_total", labels=("model",))
+        readmissions = REGISTRY.counter(
+            "hops_tpu_fleet_readmissions_total", labels=("model",))
+        ej0 = ejections.value(model="flt")
+        re0 = readmissions.value(model="flt")
+        with _start(
+            fleet_model, replicas=3,
+            hedge=fleet.HedgePolicy(min_samples=8, budget_frac=0.05,
+                                    budget_burst=5.0),
+            ejection=fleet.EjectionPolicy(
+                min_samples=5, factor=3.0, floor_ms=5.0,
+                probe_interval_s=0.05, readmit_probes=2,
+                readmit_slack_ms=30.0),
+        ) as f:
+            errors: list = []
+            stop = threading.Event()
+
+            def client():
+                while not stop.is_set():
+                    try:
+                        out = f.predict([[3]], timeout_s=20.0)
+                        if out["predictions"] != [[6]]:
+                            errors.append(("bad", out))
+                    except Exception as e:  # noqa: BLE001 — the assert
+                        errors.append(repr(e))
+
+            threads = [threading.Thread(target=client) for _ in range(4)]
+            for t in threads:
+                t.start()
+            try:
+                time.sleep(0.7)  # healthy warmup: latency stats seeded
+                gray = f.manager.ready()[-1]
+                faultinject.arm(
+                    f"serving.handle=latency:0.25@key={gray.port}")
+                assert _wait_until(
+                    lambda: ejections.value(model="flt") > ej0, 20.0), \
+                    "gray replica was never ejected"
+                desc = {d["rid"]: d for d in f.describe()["replicas"]}
+                assert desc[gray.rid]["probation"] is True
+                assert desc[gray.rid]["breaker"] == "closed"  # gray != down
+                # The replica heals: probes must readmit it.
+                faultinject.disarm()
+                assert _wait_until(
+                    lambda: readmissions.value(model="flt") > re0, 20.0), \
+                    "healed replica was never readmitted"
+                assert _wait_until(
+                    lambda: not f.router._view(gray.rid).probation, 10.0)
+            finally:
+                stop.set()
+                for t in threads:
+                    t.join(timeout=10)
+            assert errors == [], f"client-visible errors: {errors[:5]}"
+
+
+@pytest.mark.slow
+def test_bench_tail_smoke(workspace):
+    """`bench.py --tail --smoke` pin: the tail tier's acceptance gates —
+    hedged p99 >= 2x better than unhedged at hedge rate <= 5% (+ burst),
+    an ejection observed, zero client-visible errors in every phase,
+    batch shedding first while interactive sheds nothing, the brownout
+    engaging, and the fan-out store beating sequential probing."""
+    import importlib.util
+
+    root = Path(__file__).parent.parent
+    spec = importlib.util.spec_from_file_location("_bench_tail", root / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    d = bench.run_tail_bench(smoke=True)
+    assert d["p99_improvement"] >= 2.0
+    # The budget invariant itself: hedges <= budget_frac * requests
+    # + the burst (the burst amortizes away at production request
+    # counts; at smoke counts it must be priced in explicitly).
+    requests = d["hedged"]["requests"]
+    assert d["hedged"]["hedges_fired"] <= 0.05 * requests + 5.0
+    assert d["hedged"]["ejections"] >= 1
+    assert d["unhedged"]["errors"] == 0 and d["hedged"]["errors"] == 0
+    qos = d["qos"]
+    assert qos["interactive"]["errors"] == 0
+    assert qos["batch"]["errors"] == 0
+    assert qos["interactive"]["sheds"] == 0
+    batch_sheds = (qos["batch"]["sheds"]
+                   + qos["router_sheds"]["batch_rate"]
+                   + qos["router_sheds"]["batch_brownout"])
+    assert batch_sheds > 0
+    assert qos["router_sheds"]["interactive_rate"] == 0
+    assert qos["router_sheds"]["interactive_brownout"] == 0
+    assert qos["brownout_level_seen"] >= 1
+    assert d["store"]["fanout_mean_ms"] <= d["store"]["sequential_mean_ms"] * 0.8
+
+
+class TestGrayScrapePath:
+    def test_scrape_latency_fault_stales_the_view_not_routing(
+            self, fleet_model):
+        """`router.scrape=latency` keyed by replica port: the gray
+        metrics path makes that replica's scrape time out — its view
+        goes stale (scrape_ok False, deprioritized by score) — while
+        requests keep flowing and the OTHER replicas keep scraping."""
+        with _start(fleet_model, replicas=2,
+                    scrape_interval_s=0.05) as f:
+            # Let healthy scrapes land first.
+            reps = f.manager.ready()
+            assert _wait_until(lambda: all(
+                f.router._view(r.rid).last_scrape_mono is not None
+                for r in reps), 10.0)
+            victim, healthy = reps[0], reps[1]
+            faultinject.arm(
+                f"router.scrape=latency:1.0@key={victim.port}")
+            assert _wait_until(
+                lambda: not f.router._view(victim.rid).scrape_ok, 10.0), \
+                "gray scrape never staled the victim's view"
+            # Routing never stalled: requests answer while the scrape
+            # path is wedged, and the healthy replica's scrape stays ok.
+            assert f.predict([[5]], timeout_s=10.0)["predictions"] == [[10]]
+            assert f.router._view(healthy.rid).scrape_ok
+            faultinject.disarm()
+            assert _wait_until(
+                lambda: f.router._view(victim.rid).scrape_ok, 10.0)

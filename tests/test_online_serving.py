@@ -908,3 +908,121 @@ class TestExample:
         assert result["entities"] > 0
         assert len(result["predictions"]) == 3
         assert result["online_matches_offline"]
+
+
+class TestFanOutHedging:
+    """Parallel multi-shard fan-out + straggler hedging (the tail
+    layer): one slow shard eats only its own keys, a hedge races an
+    injected stall, and results stay bit-identical to the sequential
+    path."""
+
+    def _store(self, name, *, fanout, workspace, shards=4, hedge=True):
+        s = ShardedOnlineStore(name, 1, primary_key=["user_id"],
+                               shards=shards, fanout=fanout, hedge=hedge)
+        s.put_dataframe(users_df(32))
+        return s
+
+    def test_fanout_results_match_sequential(self, workspace):
+        seq = self._store("fo_seq", fanout=False, workspace=workspace)
+        fan = self._store("fo_fan", fanout=True, workspace=workspace)
+        entries = [{"user_id": i} for i in range(40)]  # hits + misses
+        assert fan.multi_get(entries) == seq.multi_get(entries)
+        assert fan.multi_get(entries, deadline_s=5.0) == \
+            seq.multi_get(entries, deadline_s=5.0)
+        seq.close()
+        fan.close()
+
+    def test_slow_shard_eats_only_its_own_keys(self, workspace, monkeypatch):
+        s = self._store("fo_slow", fanout=True, workspace=workspace,
+                        hedge=False)
+        victim = s._shards[1]
+        real = ShardedOnlineStore._shard_lookup
+
+        def slow_lookup(shard, pk_lists):
+            if shard is victim:
+                time.sleep(0.5)
+            return real(shard, pk_lists)
+
+        monkeypatch.setattr(ShardedOnlineStore, "_shard_lookup",
+                            staticmethod(slow_lookup))
+        entries = [{"user_id": i} for i in range(32)]
+        t0 = time.perf_counter()
+        rows = s.multi_get(entries, deadline_s=0.1)
+        dt = time.perf_counter() - t0
+        assert dt < 0.4  # the slow shard did NOT serialize the call
+        by_shard = {i: s.shard_index({"user_id": i}) for i in range(32)}
+        for i, row in enumerate(rows):
+            if by_shard[i] == 1:
+                assert row is None  # its keys degraded to missing
+            else:
+                assert row is not None and row["user_id"] == i
+        # The deadline overrun is breaker pressure on THAT shard only,
+        # and the others took no strike.
+        assert s._breakers[1]._failures >= 1 or s._breakers[1].state != "closed"
+        assert s._breakers[0].state == "closed"
+        s.close()
+
+    def test_injected_straggler_is_hedged_and_rescued(self, workspace):
+        s = self._store("fo_hedge", fanout=True, workspace=workspace)
+        entries = [{"user_id": i} for i in range(32)]
+        for _ in range(12):  # seed the hedge timer's p95 history
+            s.multi_get(entries)
+        hedges = REGISTRY.counter(
+            "hops_tpu_online_shard_hedges_total", labels=("store",))
+        base = hedges.value(store=s.label)
+        # One stalled first attempt on shard 2; the hedge's second
+        # attempt passes clean (times=1).
+        faultinject.arm("shard.lookup=latency:0.4@key=2,times=1")
+        t0 = time.perf_counter()
+        rows = s.multi_get(entries, deadline_s=2.0)
+        dt = time.perf_counter() - t0
+        assert all(r is not None for r in rows)  # nothing degraded
+        assert dt < 0.35  # the hedge answered; the stall was abandoned
+        assert hedges.value(store=s.label) - base >= 1
+        assert s._breakers[2].state == "closed"  # no strike for the loser
+        s.close()
+
+    def test_error_fault_still_degrades_to_missing_in_fanout(self, workspace):
+        s = self._store("fo_err", fanout=True, workspace=workspace)
+        faultinject.arm("online.lookup=error:OSError")
+        rows = s.multi_get([{"user_id": i} for i in range(8)])
+        assert all(r is None for r in rows)
+        faultinject.disarm()
+        rows = s.multi_get([{"user_id": i} for i in range(8)])
+        assert all(r is not None for r in rows)
+        s.close()
+
+    def test_brownout_shrinks_join_deadline_to_defaults(self, workspace,
+                                                        monkeypatch):
+        from hops_tpu.runtime import qos
+
+        s = self._store("fo_brown", fanout=True, workspace=workspace,
+                        hedge=False)
+
+        def wedged_lookup(shard, pk_lists):
+            time.sleep(0.4)
+            return [None] * len(pk_lists)
+
+        predictor = FeatureJoinPredictor(
+            lambda vectors: [v[:1] for v in vectors],
+            {"groups": [{"name": "fo_brown", "primary_key": ["user_id"],
+                         "features": ["f0"]}],
+             "order": ["f0"], "missing": "default", "defaults": {"f0": -1.0},
+             "brownout_lookup_deadline_s": 0.05},
+            model="brownout-test",
+            stores={"fo_brown": s},
+        )
+        monkeypatch.setattr(ShardedOnlineStore, "_shard_lookup",
+                            staticmethod(wedged_lookup))
+        qos.set_brownout(qos.DEGRADE, hold_s=30.0)
+        try:
+            t0 = time.perf_counter()
+            vecs = predictor.join([{"user_id": 1}])
+            dt = time.perf_counter() - t0
+            # Browned out: stop waiting on the wedged shards, serve the
+            # configured default instead.
+            assert vecs == [[-1.0]]
+            assert dt < 0.3
+        finally:
+            qos.set_brownout(0)
+            s.close()

@@ -1,0 +1,302 @@
+"""QoS runtime unit tests: priority resolution, brownout controller
+hysteresis, the bounded priority queue's shed/starvation contracts, and
+the persistent-connection HTTP pool.
+
+These are the shared primitives the gray-failure layer hangs off
+(docs/operations.md "Tail latency & QoS"); the integration behavior
+rides in test_fleet.py / test_online_serving.py.
+"""
+
+from __future__ import annotations
+
+import json
+import queue as stdlib_queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from hops_tpu.runtime import qos
+from hops_tpu.runtime.httpclient import HTTPPool
+
+
+# -- priority resolution ------------------------------------------------------
+
+
+class TestPriorityResolution:
+    def test_header_alone_is_honored(self):
+        assert qos.parse_priority("batch") == "batch"
+        assert qos.parse_priority("interactive") == "interactive"
+
+    def test_no_signal_defaults_interactive(self):
+        assert qos.parse_priority(None) == "interactive"
+        assert qos.parse_priority("") == "interactive"
+        assert qos.parse_priority("garbage") == "interactive"
+
+    def test_header_can_demote_never_promote(self):
+        # Tenant configured batch: an interactive claim must NOT jump
+        # the queue; a batch claim on an interactive tenant may demote.
+        assert qos.parse_priority("interactive", configured="batch") == "batch"
+        assert qos.parse_priority("batch", configured="interactive") == "batch"
+        assert qos.parse_priority(None, configured="batch") == "batch"
+
+    def test_scope_rides_the_thread(self):
+        assert qos.request_priority() == "interactive"
+        with qos.priority_scope("batch"):
+            assert qos.request_priority() == "batch"
+        assert qos.request_priority() == "interactive"
+
+
+# -- brownout -----------------------------------------------------------------
+
+
+class TestBrownoutController:
+    def _ctl(self, **kw):
+        kw.setdefault("slo_p99_ms", 100.0)
+        kw.setdefault("burn_window_s", 1.0)
+        kw.setdefault("recover_window_s", 2.0)
+        clock = [0.0]
+        ctl = qos.BrownoutController(
+            qos.BrownoutPolicy(**kw), clock=lambda: clock[0])
+        return ctl, clock
+
+    def test_sustained_burn_degrades_then_sheds(self):
+        ctl, clock = self._ctl()
+        assert ctl.observe(150.0) == 0  # breach begins, not sustained
+        clock[0] = 1.1
+        assert ctl.observe(150.0) == qos.DEGRADE
+        # Deeper burn (> shed_factor * slo) sustained -> SHED.
+        clock[0] = 2.0
+        ctl.observe(250.0)
+        clock[0] = 3.2
+        assert ctl.observe(250.0) == qos.SHED
+
+    def test_one_bursty_tick_never_flaps(self):
+        ctl, clock = self._ctl()
+        ctl.observe(500.0)
+        clock[0] = 0.5
+        assert ctl.observe(50.0) == 0  # burn not sustained; timer reset
+        clock[0] = 2.0
+        assert ctl.observe(500.0) == 0  # a fresh breach starts over
+
+    def test_recovery_steps_down_one_level_per_window(self):
+        ctl, clock = self._ctl()
+        ctl.observe(300.0)
+        clock[0] = 1.1
+        assert ctl.observe(300.0) == qos.SHED
+        clock[0] = 2.0
+        ctl.observe(50.0)  # clearing begins (below exit_factor * slo)
+        clock[0] = 4.1
+        assert ctl.observe(50.0) == qos.DEGRADE  # one notch down
+        clock[0] = 6.2
+        assert ctl.observe(50.0) == 0  # next window clears fully
+
+    def test_no_signal_holds_level(self):
+        ctl, clock = self._ctl()
+        ctl.observe(300.0)
+        clock[0] = 1.1
+        assert ctl.observe(300.0) == qos.SHED
+        clock[0] = 10.0
+        assert ctl.observe(None) == qos.SHED  # blind ticks hold
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            qos.BrownoutPolicy(slo_p99_ms=0)
+        with pytest.raises(ValueError):
+            qos.BrownoutPolicy(slo_p99_ms=10, exit_factor=1.5)
+
+    def test_global_level_expires_by_ttl(self):
+        clock = [0.0]
+        qos.set_brownout(qos.DEGRADE, hold_s=1.0, clock=lambda: clock[0])
+        assert qos.brownout_level(clock=lambda: clock[0]) == qos.DEGRADE
+        clock[0] = 1.5
+        assert qos.brownout_level(clock=lambda: clock[0]) == 0
+
+    def test_remote_brownout_only_raises(self):
+        qos.set_brownout(0)
+        qos.note_remote_brownout("2", hold_s=5.0)
+        assert qos.brownout_level() == qos.SHED
+        qos.note_remote_brownout("garbage")  # ignored
+        qos.note_remote_brownout("0")  # a zero never lowers anything
+        assert qos.brownout_level() == qos.SHED
+        qos.set_brownout(0)
+
+
+# -- bounded priority queue ---------------------------------------------------
+
+
+class TestBoundedPriorityQueue:
+    def test_priority_order_fifo_within_class(self):
+        q = qos.BoundedPriorityQueue(8)
+        q.put("b1", rank=1)
+        q.put("i1", rank=0)
+        q.put("b2", rank=1)
+        q.put("i2", rank=0)
+        assert [q.get_nowait() for _ in range(4)] == ["i1", "i2", "b1", "b2"]
+
+    def test_full_queue_evicts_newest_of_worst_class(self):
+        q = qos.BoundedPriorityQueue(2)
+        q.put("b-old", rank=1)
+        q.put("b-new", rank=1)
+        evicted = q.put("i1", rank=0)
+        assert evicted == "b-new"  # newest, least-sunk batch work sheds
+        assert q.get_nowait() == "i1"
+        assert q.get_nowait() == "b-old"
+
+    def test_full_of_equal_or_better_refuses_the_incomer(self):
+        q = qos.BoundedPriorityQueue(1)
+        q.put("b1", rank=1)
+        with pytest.raises(qos.ShedError):
+            q.put("b2", rank=1)  # same class: nothing worse to evict
+        q2 = qos.BoundedPriorityQueue(1)
+        q2.put("i1", rank=0)
+        with pytest.raises(qos.ShedError):
+            q2.put("b1", rank=1)  # everything queued outranks it
+
+    def test_batch_is_starvation_free_under_interactive_load(self):
+        q = qos.BoundedPriorityQueue(64, starvation_limit=3)
+        q.put("batch", rank=1)
+        for i in range(10):
+            q.put(f"i{i}", rank=0)
+        served = []
+        # Keep refilling interactive as fast as we drain — batch must
+        # still surface within starvation_limit picks.
+        for n in range(8):
+            item = q.get_nowait()
+            served.append(item)
+            q.put(f"extra{n}", rank=0)
+        assert "batch" in served
+        assert served.index("batch") <= 3
+
+    def test_control_lane_preempts_and_is_never_evicted(self):
+        q = qos.BoundedPriorityQueue(1)
+        q.put("i1", rank=0)
+        q.put(None, rank=-1)  # sentinel: no bound, no eviction
+        assert q.get_nowait() is None
+        assert q.get_nowait() == "i1"
+
+    def test_get_timeout_raises_stdlib_empty(self):
+        q = qos.BoundedPriorityQueue(4)
+        with pytest.raises(stdlib_queue.Empty):
+            q.get(timeout=0.01)
+
+    def test_blocked_get_wakes_on_put(self):
+        q = qos.BoundedPriorityQueue(4)
+        out = []
+        t = threading.Thread(target=lambda: out.append(q.get(timeout=5)))
+        t.start()
+        time.sleep(0.05)
+        q.put("x", rank=0)
+        t.join(timeout=5)
+        assert out == ["x"]
+
+    def test_bound_validation(self):
+        with pytest.raises(ValueError):
+            qos.BoundedPriorityQueue(0)
+
+
+class TestStarvationGuard:
+    def test_forces_worst_class_after_limit(self):
+        g = qos.StarvationGuard(limit=2)
+        assert g.pick_rank([0, 1]) == 0
+        assert g.pick_rank([0, 1]) == 0
+        assert g.pick_rank([0, 1]) == 1  # the forced batch pick
+        assert g.pick_rank([0, 1]) == 0  # streak reset
+
+    def test_single_class_resets_the_streak(self):
+        g = qos.StarvationGuard(limit=2)
+        g.pick_rank([0, 1])
+        assert g.pick_rank([0]) == 0  # nothing waiting behind
+        assert g.pick_rank([0, 1]) == 0
+        assert g.pick_rank([0, 1]) == 0
+
+
+# -- persistent-connection pool -----------------------------------------------
+
+
+def _http11_server(handler_body):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            code, body = handler_body(self)
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        do_POST = do_GET
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class TestHTTPPool:
+    def test_connection_reuse_across_requests(self):
+        srv = _http11_server(lambda h: (200, {"ok": 1}))
+        pool = HTTPPool()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/x"
+            for _ in range(3):
+                code, body, headers = pool.request("GET", url, timeout_s=5)
+                assert code == 200 and json.loads(body) == {"ok": 1}
+            # The second and third exchanges rode the parked socket —
+            # the whole point of the pool (no per-hop handshake).
+            assert pool.created == 1
+            assert pool.reused == 2
+        finally:
+            pool.close()
+            srv.shutdown()
+            srv.server_close()
+
+    def test_4xx_5xx_are_data_not_exceptions(self):
+        srv = _http11_server(lambda h: (503, {"error": "shed"}))
+        pool = HTTPPool()
+        try:
+            code, body, _ = pool.request(
+                "POST",
+                f"http://127.0.0.1:{srv.server_address[1]}/x",
+                body=b"{}", timeout_s=5)
+            assert code == 503
+            assert json.loads(body) == {"error": "shed"}
+        finally:
+            pool.close()
+            srv.shutdown()
+            srv.server_close()
+
+    def test_stale_parked_connection_retries_fresh(self):
+        # Serve one request, then kill the server and bring a new one
+        # up on the SAME port: the parked keep-alive is now dead, and
+        # the pool must retry once on a fresh connection instead of
+        # surfacing the stale-socket error.
+        srv = _http11_server(lambda h: (200, {"gen": 1}))
+        port = srv.server_address[1]
+        pool = HTTPPool()
+        try:
+            url = f"http://127.0.0.1:{port}/x"
+            assert pool.request("GET", url, timeout_s=5)[0] == 200
+            srv.shutdown()
+            srv.server_close()
+            srv2 = ThreadingHTTPServer(("127.0.0.1", port), srv.RequestHandlerClass)
+            threading.Thread(target=srv2.serve_forever, daemon=True).start()
+            try:
+                code, body, _ = pool.request("GET", url, timeout_s=5)
+                assert code == 200
+            finally:
+                srv2.shutdown()
+                srv2.server_close()
+        finally:
+            pool.close()
+
+    def test_transport_failure_raises_oserror_family(self):
+        pool = HTTPPool()
+        with pytest.raises(OSError):
+            pool.request("GET", "http://127.0.0.1:9/x", timeout_s=0.5)
+        pool.close()

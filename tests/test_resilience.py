@@ -285,6 +285,37 @@ class TestFaultInject:
         assert faultinject.fire("serving.handle") is False
         assert time.monotonic() - t0 >= 0.05
 
+    def test_keyed_spec_targets_one_component(self):
+        # A gray fault targets ONE replica port / shard index among
+        # many sharing the process: only the matching key fires.
+        faultinject.arm("serving.handle=error:OSError@key=9001")
+        faultinject.fire("serving.handle", key=9000)  # other replica
+        faultinject.fire("serving.handle")  # keyless passage
+        with pytest.raises(OSError):
+            faultinject.fire("serving.handle", key=9001)
+        # Non-string key values (ports, shard indices) stringify.
+        with pytest.raises(OSError):
+            faultinject.fire("serving.handle", key="9001")
+        faultinject.disarm()
+
+    def test_keyed_spec_counts_passages_per_key(self):
+        # times/after schedules must replay deterministically PER
+        # component: passages of other keys are invisible to the spec.
+        faultinject.arm("shard.lookup=error:OSError@key=2,times=1,after=1")
+        faultinject.fire("shard.lookup", key=0)  # not counted
+        faultinject.fire("shard.lookup", key=2)  # passage 0: after=1 skips
+        faultinject.fire("shard.lookup", key=0)  # not counted
+        with pytest.raises(OSError):
+            faultinject.fire("shard.lookup", key=2)  # passage 1: fires
+        faultinject.fire("shard.lookup", key=2)  # times=1 exhausted
+        faultinject.disarm()
+
+    def test_keyless_spec_still_matches_keyed_passages(self):
+        faultinject.arm("shard.lookup=error:OSError@times=1")
+        with pytest.raises(OSError):
+            faultinject.fire("shard.lookup", key=3)
+        faultinject.disarm()
+
     def test_fire_data_corrupts_payload(self):
         faultinject.arm("pubsub.publish=corrupt@times=1")
         out = faultinject.fire_data("pubsub.publish", b"hello world")
