@@ -945,6 +945,239 @@ def run_serving_fleet_bench(
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_multi_host_bench(
+    smoke: bool = False,
+    *,
+    hosts: int = 2,
+    replicas: int = 2,
+    shards: int = 2,
+    clients: int = 6,
+    work_ms: float = 15.0,
+    measure_s: float = 3.0,
+    entities: int = 2000,
+    lookup_batches: int = 200,
+    batch_keys: int = 64,
+) -> dict:
+    """The ``--multi-host`` tier: hostd-placed serving and placed
+    feature shards vs their local-placement baselines.
+
+    Host-only (no accelerator, no relay lock; the hostds run
+    ``inprocess_units=True`` — the placement *control plane* is the
+    real HTTP surface under test, the units skip process startup so
+    the tier measures placement, not fork+import). Phases:
+
+    1. **local fleet** — ``replicas`` in-process replicas behind the
+       router, closed-loop clients for ``measure_s``: the
+       local-placement baseline (rps, p50/p99).
+    2. **placed fleet** — the same fleet with ``placement=`` a
+       :class:`PlacementClient` over ``hosts`` hostd agents: identical
+       load. Since placement is control-plane-only (the router talks
+       straight to each replica's registered host:port), the ratio to
+       phase 1 is the data-plane-unchanged check; the JSON also
+       carries the control-plane RPC count that placed the fleet.
+    3. **shard fan-out** — ``batch_keys``-key ``multi_get`` batches
+       against a local ``ShardedOnlineStore`` vs the same data behind
+       ``shards`` placed shard servers (warm-started from one
+       snapshot): lookups/s and per-batch p50/p99 for both, plus a
+       row-identity check — the placed store must return exactly the
+       local store's rows.
+
+    Every client records errors; the tier asserts none in its JSON.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import pandas as pd
+
+    from hops_tpu.featurestore.online_serving import ShardedOnlineStore
+    from hops_tpu.jobs import placement
+    from hops_tpu.modelrepo import fleet, registry, serving
+    from hops_tpu.runtime import config as rtconfig
+    from hops_tpu.telemetry.metrics import REGISTRY
+
+    if smoke:
+        clients, work_ms, measure_s = 4, 3.0, 1.0
+        entities, lookup_batches, batch_keys = 400, 60, 32
+
+    tmp = Path(tempfile.mkdtemp(prefix="hops_tpu_mhbench_"))
+    rtconfig.configure(workspace=str(tmp / "ws"), project="bench")
+    hostds: list = []
+    stores: list = []
+    try:
+        art = tmp / "art"
+        art.mkdir()
+        (art / "p.py").write_text(
+            "import threading, time\n"
+            "class Predict:\n"
+            "    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "    def predict(self, instances):\n"
+            "        with self._lock:\n"
+            f"            time.sleep({work_ms / 1e3})\n"
+            "        return [[v[0]] for v in instances]\n"
+        )
+        registry.export(art, "mhbench", metrics={"v": 1.0})
+        serving.create_or_update("mhbench", model_name="mhbench",
+                                 model_version=1, model_server="PYTHON")
+
+        class _Load:
+            """Closed-loop clients; thread-safe completion log."""
+
+            def __init__(self, f, n):
+                self.f = f
+                self.errors = 0
+                self.lock = threading.Lock()
+                self.lat: list[float] = []
+                self.stop = threading.Event()
+                self.threads = [
+                    threading.Thread(target=self._run, daemon=True)
+                    for _ in range(n)
+                ]
+                for t in self.threads:
+                    t.start()
+
+            def _run(self):
+                while not self.stop.is_set():
+                    t0 = time.perf_counter()
+                    try:
+                        self.f.predict([[1]], timeout_s=30.0)
+                        with self.lock:
+                            self.lat.append(time.perf_counter() - t0)
+                    except Exception:  # noqa: BLE001 — counted, asserted on
+                        with self.lock:
+                            self.errors += 1
+
+            def halt(self):
+                self.stop.set()
+                for t in self.threads:
+                    t.join(timeout=10)
+
+        def _serve_phase(**fleet_kwargs):
+            with fleet.start_fleet("mhbench", replicas,
+                                   scrape_interval_s=0.05,
+                                   **fleet_kwargs) as f:
+                load = _Load(f, clients)
+                t0 = time.perf_counter()
+                time.sleep(measure_s)
+                elapsed = time.perf_counter() - t0
+                load.halt()
+                lat_ms = np.asarray(load.lat) * 1e3
+                return {
+                    "rps": round(len(load.lat) / elapsed, 1),
+                    "p50_ms": round(float(np.percentile(lat_ms, 50)), 2) if len(lat_ms) else 0.0,
+                    "p99_ms": round(float(np.percentile(lat_ms, 99)), 2) if len(lat_ms) else 0.0,
+                    "errors": load.errors,
+                }
+
+        # -- phase 1: local-placement baseline -------------------------------
+        local_serve = _serve_phase(inprocess=True)
+
+        # -- phase 2: hostd-placed fleet --------------------------------------
+        for i in range(hosts):
+            hostds.append(placement.Hostd(
+                f"bench-h{i}", inprocess_units=True,
+                unit_root=tmp / f"h{i}"))
+        client = placement.PlacementClient(placement.HostRegistry(
+            hosts=[h.host() for h in hostds]))
+        m_rpc = REGISTRY.counter(
+            "hops_tpu_placement_rpc_total",
+            labels=("host", "verb", "outcome"))
+        rpc0 = sum(
+            m_rpc.value(host=h.name, verb=v, outcome="ok")
+            for h in hostds for v in ("spawn", "drain", "reap", "health"))
+        placed_serve = _serve_phase(placement=client)
+        placed_rpcs = sum(
+            m_rpc.value(host=h.name, verb=v, outcome="ok")
+            for h in hostds for v in ("spawn", "drain", "reap", "health")
+        ) - rpc0
+
+        # -- phase 3: shard fan-out, local vs placed --------------------------
+        rows = pd.DataFrame({
+            "uid": list(range(entities)),
+            "score": [i * 0.5 for i in range(entities)],
+            "clicks": [i % 97 for i in range(entities)],
+        })
+        local_store = ShardedOnlineStore(
+            "mhbench_feats", primary_key=["uid"], shards=shards,
+            root=tmp / "online")
+        stores.append(local_store)
+        local_store.put_dataframe(rows)
+        snap = local_store.snapshot(tmp / "snap")
+
+        rng = np.random.default_rng(7)
+        batches = [
+            [[int(k)] for k in rng.integers(0, entities, size=batch_keys)]
+            for _ in range(lookup_batches)
+        ]
+
+        def _lookup_phase(store):
+            lat = []
+            t0 = time.perf_counter()
+            for b in batches:
+                s = time.perf_counter()
+                store.multi_get(b)
+                lat.append(time.perf_counter() - s)
+            elapsed = time.perf_counter() - t0
+            lat_ms = np.asarray(lat) * 1e3
+            return {
+                "lookups_per_sec": round(
+                    lookup_batches * batch_keys / elapsed, 1),
+                "batch_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+                "batch_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
+            }
+
+        local_lookup = _lookup_phase(local_store)
+        units = [
+            client.spawn("shard", {
+                "store": "mhbench_feats", "version": 1, "shard_index": i,
+                "shards": shards, "primary_key": ["uid"],
+                "root": str(tmp / f"placed_shard{i}"), "port": 0,
+                "snapshot": str(snap),
+            })
+            for i in range(shards)
+        ]
+        placed_store = ShardedOnlineStore(
+            "mhbench_feats", primary_key=["uid"],
+            endpoints=[f"http://{u.address}:{u.port}" for u in units])
+        stores.append(placed_store)
+        placed_lookup = _lookup_phase(placed_store)
+        # Bit-identical serving data: the warm-started placed shards
+        # must answer exactly what the local store answers.
+        probe = batches[0]
+        rows_match = local_store.multi_get(probe) == placed_store.multi_get(probe)
+        for u in units:
+            client.reap(u)
+
+        return {
+            "hosts": hosts,
+            "replicas": replicas,
+            "shards": shards,
+            "local_rps": local_serve["rps"],
+            "placed_rps": placed_serve["rps"],
+            "placed_over_local": round(
+                placed_serve["rps"] / max(local_serve["rps"], 1e-9), 2),
+            "local_p99_ms": local_serve["p99_ms"],
+            "placed_p99_ms": placed_serve["p99_ms"],
+            "placement_rpcs": int(placed_rpcs),
+            "local_lookups_per_sec": local_lookup["lookups_per_sec"],
+            "placed_lookups_per_sec": placed_lookup["lookups_per_sec"],
+            "local_batch_p99_ms": local_lookup["batch_p99_ms"],
+            "placed_batch_p99_ms": placed_lookup["batch_p99_ms"],
+            "rows_match": bool(rows_match),
+            "errors": int(local_serve["errors"] + placed_serve["errors"]),
+        }
+    finally:
+        for s in stores:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        for h in hostds:
+            h.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_tail_bench(
     smoke: bool = False,
     *,
@@ -2335,14 +2568,16 @@ def main() -> None:
         "--grad-comms",
         choices=["none", "quantized", "zero1", "quantized+zero1",
                  "overlap", "quantized+overlap", "zero2",
-                 "quantized+zero2", "zero3", "quantized+zero3"],
+                 "quantized+zero2", "zero3", "quantized+zero3",
+                 "hier", "quantized+hier"],
         default="none",
         help="gradient-communication schedule for the ResNet bench: "
         "block-scaled int8 quantized all-reduce, ZeRO-1/2/3 sharded "
-        "updates, and overlap-scheduled (bucket-as-ready, launched "
-        "under backward) variants (hops_tpu.parallel.grad_comms); "
-        "overlap/zero2/zero3 lines carry overlap_fraction and "
-        "per-chip optimizer-state bytes",
+        "updates, overlap-scheduled (bucket-as-ready, launched "
+        "under backward) variants, and hierarchy-aware (intra-host "
+        "reduce, one inter-host exchange per byte) schedules "
+        "(hops_tpu.parallel.grad_comms); overlap/zero2/zero3 lines "
+        "carry overlap_fraction and per-chip optimizer-state bytes",
     )
     parser.add_argument(
         "--remat", action="store_true",
@@ -2372,6 +2607,15 @@ def main() -> None:
         "with autoscale-up and a mid-load rollout; reports requests/s, "
         "p50/p99 latency, per-replica balance, scale events, and the "
         "rollout blip; host-only (no accelerator, no relay lock)",
+    )
+    parser.add_argument(
+        "--multi-host", action="store_true", dest="multi_host",
+        help="multi-host placement tier: hostd-placed replicas and "
+        "placed feature shards vs their local-placement baselines "
+        "(fleet rps/p99 local vs placed, shard multi_get fan-out "
+        "local vs placed, warm-start row-identity check, placement "
+        "control-plane RPC count); host-only (no accelerator, no "
+        "relay lock)",
     )
     parser.add_argument(
         "--tail", action="store_true",
@@ -2561,6 +2805,19 @@ def main() -> None:
         print(json.dumps({
             "metric": "tail_hedged_p99_improvement",
             "value": result["p99_improvement"],
+            "unit": "x",
+            **result,
+        }))
+        return
+
+    if args.multi_host:
+        # Entirely host-side: the hostds, placement client and shard
+        # servers are all stdlib HTTP — no accelerator, no relay lock.
+        _note("multi-host bench: hostd-placed fleet + shards vs local")
+        result = run_multi_host_bench(smoke=args.smoke)
+        print(json.dumps({
+            "metric": "multi_host_placed_over_local",
+            "value": result["placed_over_local"],
             "unit": "x",
             **result,
         }))

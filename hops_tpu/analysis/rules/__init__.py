@@ -8,6 +8,7 @@ Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 - :mod:`.lock_discipline` — ``lock-discipline``
 - :mod:`.metric_consistency` — ``metric-name-consistency``
 - :mod:`.debug_surfaces` — ``debug-surface-docs``
+- :mod:`.hardcoded_loopback` — ``hardcoded-loopback``
 - :mod:`.swallowed_exception` — ``swallowed-exception``
 - :mod:`.naked_retry` — ``naked-retry-loop``
 - :mod:`.blocking_call` — ``blocking-call-no-deadline``
@@ -19,6 +20,7 @@ from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effect
     blocking_call,
     debug_surfaces,
     donation,
+    hardcoded_loopback,
     host_sync,
     jit_purity,
     lock_discipline,

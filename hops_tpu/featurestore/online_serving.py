@@ -148,6 +148,61 @@ def _shard_of(key: str, n: int) -> int:
     return zlib.crc32(key.encode()) % n
 
 
+class _RemoteShard:
+    """Client proxy for one placed shard server (``jobs.placement.
+    shardd``), shaped exactly like :class:`~hops_tpu.featurestore.
+    online.OnlineStore` where the sharded store touches it.
+
+    Transport failures and non-200 answers raise ``OSError`` subclasses
+    — precisely what ``multi_get``'s per-shard breaker/hedge/deadline
+    machinery already catches, so placed shards inherit the local tail
+    semantics without a line of change there.
+    """
+
+    def __init__(self, endpoint: str, *, timeout_s: float = 5.0):
+        from hops_tpu.runtime.httpclient import HTTPPool
+
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout_s = float(timeout_s)
+        self._pool = HTTPPool(max_idle_per_host=4)
+
+    def _exchange(self, method: str, path: str,
+                  payload: dict | None = None) -> dict:
+        body = (json.dumps(payload, default=str).encode()
+                if payload is not None else None)
+        code, data, _ = self._pool.request(
+            method, f"{self.endpoint}{path}", body,
+            {"Content-Type": "application/json"} if body else None,
+            timeout_s=self.timeout_s,
+        )
+        if code != 200:
+            raise ConnectionError(
+                f"shard server {self.endpoint}{path} answered {code}")
+        return json.loads(data) if data else {}
+
+    def get_many(self, pk_values_list: list[list[Any]]) -> list[dict | None]:
+        return self._exchange("POST", "/get_many",
+                              {"pks": pk_values_list})["rows"]
+
+    def put_dataframe(self, df: pd.DataFrame, primary_key: list[str]) -> int:
+        recs = df.to_dict(orient="records")
+        return int(self._exchange("POST", "/put",
+                                  {"records": recs}).get("applied", 0))
+
+    def delete_keys(self, df: pd.DataFrame, primary_key: list[str]) -> None:
+        self._exchange("POST", "/delete",
+                       {"records": df.to_dict(orient="records")})
+
+    def scan(self) -> Iterator[dict]:
+        yield from self._exchange("GET", "/scan")["rows"]
+
+    def count(self) -> int:
+        return int(self._exchange("GET", "/stats")["rows"])
+
+    def close(self) -> None:
+        self._pool.close()
+
+
 class ShardedOnlineStore:
     """N ``OnlineStore`` shards keyed by ``crc32(primary key) % N``.
 
@@ -172,9 +227,13 @@ class ShardedOnlineStore:
         breaker_reset_s: float = 5.0,
         fanout: bool = True,
         hedge: bool = True,
+        endpoints: list[str] | None = None,
+        rpc_timeout_s: float = 5.0,
     ):
         if not primary_key:
             raise ValueError("ShardedOnlineStore needs a primary_key")
+        if endpoints is not None and not endpoints:
+            raise ValueError("endpoints= must name at least one shard server")
         if shards < 1:
             raise ValueError(f"shards must be >= 1, got {shards}")
         self.name = name
@@ -185,34 +244,48 @@ class ShardedOnlineStore:
         d = Path(root) if root is not None else storage.feature_store_root() / "online"
         d.mkdir(parents=True, exist_ok=True)
         self._dir = d
-        # The shard layout is part of the data: crc32(key) % N only
-        # finds a row under the N it was written with. The first opener
-        # persists its layout; later openers (serving replicas, other
-        # processes) ADOPT it — a differing ``shards=`` argument would
-        # otherwise silently read misses for most keys.
-        meta_path = d / f"{self.label}.meta.json"
-        if meta_path.exists():
-            meta = json.loads(meta_path.read_text())
-            if [k.lower() for k in meta.get("primary_key", [])] != self.primary_key:
-                raise ValueError(
-                    f"online store {self.label} was created with primary key "
-                    f"{meta.get('primary_key')}, not {self.primary_key}"
-                )
-            if int(meta["shards"]) != int(shards):
-                log.info(
-                    "online store %s: adopting persisted shard count %d "
-                    "(requested %d)", self.label, meta["shards"], shards,
-                )
-            shards = int(meta["shards"])
+        if endpoints is not None:
+            # PLACED mode: each shard is a remote shardd server (placed
+            # on some host by the placement layer); the shard count IS
+            # the endpoint list — the placement that spawned the
+            # servers owns the layout, so the local meta file is not
+            # consulted. Everything else (crc32 routing, per-shard
+            # breakers, fan-out, hedging) is identical to local mode.
+            shards = len(endpoints)
+            self._shards: list[Any] = [
+                _RemoteShard(ep, timeout_s=rpc_timeout_s) for ep in endpoints
+            ]
         else:
-            tmp = meta_path.with_suffix(".meta.tmp")
-            tmp.write_text(json.dumps(
-                {"shards": int(shards), "primary_key": self.primary_key}
-            ))
-            os.replace(tmp, meta_path)
-        self._shards = [
-            OnlineStore(d / f"{self.label}.shard{i}") for i in range(int(shards))
-        ]
+            # The shard layout is part of the data: crc32(key) % N only
+            # finds a row under the N it was written with. The first
+            # opener persists its layout; later openers (serving
+            # replicas, other processes) ADOPT it — a differing
+            # ``shards=`` argument would otherwise silently read misses
+            # for most keys.
+            meta_path = d / f"{self.label}.meta.json"
+            if meta_path.exists():
+                meta = json.loads(meta_path.read_text())
+                if [k.lower() for k in meta.get("primary_key", [])] != self.primary_key:
+                    raise ValueError(
+                        f"online store {self.label} was created with primary key "
+                        f"{meta.get('primary_key')}, not {self.primary_key}"
+                    )
+                if int(meta["shards"]) != int(shards):
+                    log.info(
+                        "online store %s: adopting persisted shard count %d "
+                        "(requested %d)", self.label, meta["shards"], shards,
+                    )
+                shards = int(meta["shards"])
+            else:
+                tmp = meta_path.with_suffix(".meta.tmp")
+                tmp.write_text(json.dumps(
+                    {"shards": int(shards), "primary_key": self.primary_key}
+                ))
+                os.replace(tmp, meta_path)
+            self._shards = [
+                OnlineStore(d / f"{self.label}.shard{i}")
+                for i in range(int(shards))
+            ]
         # One breaker per shard: a dead shard fails fast (its keys read
         # as missing) instead of stalling every request that hashes into
         # it; the half-open probe heals it when the backend recovers.
@@ -954,6 +1027,16 @@ def validate_feature_config(cfg: dict[str, Any]) -> dict[str, Any]:
             raise ValueError(
                 f"feature_config group {g['name']!r} needs a primary_key"
             )
+        eps = g.get("endpoints")
+        if eps is not None and (
+            not isinstance(eps, list)
+            or not eps
+            or not all(isinstance(e, str) and e.startswith("http") for e in eps)
+        ):
+            raise ValueError(
+                f"feature_config group {g['name']!r} endpoints must be a "
+                f"non-empty list of http URLs, got {eps!r}"
+            )
     if not cfg.get("order") and not all(g.get("features") for g in groups):
         raise ValueError(
             "feature_config needs an explicit 'order' (output feature "
@@ -974,7 +1057,10 @@ class FeatureJoinPredictor:
     join pass.
 
     ``feature_config`` keys: ``groups`` (list of ``{"name", "version",
-    "primary_key", "features", "shards", "ttl_s"}``), ``order`` (output
+    "primary_key", "features", "shards", "ttl_s", "endpoints"}`` —
+    ``endpoints`` lists placed shard-server URLs, turning the group's
+    store remote; see docs/operations.md "Multi-host placement"),
+    ``order`` (output
     feature order; default: concatenation of the groups' ``features``),
     ``missing`` (``default`` — substitute ``defaults[f]`` or
     ``default_value``; ``reject`` — fail the request; ``passthrough`` —
@@ -1017,6 +1103,11 @@ class FeatureJoinPredictor:
                     root=cfg.get("root"),
                     fanout=bool(g.get("fanout", cfg.get("fanout", True))),
                     hedge=bool(g.get("hedge", cfg.get("hedge", True))),
+                    # Placed shards: the group's shard-server endpoints
+                    # (placement wrote them into the serving config, so
+                    # subprocess fleet replicas join against the same
+                    # remote shards the local path would).
+                    endpoints=g.get("endpoints"),
                 )
             feats = [str(f).lower() for f in (g.get("features") or [])]
             self._groups.append((store, feats))

@@ -1,0 +1,46 @@
+"""Multi-host placement: host agents, a placement client, shard servers.
+
+Every distributed piece of the platform used to be single-host: fleet
+replicas were local ``Popen`` children of the ``ReplicaManager``,
+feature-store shards were local files, and the router only ever spoke
+to ``127.0.0.1``. This package is the control plane that removes that
+assumption — the TPU build's equivalent of the reference platform's
+jobs service (PAPER.md L6, ``jobs-client/``):
+
+- :mod:`~hops_tpu.jobs.placement.registry` — :class:`Host` +
+  :class:`HostRegistry`: the set of machines placement may use, from a
+  static list or a join-via-announce directory hostds heartbeat into.
+- :mod:`~hops_tpu.jobs.placement.hostd` — the per-host agent: a stdlib
+  HTTP daemon accepting spawn / drain / reap / kill / health verbs for
+  the UNITS on its host (``serving_host --fleet-worker`` replicas and
+  :mod:`~hops_tpu.jobs.placement.shardd` feature-shard servers).
+- :mod:`~hops_tpu.jobs.placement.client` — :class:`PlacementClient`:
+  what ``ReplicaManager`` (and through it the autoscaler and rollouts)
+  drives instead of local ``Popen``. Per-host circuit breakers,
+  deadlines on every RPC, and placement across the surviving hosts
+  when one dies — the ``placement.rpc`` fault point makes partitions
+  deterministically injectable.
+- :mod:`~hops_tpu.jobs.placement.shardd` — one feature-store shard
+  (``featurestore.online.OnlineStore``) behind HTTP, warm-startable
+  from a PR 8 snapshot manifest, jax-free so it starts in milliseconds.
+
+Data plane vs control plane: the placement client places units and
+manages their lifecycle; request traffic (router forwards, shard
+``multi_get`` fan-out) goes DIRECT to each unit's ``host:port`` — the
+hostd is never on the hot path.
+
+See docs/operations.md "Multi-host placement".
+"""
+
+from hops_tpu.jobs.placement.client import PlacedUnit, PlacementClient, PlacementError
+from hops_tpu.jobs.placement.hostd import Hostd
+from hops_tpu.jobs.placement.registry import Host, HostRegistry
+
+__all__ = [
+    "Host",
+    "HostRegistry",
+    "Hostd",
+    "PlacedUnit",
+    "PlacementClient",
+    "PlacementError",
+]

@@ -1,0 +1,249 @@
+"""shardd — one feature-store shard behind HTTP.
+
+``ShardedOnlineStore`` keys rows by ``crc32(primary key) % N`` and, in
+the single-host build, opens all N ``OnlineStore`` shard files locally.
+Placed mode keeps the client exactly as it is — routing, per-shard
+breakers, parallel fan-out, straggler hedging — and swaps each local
+shard for a remote one: an instance of this server, placed on some host
+by the :mod:`~hops_tpu.jobs.placement.hostd` agent.
+
+Deliberately **jax-free** (the import chain stops at
+``featurestore.online``): a shard server is a lookup daemon, and paying
+a multi-second jax initialization per shard would dominate every
+placement and chaos-heal latency. That is also why this is its own
+process model rather than a ``serving_host`` mode.
+
+Verbs (JSON in, JSON out, HTTP/1.1 keep-alive for the pool)::
+
+    GET  /healthz            {"status": "ok", "store", "shard", "rows"}
+    GET  /stats              {"rows": N}
+    POST /get_many {"pks": [[...], ...]}        -> {"rows": [row|null, ...]}
+    POST /put      {"records": [...]}           -> {"applied": N}
+    POST /delete   {"records": [...]}           -> {}
+    GET  /scan                                  -> {"rows": [...]}
+
+Warm start: a ``snapshot`` path in the config names a
+``ShardedOnlineStore.snapshot`` directory (PR 8's integrity-manifest
+format); the server verifies THIS shard's file against the manifest
+(size + SHA-256 — verify-before-trust) and loads it before serving, so
+a re-placed shard starts warm instead of empty.
+
+Config (``cfg.json`` for the CLI, a dict for in-process units)::
+
+    {"store": "profile", "version": 1, "shard_index": 0, "shards": 4,
+     "primary_key": ["uid"], "root": "/data/online", "port": 0,
+     "snapshot": "/data/snaps/profile_1"}        # optional
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any
+
+import pandas as pd
+
+from hops_tpu.featurestore.online import OnlineStore
+from hops_tpu.runtime.logging import get_logger
+
+log = get_logger(__name__)
+
+
+class SnapshotCorruptError(RuntimeError):
+    """A warm-start snapshot failed its manifest integrity check."""
+
+
+def _file_sha256(path: Path, chunk: int = 1 << 20) -> str:
+    # Local twin of runtime.checkpoint._file_sha256: importing that
+    # module would pull jax into every shard server.
+    h = hashlib.sha256()
+    with path.open("rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                return h.hexdigest()
+            h.update(block)
+
+
+class ShardServer:
+    """One ``OnlineStore`` shard served over HTTP (see module docs)."""
+
+    def __init__(self, cfg: dict[str, Any]):
+        self.store_name = cfg["store"]
+        self.version = int(cfg.get("version", 1))
+        self.shard_index = int(cfg["shard_index"])
+        self.n_shards = int(cfg.get("shards", 1))
+        self.primary_key = [k.lower() for k in cfg["primary_key"]]
+        self.label = f"{self.store_name}_{self.version}"
+        root = Path(cfg["root"])
+        root.mkdir(parents=True, exist_ok=True)
+        self._store = OnlineStore(
+            root / f"{self.label}.shard{self.shard_index}")
+        if cfg.get("snapshot"):
+            loaded = self.warm_start(cfg["snapshot"])
+            log.info("shardd %s shard %d: warm-started %d rows from %s",
+                     self.label, self.shard_index, loaded, cfg["snapshot"])
+        self._server = _make_server(
+            self, int(cfg.get("port", 0)), cfg.get("bind", "127.0.0.1"))
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"shardd-{self.label}-{self.shard_index}", daemon=True)
+        self._thread.start()
+
+    # -- warm start -----------------------------------------------------------
+
+    def warm_start(self, snapshot_dir: str | Path) -> int:
+        """Verify this shard's file against the snapshot manifest and
+        load its rows. Raises :class:`SnapshotCorruptError` on any
+        integrity mismatch — serving from a corrupt warm start is worse
+        than starting cold."""
+        d = Path(snapshot_dir)
+        manifest = json.loads((d / "manifest.json").read_text())
+        if int(manifest.get("shards", self.n_shards)) != self.n_shards:
+            raise SnapshotCorruptError(
+                f"snapshot {d} holds {manifest.get('shards')} shards, "
+                f"server expects {self.n_shards}")
+        fname = f"shard{self.shard_index}.jsonl"
+        meta = manifest.get("files", {}).get(fname)
+        if meta is None:
+            raise SnapshotCorruptError(f"snapshot {d} has no {fname}")
+        p = d / fname
+        try:
+            size = p.stat().st_size
+        except OSError as e:
+            raise SnapshotCorruptError(
+                f"snapshot {d}: {fname} unreadable ({e})") from None
+        if size != meta["size"]:
+            raise SnapshotCorruptError(
+                f"snapshot {d}: {fname} size {size} != manifest {meta['size']}")
+        if _file_sha256(p) != meta["sha256"]:
+            raise SnapshotCorruptError(
+                f"snapshot {d}: {fname} checksum mismatch")
+        with p.open() as f:
+            rows = [json.loads(line) for line in f if line.strip()]
+        return self._put_rows(rows)
+
+    # -- verb implementations -------------------------------------------------
+
+    def _put_rows(self, rows: list[dict]) -> int:
+        if not rows:
+            return 0
+        # Group by column signature (the ShardedOnlineStore contract):
+        # one put per homogeneous slice so a mixed batch never NaN-pads
+        # missing columns into stored rows.
+        by_cols: dict[frozenset, list[dict]] = {}
+        for rec in rows:
+            by_cols.setdefault(frozenset(rec), []).append(rec)
+        applied = 0
+        for recs in by_cols.values():
+            applied += self._store.put_dataframe(
+                pd.DataFrame(recs), self.primary_key)
+        return applied
+
+    def handle(self, method: str, path: str, body: dict) -> tuple[int, dict]:
+        if method == "GET" and path == "/healthz":
+            return 200, {"status": "ok", "store": self.label,
+                         "shard": self.shard_index,
+                         "rows": self._store.count()}
+        if method == "GET" and path == "/stats":
+            return 200, {"rows": self._store.count()}
+        if method == "GET" and path == "/scan":
+            return 200, {"rows": list(self._store.scan())}
+        if method == "POST" and path == "/get_many":
+            return 200, {"rows": self._store.get_many(body["pks"])}
+        if method == "POST" and path == "/put":
+            return 200, {"applied": self._put_rows(body["records"])}
+        if method == "POST" and path == "/delete":
+            if body.get("records"):
+                self._store.delete_keys(
+                    pd.DataFrame(body["records"]), self.primary_key)
+            return 200, {}
+        return 404, {"error": f"no such verb: {method} {path}"}
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._thread.join(timeout=5)
+        self._store.close()
+
+
+def _make_server(shard: ShardServer, port: int,
+                 bind: str = "127.0.0.1") -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"  # keep-alive: the pool's contract
+        disable_nagle_algorithm = True  # headers+body are separate writes; Nagle + delayed ACK stalls the body ~40 ms
+
+        def _reply(self, status: int, payload: dict) -> None:
+            data = json.dumps(payload, default=str).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _dispatch(self, method: str) -> None:
+            try:
+                body = {}
+                if method == "POST":
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                status, payload = shard.handle(method, self.path, body)
+            except Exception as e:  # noqa: BLE001 — a shard fault must reach the
+                # client as a 500 (breaker food), never kill the server thread
+                log.warning("shardd %s shard %d: %s %s failed: %s: %s",
+                            shard.label, shard.shard_index, method, self.path,
+                            type(e).__name__, e)
+                status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
+            self._reply(status, payload)
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+            self._dispatch("GET")
+
+        def do_POST(self):  # noqa: N802
+            self._dispatch("POST")
+
+        def log_message(self, fmt, *args):  # route through our logger
+            log.debug("shardd %s: " + fmt, shard.label, *args)
+
+    server = ThreadingHTTPServer((bind, port), Handler)
+    server.daemon_threads = True
+    return server
+
+
+def main(argv: list[str] | None = None) -> None:
+    """``python -m hops_tpu.jobs.placement.shardd DIR`` — host the shard
+    configured at ``DIR/cfg.json``, announce ``DIR/state.json``
+    atomically (the hostd polls for it), then wait for termination —
+    the ``serving_host --fleet-worker`` process model."""
+    parser = argparse.ArgumentParser(
+        prog="python -m hops_tpu.jobs.placement.shardd",
+        description=__doc__.split("\n")[0],
+    )
+    parser.add_argument("dir", help="unit directory holding cfg.json")
+    args = parser.parse_args(argv)
+
+    sigs = {signal.SIGTERM, signal.SIGINT}
+    signal.pthread_sigmask(signal.SIG_BLOCK, sigs)
+
+    udir = Path(args.dir)
+    cfg = json.loads((udir / "cfg.json").read_text())
+    server = ShardServer(cfg)
+    state = {"store": server.label, "shard": server.shard_index,
+             "port": server.port, "pid": os.getpid()}
+    tmp = udir / f".state.json.tmp{os.getpid()}"
+    tmp.write_text(json.dumps(state))
+    os.replace(tmp, udir / "state.json")
+    print(json.dumps(state), flush=True)
+    signal.sigwait(sigs)
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -79,10 +79,12 @@ class ServingFleet:
         autoscale_interval_s: float = 1.0,
         rate_limits: dict[str, dict[str, float]] | None = None,
         spawn_timeout_s: float = 60.0,
+        placement: Any = None,
         **router_kwargs: Any,
     ):
         self.manager = ReplicaManager(
-            name, inprocess=inprocess, spawn_timeout_s=spawn_timeout_s)
+            name, inprocess=inprocess, spawn_timeout_s=spawn_timeout_s,
+            placement=placement)
         self.router = None
         self.autoscaler = None
         try:

@@ -117,9 +117,17 @@ class Autoscaler:
     # -- the decision loop ----------------------------------------------------
 
     def tick(self) -> str | None:
-        """One evaluation: reap finished drains, heal below-minimum,
-        then judge load. Returns the action taken (``"up"`` | ``"down"``
-        | ``"reap"`` | ``"heal"`` | None) — tests drive this directly."""
+        """One evaluation: reconcile placed liveness, reap finished
+        drains, heal below-minimum, then judge load. Returns the action
+        taken (``"up"`` | ``"down"`` | ``"reap"`` | ``"heal"`` | None)
+        — tests drive this directly."""
+        # Placed fleets first sweep for replicas whose HOST died (no
+        # local SIGCHLD): reconcile marks them failed, which drops the
+        # live count below target and turns this very tick into a heal
+        # — re-placement lands on the surviving hosts.
+        reconcile = getattr(self.manager, "reconcile", None)
+        if reconcile is not None:
+            reconcile()
         self._reap_drained()
         live = [r for r in self.manager.replicas()
                 if r.state in ("ready", "starting")]

@@ -63,19 +63,26 @@ class FleetSpawnError(RuntimeError):
 
 @dataclasses.dataclass
 class Replica:
-    """One serving worker of the fleet (process- or thread-hosted)."""
+    """One serving worker of the fleet (process-, thread-, or
+    placement-hosted). ``host`` is where its serving port lives —
+    loopback for local workers, the placing host's address for placed
+    ones; the router forwards to ``host:port`` either way."""
 
     rid: str
     version: int | None
     state: str = "starting"
+    host: str = "127.0.0.1"
     port: int | None = None
     proc: subprocess.Popen | None = None
     server: Any = None  # in-process serving._RunningServing
+    unit: Any = None  # placement.PlacedUnit for placed replicas
     spawned_at: float = 0.0
 
     @property
     def pid(self) -> int | None:
-        return self.proc.pid if self.proc is not None else None
+        if self.proc is not None:
+            return self.proc.pid
+        return self.unit.pid if self.unit is not None else None
 
 
 class ReplicaManager:
@@ -88,12 +95,20 @@ class ReplicaManager:
     """
 
     def __init__(self, name: str, *, inprocess: bool = False,
-                 spawn_timeout_s: float = 60.0):
+                 spawn_timeout_s: float = 60.0, placement: Any = None):
         reg = serving._load_registry()
         if name not in reg:
             raise KeyError(f"serving {name!r} not found — create_or_update first")
+        if placement is not None and inprocess:
+            raise ValueError("placement= and inprocess=True are exclusive: "
+                             "a placed replica lives on its host's agent")
         self.name = name
         self.inprocess = inprocess
+        #: A ``jobs.placement.PlacementClient``: replicas spawn on the
+        #: registry's hosts via their hostd agents instead of local
+        #: ``Popen`` — the autoscaler and rollouts ride through
+        #: unchanged, they only ever call spawn/drain/reap here.
+        self.placement = placement
         self.spawn_timeout_s = spawn_timeout_s
         self._lock = threading.Lock()
         self._replicas: dict[str, Replica] = {}  # guarded by: self._lock
@@ -181,7 +196,9 @@ class ReplicaManager:
             faultinject.fire("fleet.spawn")  # chaos point
             cfg = self._replica_cfg(version)
             rep.version = cfg.get("model_version")
-            if self.inprocess:
+            if self.placement is not None:
+                self._spawn_placed(rep, cfg)
+            elif self.inprocess:
                 rep.server = serving._RunningServing(cfg)
                 rep.port = rep.server.port
             else:
@@ -264,6 +281,17 @@ class ReplicaManager:
             f"within {self.spawn_timeout_s}s"
         )
 
+    def _spawn_placed(self, rep: Replica, cfg: dict[str, Any]) -> None:
+        """Place the replica on some registry host via its hostd agent
+        (the client picks the least-placed healthy host and retries on
+        survivors when one dies — ``placement.rpc`` faults land
+        there). The worker is the same ``serving_host --fleet-worker``
+        process; only who spawned it changes."""
+        unit = self.placement.spawn("replica", cfg)
+        rep.unit = unit
+        rep.host = unit.address
+        rep.port = unit.port
+
     def wait_ready(self, rid: str, timeout_s: float | None = None) -> Replica:
         """Block until the replica's ``/healthz`` answers 200, then mark
         it ``ready``. Raises :class:`FleetSpawnError` on timeout."""
@@ -326,7 +354,7 @@ class ReplicaManager:
             return "unreachable", {}
         try:
             with urllib.request.urlopen(
-                f"http://127.0.0.1:{rep.port}/healthz", timeout=2.0
+                f"http://{rep.host}:{rep.port}/healthz", timeout=2.0
             ) as resp:
                 return "ok", json.loads(resp.read())
         except urllib.error.HTTPError as e:
@@ -358,8 +386,11 @@ class ReplicaManager:
         if rep.server is not None:
             rep.server.drain()
         elif rep.port is not None:
+            # Placed replicas drain by the SAME direct POST (the drain
+            # is the replica's own admission flip, not a host-lifecycle
+            # action) — the hostd only owns spawn/reap/kill.
             req = urllib.request.Request(
-                f"http://127.0.0.1:{rep.port}/admin/drain", data=b"{}",
+                f"http://{rep.host}:{rep.port}/admin/drain", data=b"{}",
                 headers={"Content-Type": "application/json"},
             )
             try:
@@ -393,6 +424,15 @@ class ReplicaManager:
         if rep.server is not None:
             rep.server.stop()
             rep.server = None
+        if rep.unit is not None:
+            try:
+                self.placement.reap(rep.unit)
+            except Exception as e:  # noqa: BLE001 — a dead/partitioned host's
+                # units are already gone; reap must stay idempotent
+                log.warning("fleet %s: placed replica %s reap via %s failed "
+                            "(host dead?): %s", self.name, rep.rid,
+                            rep.unit.host.name, e)
+            rep.unit = None
         if rep.proc is not None and rep.proc.poll() is None:
             rep.proc.terminate()
             try:
@@ -423,6 +463,15 @@ class ReplicaManager:
         if rep.proc is not None and rep.proc.poll() is None:
             os.kill(rep.proc.pid, signal.SIGKILL)
             rep.proc.wait(timeout=10)
+        if rep.unit is not None:
+            try:
+                self.placement.kill(rep.unit)
+            except Exception as e:  # noqa: BLE001 — chaos may have taken the
+                # whole host with it; the unit is dead either way
+                log.warning("fleet %s: placed replica %s kill via %s failed "
+                            "(host dead?): %s", self.name, rep.rid,
+                            rep.unit.host.name, e)
+            rep.unit = None
         if rep.server is not None:
             rep.server.stop()
             rep.server = None
@@ -432,6 +481,34 @@ class ReplicaManager:
         self._forget(rid)
         self._publish_states()
         log.warning("fleet %s: replica %s KILLED (chaos)", self.name, rid)
+
+    def reconcile(self) -> list[str]:
+        """Placed-fleet liveness sweep: a replica whose HOST died takes
+        no SIGCHLD here — nothing local notices. Probe each placed
+        ready/starting replica; the unreachable ones are marked failed
+        and forgotten, so the replica count drops and the autoscaler's
+        next tick re-places them on the surviving hosts. Local fleets
+        (no placement client) are a no-op. Returns the failed rids."""
+        if self.placement is None:
+            return []
+        failed: list[str] = []
+        for rep in self.replicas():
+            if rep.unit is None or rep.state not in ("starting", "ready"):
+                continue
+            if self._probe(rep)[0] != "unreachable":
+                continue
+            rep.state = "failed"
+            rep.unit = None  # its host is gone; nothing left to reap
+            flight.record("replica_state", model=self.name,
+                          rid=rep.rid, state="failed", how="reconcile")
+            self._forget(rep.rid)
+            failed.append(rep.rid)
+            log.warning("fleet %s: placed replica %s on %s:%s unreachable — "
+                        "marked failed for re-placement", self.name, rep.rid,
+                        rep.host, rep.port)
+        if failed:
+            self._publish_states()
+        return failed
 
     def commit_version(self, version: int | None) -> None:
         """Persist a completed rollout's version into the serving

@@ -153,6 +153,13 @@ _m_probation = REGISTRY.gauge(
     "Replicas currently in latency probation, per endpoint",
     labels=("model",),
 )
+_m_synthetic_probes = REGISTRY.counter(
+    "hops_tpu_fleet_synthetic_probes_total",
+    "Shadow probes fired with bodies materialized from the "
+    "probe_workload capture artifact (probation re-admission when no "
+    "live traffic flows), per endpoint",
+    labels=("model",),
+)
 _m_qos_shed = REGISTRY.counter(
     "hops_tpu_fleet_qos_shed_total",
     "Requests refused by QoS policy, per endpoint, class, and reason "
@@ -555,11 +562,20 @@ class Router:
         ejection: EjectionPolicy | dict[str, Any] | None = None,
         brownout: qos.BrownoutPolicy | dict[str, Any] | None = None,
         attempt_workers: int = 128,
+        probe_workload: Any = None,
         port: int = 0,
         clock=time.monotonic,
     ):
         self.manager = manager
         self.name = manager.name
+        #: Capture/synthesis artifact dir (telemetry.workload) whose
+        #: recorded requests become SYNTHETIC shadow-probe bodies: a
+        #: probation replica on a quiet fleet would otherwise never be
+        #: probed again (probes piggyback on live traffic) and sit
+        #: ejected forever. None = live-traffic probes only.
+        self.probe_workload = probe_workload
+        self._probe_bodies: list[bytes] | None = None  # lazy; [] = unusable
+        self._probe_body_idx = 0
         self.scrape_interval_s = scrape_interval_s
         self.forward_timeout_s = forward_timeout_s
         self.max_attempts = max_attempts
@@ -629,6 +645,7 @@ class Router:
             # (benches, sibling services) reuses connections; every
             # reply frames itself with an explicit Content-Length.
             protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True  # headers+body are separate writes; Nagle + delayed ACK stalls the body ~40 ms
 
             def log_message(self, *args: Any) -> None:  # silence stderr spam
                 pass
@@ -856,6 +873,13 @@ class Router:
 
     # -- views / telemetry scrape ---------------------------------------------
 
+    @staticmethod
+    def _rep_host(rep: Any) -> str:
+        """Where a replica's serving port lives. Placed replicas carry
+        their host's address; local (and duck-typed test) replicas
+        default to loopback."""
+        return getattr(rep, "host", None) or "127.0.0.1"
+
     def _view(self, rid: str) -> _ReplicaView:
         with self._views_lock:
             view = self._views.get(rid)
@@ -874,6 +898,7 @@ class Router:
             try:
                 self._eject_tick()
                 self._brownout_tick()
+                self._synthetic_probe_tick()
             except Exception:  # noqa: BLE001 — detectors must not kill the loop
                 log.exception("fleet %s: gray-failure tick failed", self.name)
 
@@ -894,7 +919,7 @@ class Router:
             if rep.state not in ("ready", "starting") or rep.port is None:
                 continue
             view = self._view(rep.rid)
-            snap = self._scrape_replica(rep.port)
+            snap = self._scrape_replica(self._rep_host(rep), rep.port)
             if snap is None:
                 view.scrape_ok = False
                 continue
@@ -919,7 +944,8 @@ class Router:
         "hops_tpu_workload_capture_active",
     )
 
-    def _scrape_replica(self, port: int) -> dict[str, float] | None:
+    def _scrape_replica(self, host: str,
+                        port: int) -> dict[str, float] | None:
         timeout = max(0.5, self.scrape_interval_s * 2)
 
         def fetch() -> tuple[int, bytes, dict[str, str]]:
@@ -927,7 +953,7 @@ class Router:
             faultinject.fire("router.scrape", key=port)
             return self.pool.request(
                 "GET",
-                f"http://127.0.0.1:{port}/metrics.json"
+                f"http://{host}:{port}/metrics.json"
                 f"?families={','.join(self._SCRAPE_FAMILIES)}",
                 timeout_s=timeout,
             )
@@ -1124,7 +1150,7 @@ class Router:
                 except Exception as e:
                     raise urllib.error.URLError(e) from e
                 code, payload, headers = self._forward(
-                    rep.port, body, extra_headers)
+                    self._rep_host(rep), rep.port, body, extra_headers)
                 fspan.annotate(status=code)
         except (OSError, urllib.error.URLError) as e:
             # Transport failure: the replica is gone or wedged —
@@ -1202,7 +1228,8 @@ class Router:
                             except Exception as e:
                                 raise urllib.error.URLError(e) from e
                             code, payload, headers = self._forward(
-                                rep.port, body, extra_headers)
+                                self._rep_host(rep), rep.port, body,
+                                extra_headers)
                             fspan.annotate(status=code)
                     except (OSError, urllib.error.URLError) as e:
                         err = e
@@ -1259,7 +1286,7 @@ class Router:
             return self._attempt_pool
 
     def _forward(
-        self, port: int, body: bytes,
+        self, host: str, port: int, body: bytes,
         extra_headers: dict[str, str] | None = None,
     ) -> tuple[int, bytes, dict[str, str]]:
         headers = {"Content-Type": "application/json", **(extra_headers or {})}
@@ -1272,7 +1299,7 @@ class Router:
         # routing input, never exceptions). Bodies stay raw bytes.
         code, data, resp_headers = self.pool.request(
             "POST",
-            f"http://127.0.0.1:{port}/v1/models/{self.name}:predict",
+            f"http://{host}:{port}/v1/models/{self.name}:predict",
             body=body, headers=headers, timeout_s=self.forward_timeout_s,
         )
         if code >= 400 and not data:
@@ -1423,8 +1450,56 @@ class Router:
         self._m_probation.set(
             sum(1 for v in views if v.probation))
 
+    def _probe_body_pool(self) -> list[bytes]:
+        """Synthetic probe bodies from the ``probe_workload`` artifact,
+        materialized lazily on the first probation that needs one: up to
+        32 captured requests, deterministically re-materialized
+        (``materialize_payload`` seed 0 — the same bodies across router
+        restarts). An unusable artifact logs once and leaves the pool
+        empty; live-traffic probes keep working."""
+        if self._probe_bodies is None:
+            bodies: list[bytes] = []
+            if self.probe_workload is not None:
+                try:
+                    from hops_tpu.telemetry.workload import (
+                        load_artifact, materialize_payload)
+
+                    art = load_artifact(self.probe_workload)
+                    for rec in art["records"][:32]:
+                        bodies.append(json.dumps(
+                            materialize_payload(rec, seed=0)
+                        ).encode())
+                except Exception:  # noqa: BLE001 — probes are optional
+                    log.exception(
+                        "fleet %s: probe_workload %s unusable — "
+                        "synthetic probes disabled",
+                        self.name, self.probe_workload)
+            self._probe_bodies = bodies
+        return self._probe_bodies
+
+    def _synthetic_probe_tick(self) -> None:
+        """Scrape-loop hook: probation replicas on a QUIET fleet get
+        shadow probes with synthetic bodies from the captured-workload
+        pool — without this, probes only piggyback on live requests and
+        a zero-traffic probation is a life sentence. The per-view probe
+        cadence inside :meth:`_maybe_shadow_probe` dedups against live
+        traffic: a busy router's probation views are already inside
+        their probe interval, so this tick fires nothing extra."""
+        if not self.ejection.enabled or self.probe_workload is None:
+            return
+        with self._views_lock:
+            if not any(v.probation for v in self._views.values()):
+                return
+        pool = self._probe_body_pool()
+        if not pool:
+            return
+        body = pool[self._probe_body_idx % len(pool)]
+        self._probe_body_idx += 1
+        self._maybe_shadow_probe(body, None, synthetic=True)
+
     def _maybe_shadow_probe(
-        self, body: bytes, extra_headers: dict[str, str] | None
+        self, body: bytes, extra_headers: dict[str, str] | None,
+        synthetic: bool = False,
     ) -> None:
         """Probation replicas are re-judged with SHADOW traffic: a copy
         of a live (idempotent) request, fired after the real reply went
@@ -1442,6 +1517,8 @@ class Router:
             if now - view.last_probe_mono < self.ejection.probe_interval_s:
                 continue
             view.last_probe_mono = now
+            if synthetic:
+                _m_synthetic_probes.inc(model=self.name)
             threading.Thread(
                 target=self._shadow_probe, args=(rep, view, body,
                                                  extra_headers),
@@ -1457,7 +1534,8 @@ class Router:
         try:
             code, _, _ = self.pool.request(
                 "POST",
-                f"http://127.0.0.1:{rep.port}/v1/models/{self.name}:predict",
+                f"http://{self._rep_host(rep)}:{rep.port}"
+                f"/v1/models/{self.name}:predict",
                 body=body, headers=headers,
                 timeout_s=self.ejection.probe_timeout_s,
             )

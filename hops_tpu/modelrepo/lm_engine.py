@@ -296,7 +296,15 @@ class LMEngine:
         kv_page_size: int | None = None,
         kv_pool_blocks: int | None = None,
         prefill_chunk: int | None = None,
+        max_queue: int = 1024,
     ):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        #: Admission bound on :meth:`submit`: beyond this many queued
+        #: requests submit raises :class:`~hops_tpu.runtime.qos.QueueFullError`
+        #: (a ShedError) — backpressure surfaces at the door as a typed
+        #: 503 instead of an unbounded deque eating the host.
+        self.max_queue = int(max_queue)
         if not getattr(model, "ragged_decode", False):
             raise ValueError(
                 "LMEngine requires TransformerLM(ragged_decode=True) — "
@@ -1581,6 +1589,14 @@ class LMEngine:
                     f"(kv_pool_blocks={self._pool.num_blocks}, "
                     f"page={self._page_size})"
                 )
+        # Admission bound LAST: malformed requests above stay 400-shaped
+        # (ValueError); only a well-formed request at a full queue is a
+        # shed the client should retry.
+        if len(self._queue) >= self.max_queue:
+            raise qos.QueueFullError(
+                f"submit queue full ({len(self._queue)}/{self.max_queue} "
+                f"queued); retry later"
+            )
         seed = int(seed) & 0x7FFFFFFF  # fold into int32 before it hits jit
         ticket = self._next_ticket
         self._next_ticket += 1

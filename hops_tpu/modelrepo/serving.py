@@ -231,6 +231,9 @@ class LMEnginePredictor:
             kv_pool_blocks=(
                 int(cfg["kv_pool_blocks"]) if cfg.get("kv_pool_blocks") else None
             ),
+            # Bounded admission: a full submit queue rejects with a
+            # typed QueueFullError -> 503 reason="overload".
+            max_queue=int(cfg.get("max_queue", 1024)),
             prefill_chunk=(
                 int(cfg["prefill_chunk"]) if cfg.get("prefill_chunk") else None
             ),
@@ -757,6 +760,7 @@ class _RunningServing:
             # Keep-alive for the router's persistent-connection pool:
             # every reply frames itself with an explicit Content-Length.
             protocol_version = "HTTP/1.1"
+            disable_nagle_algorithm = True  # headers+body are separate writes; Nagle + delayed ACK stalls the body ~40 ms
 
             def log_message(self, *args: Any) -> None:  # silence stderr spam
                 pass
@@ -1002,10 +1006,16 @@ class _RunningServing:
                             preds = predictor.predict(instances)
                 except qos.ShedError as e:
                     # Evicted from the batch queue by higher-priority
-                    # work: a shed, not a failure — no breaker strike,
-                    # same 503 retry shape as every other shed.
-                    m_shed.inc(model=name, reason="qos")
-                    tspan.annotate(shed="qos")
+                    # work (reason="qos") or refused at a full submit
+                    # queue (QueueFullError, reason="overload"): a
+                    # shed, not a failure — no breaker strike, same
+                    # 503 retry shape as every other shed.
+                    reason = (
+                        "overload" if isinstance(e, qos.QueueFullError)
+                        else "qos"
+                    )
+                    m_shed.inc(model=name, reason=reason)
+                    tspan.annotate(shed=reason)
                     self._reply(
                         503, self._maybe_debug(
                             {"error": f"{type(e).__name__}: {e}"}, tspan),

@@ -11,6 +11,9 @@ from hops_tpu.parallel import grad_comms, mesh, multihost, strategy  # noqa: F40
 from hops_tpu.parallel.grad_comms import (  # noqa: F401
     GradCommsConfig,
     all_reduce_grads,
+    hier_all_gather,
+    hier_reduce_scatter,
+    psum_hierarchical,
     psum_quantized,
     sharded_apply_gradients,
     tag_backward_comms,

@@ -46,6 +46,21 @@ control of that traffic with three composable optimizations:
    autodiff transpose IS the as-ready reduce-scatter. All exact for
    elementwise optimizers; all composing with the quantized wire.
 
+5. **Hierarchy-aware collectives** (``hierarchy=H``): on a multi-host
+   mesh the flat ring all-reduce crosses the slow inter-host fabric
+   (DCN) once per hop — N-1 crossings per byte. Setting ``hierarchy``
+   to the host count reschedules every gradient reduction as
+   intra-host all-to-all (ICI) → inter-host all-to-all (one DCN
+   crossing per byte) → local fold in **global rank order** →
+   intra-host then inter-host all-gather. Because the schedule moves
+   addends instead of summing partial results per phase, the fold
+   reproduces XLA's flat rank-order accumulation exactly: the
+   hierarchical path is **bit-identical** to the flat one, composes
+   with the quantized wire (the two ``_wire`` hops sit at the same
+   points) and with the overlap hooks, and its reduce-scatter half
+   (:func:`hier_reduce_scatter`) drops into the ZeRO-1/2 update.
+   Leaf-level entry point: :func:`psum_hierarchical`.
+
 Everything here runs inside ``shard_map`` over the strategy's data
 axis — ``Strategy.step(fn, grad_comms=cfg)`` does the wrapping, and
 ``models.common.make_train_step(grad_comms=cfg)`` builds a step that
@@ -122,6 +137,10 @@ class GradCommsConfig:
     bucket_bytes: int = DEFAULT_BUCKET_BYTES
     overlap: bool = False
     local_only: bool = False  # bench-only: no reduction (compute-time probe)
+    #: Host count for hierarchy-aware collectives: 0 = flat (single
+    #: fabric), >= 2 = intra-host reduce then one inter-host exchange
+    #: per byte. Bit-identical to flat; requires replica count % hosts == 0.
+    hierarchy: int = 0
 
     def __post_init__(self):
         if self.update_sharding not in (
@@ -138,9 +157,23 @@ class GradCommsConfig:
                 "zero2/zero3 overlap by construction and zero1 "
                 "(cross_replica) reduce-scatters at update time"
             )
-        if self.local_only and (self.overlap or self.update_sharding != "replicated"):
+        if self.local_only and (self.overlap or self.hierarchy
+                                or self.update_sharding != "replicated"):
             raise ValueError("local_only is a bench timing reference; "
                              "combine it with nothing")
+        if self.hierarchy:
+            if self.hierarchy < 2:
+                raise ValueError(
+                    "hierarchy counts hosts: 0 (flat) or >= 2, got "
+                    f"{self.hierarchy}"
+                )
+            if self.update_sharding == "zero3":
+                raise ValueError(
+                    "hierarchy composes with the replicated/zero1/zero2 "
+                    "updates; zero3's reduce-scatter is autodiff's "
+                    "transpose of the param gather and cannot be "
+                    "rescheduled"
+                )
 
     @property
     def zero_stage(self) -> int:
@@ -156,6 +189,8 @@ class GradCommsConfig:
         parts = []
         if self.quantize:
             parts.append("quantized")
+        if self.hierarchy:
+            parts.append("hier")
         if self.overlap:
             parts.append("overlap")
         if self.zero_stage:
@@ -180,6 +215,12 @@ class GradCommsConfig:
             "quantized+zero2": cls(quantize=True, update_sharding="zero2"),
             "zero3": cls(update_sharding="zero3"),
             "quantized+zero3": cls(quantize=True, update_sharding="zero3"),
+            "hier": cls(hierarchy=2),
+            "quantized+hier": cls(quantize=True, hierarchy=2),
+            "hier+overlap": cls(hierarchy=2, overlap=True),
+            "quantized+hier+overlap": cls(
+                quantize=True, hierarchy=2, overlap=True),
+            "hier+zero1": cls(hierarchy=2, update_sharding="cross_replica"),
         }
         if mode not in known:
             raise ValueError(
@@ -237,6 +278,126 @@ def _wire(x: jax.Array, block_size: int, qdtype: Any) -> jax.Array:
     return dequantize_blockwise(q, scales, x.size, x.shape, x.dtype)
 
 
+# -- hierarchy-aware collectives ----------------------------------------------
+#
+# The flat reduce-scatter ring crosses the inter-host fabric (DCN) on
+# N-1 of its N hops — every byte pays the slow link N-1 times. The
+# hierarchical schedule below pays it once: tiles first shuffle inside
+# each host over ICI (all-to-all within the intra groups), then exactly
+# one tile-sized exchange crosses hosts (all-to-all within the inter
+# groups), and the reduction itself is a LOCAL fold over the collected
+# addends. Folding in global rank order is what buys bit-identity: XLA's
+# flat psum/psum_scatter accumulates contributions sequentially in rank
+# order, and a movement-only schedule that delivers every rank's addend
+# can reproduce that order exactly — whereas summing per phase (the
+# textbook two-level all-reduce) reassociates the sum and drifts ~1 ulp.
+# Mesh ranks are host-major: rank = host * local + device_on_host, the
+# order `parallel.mesh.make_mesh` lays devices out in.
+
+
+def hier_groups(
+    n: int, hosts: int
+) -> tuple[list[list[int]], list[list[int]]]:
+    """(intra, inter) ``axis_index_groups`` for ``n`` host-major ranks on
+    ``hosts`` hosts: intra groups are the ranks sharing a host, inter
+    groups link the k-th device of every host."""
+    if hosts < 2:
+        raise ValueError(f"hierarchy needs >= 2 hosts, got {hosts}")
+    if n % hosts:
+        raise ValueError(
+            f"replica count {n} not divisible by hierarchy={hosts} hosts"
+        )
+    local = n // hosts
+    intra = [[h * local + i for i in range(local)] for h in range(hosts)]
+    inter = [[h * local + i for h in range(hosts)] for i in range(local)]
+    return intra, inter
+
+
+def hier_reduce_scatter(
+    flat: jax.Array, axis_name: Any, hosts: int
+) -> jax.Array:
+    """Hierarchical tiled reduce-scatter of a flat buffer (length a
+    multiple of the replica count): intra-host all-to-all, one
+    inter-host all-to-all, local fold in global rank order. Returns this
+    rank's ``len(flat)/N`` tile — **bit-identical** to
+    ``lax.psum_scatter(flat, axis_name, scatter_dimension=0,
+    tiled=True)``, so it drops into any flat schedule (the quantized
+    wire, the ZeRO-1/2 updates) without changing a single bit."""
+    n = lax.psum(1, axis_name)
+    intra, inter = hier_groups(n, hosts)
+    local = n // hosts
+    if flat.shape[0] % n:
+        raise ValueError(
+            f"buffer length {flat.shape[0]} not divisible by {n} replicas"
+        )
+    t = flat.reshape(hosts, local, -1)
+    # Phase 1 (ICI): within each host, devices swap tile rows so device
+    # k holds every host-mate's addends for the tiles k will own.
+    p1 = lax.all_to_all(
+        t, axis_name, split_axis=1, concat_axis=1, tiled=True,
+        axis_index_groups=intra,
+    )
+    # Phase 2 (DCN): the single inter-host exchange — host rows swap so
+    # each rank now holds ALL N addends for its own tile.
+    p2 = lax.all_to_all(
+        p1, axis_name, split_axis=0, concat_axis=0, tiled=True,
+        axis_index_groups=inter,
+    )
+    contrib = p2.reshape(n, -1)  # row s = rank s's addend for my tile
+    acc = contrib[0]
+    for s in range(1, n):  # fold-left in rank order = flat psum order
+        acc = acc + contrib[s]
+    return acc
+
+
+def hier_all_gather(
+    shard: jax.Array, axis_name: Any, hosts: int
+) -> jax.Array:
+    """Hierarchical tiled all-gather of per-rank tiles back to the full
+    buffer: intra-host gather FIRST (each host assembles its contiguous
+    tile block over ICI), then one inter-host gather concatenates the
+    host blocks. Pure movement — output equals the flat tiled
+    ``all_gather`` element for element. Gathering inter-first would
+    interleave tiles from different hosts and scramble the order."""
+    g1 = lax.all_gather(
+        shard, axis_name, tiled=True,
+        axis_index_groups=hier_groups(lax.psum(1, axis_name), hosts)[0],
+    )
+    return lax.all_gather(
+        g1, axis_name, tiled=True,
+        axis_index_groups=hier_groups(lax.psum(1, axis_name), hosts)[1],
+    )
+
+
+def psum_hierarchical(
+    x: jax.Array,
+    axis_name: Any,
+    *,
+    hosts: int = 2,
+    mean: bool = False,
+) -> jax.Array:
+    """Drop-in ``lax.psum`` with the hierarchical wire schedule —
+    bit-identical output (the local fold reproduces the flat rank-order
+    accumulation), one DCN crossing per byte instead of N-1. Must run
+    inside a ``shard_map`` carrying ``axis_name``; the replica count
+    must divide by ``hosts``. With one replica there is no wire and the
+    input comes straight back."""
+    n = lax.psum(1, axis_name)
+    if n == 1:
+        return x
+    shape, size = x.shape, x.size
+    flat = x.reshape(-1)
+    pad = (-size) % n  # zero padding is sum-neutral
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    part = hier_reduce_scatter(flat, axis_name, hosts)
+    out = hier_all_gather(part, axis_name, hosts)
+    out = out.reshape(-1)[:size].reshape(shape)
+    if mean:
+        out = out / n
+    return out
+
+
 def psum_quantized(
     x: jax.Array,
     axis_name: Any,
@@ -244,6 +405,7 @@ def psum_quantized(
     block_size: int = 256,
     qdtype: Any = jnp.int8,
     mean: bool = False,
+    hierarchy: int = 0,
 ) -> jax.Array:
     """Drop-in ``lax.psum`` with block-scaled quantization on the wire.
 
@@ -252,7 +414,10 @@ def psum_quantized(
     partial sums coming out) — the EQuARX schedule: accumulation stays
     full-precision, only wire bytes shrink. Must run inside a
     ``shard_map`` carrying ``axis_name``. With one replica there is no
-    wire, so the input is returned unquantized.
+    wire, so the input is returned unquantized. ``hierarchy`` >= 2
+    swaps the flat reduce-scatter / all-gather for the hierarchical
+    schedule — the ``_wire`` hops sit at the same two points, so the
+    composition is bit-identical to the flat quantized path.
     """
     n = lax.psum(1, axis_name)
     if n == 1:
@@ -264,9 +429,16 @@ def psum_quantized(
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
     flat = _wire(flat, block_size, qdtype)  # hop 1: local grads
-    part = lax.psum_scatter(flat, axis_name, scatter_dimension=0, tiled=True)
+    if hierarchy:
+        part = hier_reduce_scatter(flat, axis_name, hierarchy)
+    else:
+        part = lax.psum_scatter(
+            flat, axis_name, scatter_dimension=0, tiled=True)
     part = _wire(part, block_size, qdtype)  # hop 2: partial sums
-    out = lax.all_gather(part, axis_name, tiled=True)
+    if hierarchy:
+        out = hier_all_gather(part, axis_name, hierarchy)
+    else:
+        out = lax.all_gather(part, axis_name, tiled=True)
     out = out.reshape(-1)[:size].reshape(shape)
     if mean:
         out = out / n
@@ -367,8 +539,11 @@ def all_reduce_grads(
         floating = jnp.issubdtype(buf.dtype, jnp.floating)
         if cfg.quantize and floating and n > 1:
             r = psum_quantized(
-                buf, axis_name, block_size=cfg.block_size, qdtype=cfg.qdtype
+                buf, axis_name, block_size=cfg.block_size,
+                qdtype=cfg.qdtype, hierarchy=cfg.hierarchy,
             )
+        elif cfg.hierarchy and floating and n > 1:
+            r = psum_hierarchical(buf, axis_name, hosts=cfg.hierarchy)
         else:
             r = lax.psum(buf, axis_name)
         if mean and floating:
@@ -438,7 +613,11 @@ def sharded_apply_gradients(
     for buf in gbufs:
         if cfg.quantize and jnp.issubdtype(buf.dtype, jnp.floating):
             buf = _wire(buf, cfg.block_size, cfg.qdtype)
-        shard = lax.psum_scatter(buf, axis_name, scatter_dimension=0, tiled=True)
+        if cfg.hierarchy:
+            shard = hier_reduce_scatter(buf, axis_name, cfg.hierarchy)
+        else:
+            shard = lax.psum_scatter(
+                buf, axis_name, scatter_dimension=0, tiled=True)
         gshards.append(shard / n)
 
     # 2-4. Sharded optimizer tail on the same per-dtype bucket layout.
@@ -488,8 +667,11 @@ def _overlap_psum_hook(axis_name: Any, cfg: GradCommsConfig) -> Callable[[Any], 
             return (lax.psum(g, axis_name),)
         if cfg.quantize:
             r = psum_quantized(
-                g, axis_name, block_size=cfg.block_size, qdtype=cfg.qdtype
+                g, axis_name, block_size=cfg.block_size,
+                qdtype=cfg.qdtype, hierarchy=cfg.hierarchy,
             )
+        elif cfg.hierarchy:
+            r = psum_hierarchical(g, axis_name, hosts=cfg.hierarchy)
         else:
             r = lax.psum(g, axis_name)
         return (r / n,)
@@ -526,7 +708,11 @@ def _scatter_shard_hook(axis_name: Any, cfg: GradCommsConfig) -> Callable[[Any],
             flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
         if cfg.quantize and jnp.issubdtype(dtype, jnp.floating):
             flat = _wire(flat, cfg.block_size, cfg.qdtype)
-        shard = lax.psum_scatter(flat, axis_name, scatter_dimension=0, tiled=True)
+        if cfg.hierarchy:
+            shard = hier_reduce_scatter(flat, axis_name, cfg.hierarchy)
+        else:
+            shard = lax.psum_scatter(
+                flat, axis_name, scatter_dimension=0, tiled=True)
         if jnp.issubdtype(dtype, jnp.floating):
             shard = shard / n
         m = flat.shape[0] // n

@@ -79,6 +79,13 @@ docs/operations.md "Failure handling & fault injection"):
                         worker is created (an error fails that spawn
                         attempt; autoscaler/rollout retry policies own
                         the recovery)
+``placement.rpc``       every placement control-plane RPC, keyed by
+                        host name — client-side in
+                        ``PlacementClient._rpc`` (a partition: the
+                        verb never reaches the host) and agent-side in
+                        the hostd dispatcher. The per-host breaker
+                        ejects the partitioned host; spawns re-place
+                        on survivors
 ==================  ========================================================
 """
 
@@ -119,6 +126,7 @@ POINTS = (
     "router.scrape",
     "shard.lookup",
     "fleet.spawn",
+    "placement.rpc",
 )
 
 _MODES = ("error", "latency", "corrupt")

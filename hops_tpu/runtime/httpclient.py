@@ -38,6 +38,7 @@ connections actually survive between requests.
 from __future__ import annotations
 
 import http.client
+import socket
 import threading
 from typing import Any, Mapping
 from urllib.parse import urlsplit
@@ -111,6 +112,15 @@ class HTTPPool:
         for fresh_retry in (False, True):
             conn, reused = self._checkout(host, port, timeout_s)
             try:
+                if conn.sock is None:
+                    # http.client sends headers and body as separate
+                    # writes; with Nagle on, the body write stalls for
+                    # the peer's delayed ACK (~40 ms) once the
+                    # connection leaves quickack mode — on reused
+                    # keep-alives that stall dwarfs the request itself.
+                    conn.connect()
+                    conn.sock.setsockopt(
+                        socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 conn.request(method, path, body=body, headers=dict(headers or {}))
                 resp = conn.getresponse()
                 data = resp.read()

@@ -234,6 +234,13 @@ class ShedError(RuntimeError):
     :class:`BoundedPriorityQueue` shed (serving maps it to a 503)."""
 
 
+class QueueFullError(ShedError):
+    """Typed admission reject: a bounded submit queue is at capacity,
+    so the request is refused at the door instead of buffering
+    unboundedly. A load signal, not a failure — serving maps it to
+    503 ``reason="overload"`` with Retry-After, no breaker strike."""
+
+
 class StarvationGuard:
     """After ``limit`` consecutive higher-class picks while lower-class
     work waits, the next pick MUST take the most-starved class. One

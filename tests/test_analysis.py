@@ -1326,3 +1326,61 @@ def test_unbounded_priority_queue_scoped_to_serving_tiers(tmp_path):
     flagged = lint_code(tmp_path, code, rule="unbounded-priority-queue",
                         filename="hops_tpu/modelrepo/lm_engine.py")
     assert rule_names(flagged) == ["unbounded-priority-queue"]
+
+
+# -- hardcoded-loopback -------------------------------------------------------
+
+
+def test_hardcoded_loopback_flags_url_literals_and_fstrings(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        def predict(port, body):
+            url = f"http://127.0.0.1:{port}/predict"
+            return post(url, body)
+
+        FALLBACK = "http://localhost:9000/v1/models/m:predict"
+        """,
+        rule="hardcoded-loopback",
+        filename=FLEET_FILE,
+    )
+    assert rule_names(findings) == ["hardcoded-loopback"] * 2
+    assert "registered" in findings[0].message
+
+
+def test_hardcoded_loopback_must_not_flag_binds_defaults_or_logs(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        from http.server import ThreadingHTTPServer
+
+        def serve(port, handler):
+            # Binding a local server to loopback is correct — only a
+            # URL pins where a REQUEST goes.
+            return ThreadingHTTPServer(("127.0.0.1", port), handler)
+
+        def connect(host="127.0.0.1", port=0):
+            log.info("replica on %s:%d (localhost)", host, port)
+            return (host, port)
+        """,
+        rule="hardcoded-loopback",
+        filename=FLEET_FILE,
+    )
+    assert findings == []
+
+
+def test_hardcoded_loopback_scoped_to_multi_host_paths(tmp_path):
+    code = """
+    PROBE = "http://127.0.0.1:9090/healthz"
+    """
+    # httpclient is host-agnostic plumbing: callers pass full URLs, so a
+    # loopback literal there is a test fixture, not a routing decision.
+    (tmp_path / "hops_tpu" / "runtime").mkdir(parents=True)
+    (tmp_path / "hops_tpu" / "featurestore").mkdir(parents=True)
+    assert lint_code(tmp_path, code, rule="hardcoded-loopback",
+                     filename="hops_tpu/runtime/httpclient.py") == []
+    flagged = lint_code(tmp_path, code, rule="hardcoded-loopback",
+                        filename="hops_tpu/featurestore/online_serving.py")
+    assert rule_names(flagged) == ["hardcoded-loopback"]

@@ -335,6 +335,47 @@ class TestServingChaos:
         finally:
             serving.stop("chaos-brk")
 
+    def test_engine_queue_full_sheds_overload_without_breaker_strike(
+            self, tmp_path):
+        """A bounded submit queue refusing work (``qos.QueueFullError``
+        — the LM engine's ``max_queue`` admission bound) is a SHED, not
+        a failure: 503 + ``Retry-After``, ``reason="overload"``, and no
+        breaker strike — the model is healthy, just full."""
+        from hops_tpu.modelrepo import serving
+
+        script = tmp_path / "p.py"
+        script.write_text(
+            "from hops_tpu.runtime import qos\n"
+            "class Predict:\n"
+            "    def predict(self, instances):\n"
+            "        if instances and instances[0] == ['burst']:\n"
+            "            raise qos.QueueFullError('submit queue full "
+            "(2/2 queued); retry later')\n"
+            "        return instances\n"
+        )
+        serving.create_or_update(
+            "chaos-qfull", model_path=str(tmp_path), model_server="PYTHON",
+            resilience_config={"breaker_failures": 2})
+        serving.start("chaos-qfull")
+        port = serving._load_registry()["chaos-qfull"]["port"]
+        try:
+            before = _counter("hops_tpu_serving_shed_total",
+                              model="chaos-qfull", reason="overload")
+            for _ in range(3):  # would open the breaker if these struck
+                code, body, headers = _post(port, "chaos-qfull",
+                                            {"instances": [["burst"]]})
+                assert code == 503 and headers["Retry-After"] == "1"
+                assert "QueueFullError" in body["error"]
+            assert _counter("hops_tpu_serving_shed_total",
+                            model="chaos-qfull", reason="overload") \
+                == before + 3
+            # No breaker strike: the very next request serves.
+            code, body, _ = _post(port, "chaos-qfull", {"instances": [[5]]})
+            assert code == 200 and body["predictions"] == [[5]]
+            assert _healthz(port)[0] == 200
+        finally:
+            serving.stop("chaos-qfull")
+
 
 # -- search-trial and pubsub chaos --------------------------------------------
 

@@ -1158,6 +1158,7 @@ def _mini_server(delay_s=0.0, code=200, body=b'{"predictions": [[2]]}'):
 
     class H(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
+        disable_nagle_algorithm = True  # no delayed-ACK stall in timings
 
         def log_message(self, *a):
             pass
@@ -1465,6 +1466,115 @@ class TestEjection:
             r.stop()
             still_slow.shutdown()
             still_slow.server_close()
+
+
+class TestSyntheticProbes:
+    """Zero-traffic probation re-admission: with no live requests to
+    shadow, the scrape loop synthesizes probe bodies from a captured
+    workload artifact — otherwise a quiet fleet's probation is a life
+    sentence."""
+
+    def _workload(self, tmp_path):
+        from hops_tpu.telemetry.workload import WorkloadRecorder
+
+        rec = WorkloadRecorder(tmp_path / "cap")
+        for i in range(3):
+            rec.record(surface="router", endpoint="stub",
+                       payload={"instances": [[float(i), 2.0]]},
+                       instances=[[float(i), 2.0]], status=200,
+                       latency_ms=2.0)
+        rec.stop()
+        return tmp_path / "cap"
+
+    def _router(self, reps, probe_workload, **ej_kw):
+        from hops_tpu.modelrepo.fleet.router import EjectionPolicy
+
+        ej_kw.setdefault("min_samples", 4)
+        ej_kw.setdefault("floor_ms", 5.0)
+        ej_kw.setdefault("readmit_probes", 2)
+        ej_kw.setdefault("probe_interval_s", 0.01)
+        ej_kw.setdefault("readmit_slack_ms", 30.0)
+        return Router(_StubManager(reps), scrape_interval_s=30.0,
+                      ejection=EjectionPolicy(**ej_kw),
+                      probe_workload=probe_workload)
+
+    def test_zero_traffic_probation_readmitted_by_synthetic_probes(
+            self, tmp_path):
+        healed = _mini_server(delay_s=0.0)
+        reps = [_StubRep("a", 1), _StubRep("b", 2),
+                _StubRep("c", healed.server_address[1])]
+        r = self._router(reps, self._workload(tmp_path))
+        try:
+            _seed_latency(r, "a", 0.005)
+            _seed_latency(r, "b", 0.006)
+            _seed_latency(r, "c", 0.3)
+            r._eject_tick()
+            assert r._view("c").probation is True
+            base = REGISTRY.counter(
+                "hops_tpu_fleet_synthetic_probes_total", labels=("model",)
+            ).value(model="stub")
+            # The captured bodies re-materialize deterministically.
+            pool = r._probe_body_pool()
+            assert [json.loads(b) for b in pool] == [
+                {"instances": [[float(i), 2.0]]} for i in range(3)]
+            # NO live traffic at all: only the scrape-loop tick fires
+            # probes, and they alone must heal the replica.
+            deadline = time.monotonic() + 10
+            while r._view("c").probation and time.monotonic() < deadline:
+                r._synthetic_probe_tick()
+                time.sleep(0.02)
+            assert r._view("c").probation is False
+            assert "c" in [rep.rid for rep in r.routable()]
+            assert REGISTRY.counter(
+                "hops_tpu_fleet_synthetic_probes_total", labels=("model",)
+            ).value(model="stub") - base >= 2  # readmit_probes
+        finally:
+            r.stop()
+            healed.shutdown()
+            healed.server_close()
+
+    def test_tick_is_noop_without_probation_or_workload(self, tmp_path):
+        reps = [_StubRep("a", 1), _StubRep("b", 2)]
+        base = REGISTRY.counter(
+            "hops_tpu_fleet_synthetic_probes_total", labels=("model",)
+        ).value(model="stub")
+        # Healthy fleet: the pool is never even materialized.
+        r = self._router(reps, self._workload(tmp_path))
+        try:
+            r._synthetic_probe_tick()
+            assert r._probe_bodies is None
+        finally:
+            r.stop()
+        # Probation but no configured workload: live probes only.
+        r2 = self._router(reps, None)
+        try:
+            _seed_latency(r2, "a", 0.005)
+            _seed_latency(r2, "b", 0.5)
+            r2._eject_tick()
+            assert r2._view("b").probation is True
+            r2._synthetic_probe_tick()
+        finally:
+            r2.stop()
+        assert REGISTRY.counter(
+            "hops_tpu_fleet_synthetic_probes_total", labels=("model",)
+        ).value(model="stub") == base
+
+    def test_unusable_artifact_disables_probes_not_the_router(
+            self, tmp_path):
+        (tmp_path / "junk").mkdir()
+        reps = [_StubRep("a", 1), _StubRep("b", 2)]
+        r = self._router(reps, tmp_path / "junk")
+        try:
+            _seed_latency(r, "a", 0.005)
+            _seed_latency(r, "b", 0.5)
+            r._eject_tick()
+            assert r._view("b").probation is True
+            r._synthetic_probe_tick()  # logs once, no crash
+            assert r._probe_body_pool() == []
+            # Live-traffic shadow probes still work as before.
+            assert r._view("b").probation is True
+        finally:
+            r.stop()
 
 
 class TestQoSRouting:

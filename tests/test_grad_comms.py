@@ -666,3 +666,136 @@ def test_wire_bytes_and_telemetry_compression_ratio():
     ).value(mode="quantized") > pre_c
     hist = REGISTRY.histogram("grad_comms_all_reduce_seconds", labels=("mode",))
     assert any(v > 0 for _, _, v in hist.samples())
+
+
+# -- hierarchy-aware collectives ----------------------------------------------
+
+
+def test_hier_groups_layout_and_validation():
+    """Ranks are host-major: intra groups are contiguous runs, inter
+    groups stride by the local size."""
+    intra, inter = gc.hier_groups(8, 2)
+    assert intra == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert inter == [[0, 4], [1, 5], [2, 6], [3, 7]]
+    intra4, inter4 = gc.hier_groups(8, 4)
+    assert intra4 == [[0, 1], [2, 3], [4, 5], [6, 7]]
+    assert inter4 == [[0, 2, 4, 6], [1, 3, 5, 7]]
+    with pytest.raises(ValueError, match=">= 2 hosts"):
+        gc.hier_groups(8, 1)
+    with pytest.raises(ValueError, match="not divisible"):
+        gc.hier_groups(8, 3)
+
+
+@pytest.mark.parametrize("hosts", [2, 4])
+def test_psum_hierarchical_bit_identical_to_flat(hosts):
+    """The hierarchical schedule only MOVES addends (two all_to_all
+    phases); the single fold sums them in global rank order — the same
+    accumulation order as flat psum, so the result is bit-identical,
+    padding path included (255 elements per device is not 8-divisible)."""
+    rs = np.random.RandomState(1)
+    per_dev = rs.randn(N_DEV, 3, 85).astype(np.float32)
+    flat = _collective(lambda v: jax.lax.psum(v, "data"), per_dev)
+    hier = _collective(
+        lambda v: gc.psum_hierarchical(v, "data", hosts=hosts), per_dev
+    )
+    np.testing.assert_array_equal(flat, hier)
+
+
+def test_hier_reduce_scatter_matches_psum_scatter():
+    rs = np.random.RandomState(2)
+    per_dev = rs.randn(N_DEV, 256).astype(np.float32)
+    ref = _collective(
+        lambda v: jax.lax.psum_scatter(v[0], "data", tiled=True)[None],
+        per_dev,
+    )
+    hier = _collective(
+        lambda v: gc.hier_reduce_scatter(v[0], "data", 2)[None], per_dev
+    )
+    np.testing.assert_array_equal(ref, hier)
+
+
+@pytest.mark.parametrize("hosts", [2, 4])
+def test_quantized_hier_bit_identical_to_quantized_flat(hosts):
+    """quantize=True composes: the wire hops sit at the same two points
+    of the schedule, so quantized+hier is bitwise equal to
+    quantized-flat — not merely close."""
+    rs = np.random.RandomState(3)
+    per_dev = rs.randn(N_DEV, 1, 1024).astype(np.float32)
+    flat = _collective(
+        lambda v: gc.psum_quantized(v, "data", block_size=128), per_dev
+    )
+    hier = _collective(
+        lambda v: gc.psum_quantized(v, "data", block_size=128,
+                                    hierarchy=hosts),
+        per_dev,
+    )
+    np.testing.assert_array_equal(flat, hier)
+
+
+def test_hier_step_bit_identical_to_flat():
+    """Acceptance: 3 training steps under hierarchy=2 equal the flat
+    explicit all-reduce — params AND optimizer moments bit-for-bit."""
+    strategy = Strategy(mesh_lib.make_mesh({"data": N_DEV}))
+    batch = strategy.distribute_batch(_batch())
+    results = {}
+    for name, cfg in [
+        ("flat", gc.GradCommsConfig()),
+        ("hier", gc.GradCommsConfig(hierarchy=2)),
+    ]:
+        step = strategy.step(
+            common.make_train_step(grad_comms=cfg), donate_state=False,
+            grad_comms=cfg,
+        )
+        state = strategy.replicate(_state(optax.adam(1e-3)))
+        for _ in range(3):
+            state, metrics = step(state, batch)
+        results[name] = (state, metrics)
+    s_flat, m_flat = results["flat"]
+    s_hier, m_hier = results["hier"]
+    assert float(m_flat["loss"]) == float(m_hier["loss"])
+    for a, b in zip(jax.tree.leaves(s_flat.params), jax.tree.leaves(s_hier.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(s_flat.opt_state), jax.tree.leaves(s_hier.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_quantized_hier_step_bit_identical_to_quantized_flat():
+    """The quantized+ composition at step level: quantized+hier trains
+    bit-identically to quantized-flat over 3 steps."""
+    strategy = Strategy(mesh_lib.make_mesh({"data": N_DEV}))
+    batch = strategy.distribute_batch(_batch())
+    results = {}
+    for name, cfg in [
+        ("q-flat", gc.GradCommsConfig(quantize=True)),
+        ("q-hier", gc.GradCommsConfig(quantize=True, hierarchy=2)),
+    ]:
+        step = strategy.step(
+            common.make_train_step(grad_comms=cfg), donate_state=False,
+            grad_comms=cfg,
+        )
+        state = strategy.replicate(_state(optax.adam(1e-3)))
+        for _ in range(3):
+            state, metrics = step(state, batch)
+        results[name] = (state, metrics)
+    s_f, m_f = results["q-flat"]
+    s_h, m_h = results["q-hier"]
+    assert float(m_f["loss"]) == float(m_h["loss"])
+    for a, b in zip(jax.tree.leaves(s_f.params), jax.tree.leaves(s_h.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(jax.tree.leaves(s_f.opt_state), jax.tree.leaves(s_h.opt_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_hier_parse_and_validation():
+    assert gc.GradCommsConfig.parse("hier").hierarchy == 2
+    assert gc.GradCommsConfig.parse("hier").mode == "hier"
+    qh = gc.GradCommsConfig.parse("quantized+hier")
+    assert qh.quantize and qh.hierarchy == 2 and qh.mode == "quantized+hier"
+    hz = gc.GradCommsConfig.parse("hier+zero1")
+    assert hz.hierarchy == 2 and hz.update_sharding == "cross_replica"
+    with pytest.raises(ValueError, match="counts hosts"):
+        gc.GradCommsConfig(hierarchy=1)
+    with pytest.raises(ValueError, match="zero3"):
+        gc.GradCommsConfig(hierarchy=2, update_sharding="zero3")
+    with pytest.raises(ValueError, match="bench timing"):
+        gc.GradCommsConfig(local_only=True, hierarchy=2)

@@ -188,3 +188,18 @@ def test_module_imports(name):
         if missing == "hops_tpu" or name.startswith(f"hops_tpu.{missing}"):
             raise
         pytest.skip(f"optional dependency not installed: {e.name}")
+
+
+def test_placement_registered_in_drift_guard():
+    """The placement layer is the only control plane that can move a
+    replica or shard off-box; if its modules stop importing, every
+    multi-host path degrades back to silent local Popen. Pin the
+    package, all three components, and the lint rule that guards its
+    no-hardcoded-loopback invariant."""
+    names = _module_names()
+    assert "hops_tpu.jobs.placement" in names
+    assert "hops_tpu.jobs.placement.hostd" in names
+    assert "hops_tpu.jobs.placement.client" in names
+    assert "hops_tpu.jobs.placement.registry" in names
+    assert "hops_tpu.jobs.placement.shardd" in names
+    assert "hops_tpu.analysis.rules.hardcoded_loopback" in names

@@ -1674,3 +1674,43 @@ def test_prefix_ordering_preserves_fifo_without_prefixes():
     order_before = [r.ticket for r in eng._queue]
     eng._order_queue_for_prefix_waves()
     assert [r.ticket for r in eng._queue] == order_before
+
+
+def test_submit_admission_bound_sheds_typed_after_validation():
+    """Bounded admission: a well-formed submit at a full queue raises
+    the TYPED shed (``qos.QueueFullError`` — a ``ShedError``, which the
+    serving tier maps to 503 reason="overload"); malformed requests at
+    the same full queue stay ValueError (400-shaped), because
+    validation precedes the bound. Accepted work is untouched."""
+    from hops_tpu.runtime import qos
+
+    model = TransformerLM(**TINY, ragged_decode=True)
+    plain = TransformerLM(**TINY)
+    params = _params(plain)
+    rs = np.random.RandomState(3)
+    prompts = [rs.randint(0, 64, (4,)) for _ in range(3)]
+
+    with pytest.raises(ValueError, match="max_queue"):
+        LMEngine(model, params, slots=1, max_queue=0)
+
+    engine = LMEngine(model, params, slots=1, max_queue=2)
+    tickets = [engine.submit(p, max_new_tokens=3) for p in prompts[:2]]
+    with pytest.raises(qos.QueueFullError, match="queue full"):
+        engine.submit(prompts[2], max_new_tokens=3)
+    assert issubclass(qos.QueueFullError, qos.ShedError)
+    # Validation outranks admission: garbage is the caller's bug even
+    # under overload, never a retry-later.
+    with pytest.raises(ValueError, match="empty prompt"):
+        engine.submit(np.zeros((0,), np.int32), max_new_tokens=3)
+    with pytest.raises(ValueError, match="max_decode_len"):
+        engine.submit(prompts[2], max_new_tokens=10_000)
+
+    results = engine.run()
+    for p, t in zip(prompts[:2], tickets):
+        ref = generate(
+            plain, params, jnp.asarray(p)[None], jax.random.PRNGKey(0),
+            max_new_tokens=3, temperature=0.0,
+        )
+        assert results[t] == list(np.asarray(ref[0, 4:]))
+    # The drained queue admits again.
+    assert engine.submit(prompts[2], max_new_tokens=3) == tickets[-1] + 1
