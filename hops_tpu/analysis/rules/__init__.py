@@ -2,6 +2,7 @@
 
 Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 
+- :mod:`.adhoc_http_server` — ``adhoc-http-server``
 - :mod:`.jit_purity` — ``jit-purity``
 - :mod:`.donation` — ``use-after-donation``
 - :mod:`.host_sync` — ``host-sync-in-loop``
@@ -17,6 +18,7 @@ Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 """
 
 from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effects
+    adhoc_http_server,
     blocking_call,
     debug_surfaces,
     donation,

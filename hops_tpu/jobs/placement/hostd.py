@@ -2,7 +2,8 @@
 
 One hostd runs on every machine the platform may place work on. It is
 the only thing the :class:`~hops_tpu.jobs.placement.client.
-PlacementClient` talks to: a stdlib HTTP daemon that spawns, drains,
+PlacementClient` talks to: an event-loop HTTP daemon (one
+:class:`~hops_tpu.runtime.httpserver.HTTPServer`) that spawns, drains,
 reaps and health-checks the UNITS on its host —
 
 - ``replica`` units: one ``serving._RunningServing`` each, hosted
@@ -51,12 +52,12 @@ import sys
 import threading
 import time
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Any
 
 from hops_tpu.jobs.placement.registry import Host, HostRegistry
 from hops_tpu.runtime import faultinject
+from hops_tpu.runtime.httpserver import HTTPServer
 from hops_tpu.runtime.logging import get_logger
 
 log = get_logger(__name__)
@@ -110,12 +111,8 @@ class Hostd:
         self._units: dict[str, _Unit] = {}  # guarded by: self._lock
         self._counter = 0  # guarded by: self._lock
         self._server = _make_server(self, bind, port)
-        self.port = self._server.server_address[1]
+        self.port = self._server.port
         self.address = bind
-        self._serve_thread = threading.Thread(
-            target=self._server.serve_forever, name=f"hostd-{name}",
-            daemon=True)
-        self._serve_thread.start()
         self._announce_dir = Path(announce_dir) if announce_dir else None
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
@@ -337,9 +334,7 @@ class Hostd:
         self._hb_stop.set()
         for unit in self.units():
             self.reap(unit.uid)
-        self._server.shutdown()
-        self._server.server_close()
-        self._serve_thread.join(timeout=5)
+        self._server.stop()
         if self._announce_dir is not None:
             HostRegistry.retract(self._announce_dir, self.name)
 
@@ -356,55 +351,30 @@ class Hostd:
                 unit.server.stop()
                 unit.server = None
             unit.state = "stopped"
-        self._server.shutdown()
-        self._server.server_close()
+        self._server.stop()
         log.warning("hostd %s: CHAOS-KILLED with %d units", self.name,
                     len(self.units()))
 
 
-def _make_server(hostd: Hostd, bind: str, port: int) -> ThreadingHTTPServer:
-    class Handler(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"
-        disable_nagle_algorithm = True  # headers+body are separate writes; Nagle + delayed ACK stalls the body ~40 ms
+def _make_server(hostd: Hostd, bind: str, port: int) -> HTTPServer:
+    def route(method, path, headers, body):
+        try:
+            # The agent-side half of the partition fault point: a
+            # chaos spec keyed by this host's name stalls/errors the
+            # verb INSIDE the agent, after transport succeeded.
+            faultinject.fire("placement.rpc", key=hostd.name)
+            payload = json.loads(body or b"{}") if method == "POST" else {}
+            status, out = hostd.handle(method, path, payload)
+        except Exception as e:  # noqa: BLE001 — agent stays up; the
+            # error is the client's breaker food
+            log.warning("hostd %s: %s %s failed: %s: %s", hostd.name,
+                        method, path, type(e).__name__, e)
+            status, out = 500, {"error": f"{type(e).__name__}: {e}"}
+        data = json.dumps(out, default=str).encode()
+        return status, {"Content-Type": "application/json"}, data
 
-        def _reply(self, status: int, payload: dict) -> None:
-            data = json.dumps(payload, default=str).encode()
-            self.send_response(status)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
-
-        def _dispatch(self, method: str) -> None:
-            try:
-                # The agent-side half of the partition fault point: a
-                # chaos spec keyed by this host's name stalls/errors the
-                # verb INSIDE the agent, after transport succeeded.
-                faultinject.fire("placement.rpc", key=hostd.name)
-                body = {}
-                if method == "POST":
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(n) or b"{}")
-                status, payload = hostd.handle(method, self.path, body)
-            except Exception as e:  # noqa: BLE001 — agent stays up; the
-                # error is the client's breaker food
-                log.warning("hostd %s: %s %s failed: %s: %s", hostd.name,
-                            method, self.path, type(e).__name__, e)
-                status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
-            self._reply(status, payload)
-
-        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-            self._dispatch("GET")
-
-        def do_POST(self):  # noqa: N802
-            self._dispatch("POST")
-
-        def log_message(self, fmt, *args):
-            log.debug("hostd %s: " + fmt, hostd.name, *args)
-
-    server = ThreadingHTTPServer((bind, port), Handler)
-    server.daemon_threads = True
-    return server
+    return HTTPServer(route, bind=bind, port=port,
+                      name=f"hostd-{hostd.name}", workers=8)
 
 
 def main(argv: list[str] | None = None) -> None:

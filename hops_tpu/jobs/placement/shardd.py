@@ -42,14 +42,13 @@ import hashlib
 import json
 import os
 import signal
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Any
 
 import pandas as pd
 
 from hops_tpu.featurestore.online import OnlineStore
+from hops_tpu.runtime.httpserver import HTTPServer
 from hops_tpu.runtime.logging import get_logger
 
 log = get_logger(__name__)
@@ -91,11 +90,7 @@ class ShardServer:
                      self.label, self.shard_index, loaded, cfg["snapshot"])
         self._server = _make_server(
             self, int(cfg.get("port", 0)), cfg.get("bind", "127.0.0.1"))
-        self.port = self._server.server_address[1]
-        self._thread = threading.Thread(
-            target=self._server.serve_forever,
-            name=f"shardd-{self.label}-{self.shard_index}", daemon=True)
-        self._thread.start()
+        self.port = self._server.port
 
     # -- warm start -----------------------------------------------------------
 
@@ -168,53 +163,28 @@ class ShardServer:
         return 404, {"error": f"no such verb: {method} {path}"}
 
     def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-        self._thread.join(timeout=5)
+        self._server.stop()
         self._store.close()
 
 
 def _make_server(shard: ShardServer, port: int,
-                 bind: str = "127.0.0.1") -> ThreadingHTTPServer:
-    class Handler(BaseHTTPRequestHandler):
-        protocol_version = "HTTP/1.1"  # keep-alive: the pool's contract
-        disable_nagle_algorithm = True  # headers+body are separate writes; Nagle + delayed ACK stalls the body ~40 ms
+                 bind: str = "127.0.0.1") -> HTTPServer:
+    def route(method, path, headers, body):
+        try:
+            payload = json.loads(body or b"{}") if method == "POST" else {}
+            status, out = shard.handle(method, path, payload)
+        except Exception as e:  # noqa: BLE001 — a shard fault must reach the
+            # client as a 500 (breaker food), never kill the server
+            log.warning("shardd %s shard %d: %s %s failed: %s: %s",
+                        shard.label, shard.shard_index, method, path,
+                        type(e).__name__, e)
+            status, out = 500, {"error": f"{type(e).__name__}: {e}"}
+        data = json.dumps(out, default=str).encode()
+        return status, {"Content-Type": "application/json"}, data
 
-        def _reply(self, status: int, payload: dict) -> None:
-            data = json.dumps(payload, default=str).encode()
-            self.send_response(status)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
-
-        def _dispatch(self, method: str) -> None:
-            try:
-                body = {}
-                if method == "POST":
-                    n = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(n) or b"{}")
-                status, payload = shard.handle(method, self.path, body)
-            except Exception as e:  # noqa: BLE001 — a shard fault must reach the
-                # client as a 500 (breaker food), never kill the server thread
-                log.warning("shardd %s shard %d: %s %s failed: %s: %s",
-                            shard.label, shard.shard_index, method, self.path,
-                            type(e).__name__, e)
-                status, payload = 500, {"error": f"{type(e).__name__}: {e}"}
-            self._reply(status, payload)
-
-        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-            self._dispatch("GET")
-
-        def do_POST(self):  # noqa: N802
-            self._dispatch("POST")
-
-        def log_message(self, fmt, *args):  # route through our logger
-            log.debug("shardd %s: " + fmt, shard.label, *args)
-
-    server = ThreadingHTTPServer((bind, port), Handler)
-    server.daemon_threads = True
-    return server
+    return HTTPServer(route, bind=bind, port=port,
+                      name=f"shardd-{shard.label}-{shard.shard_index}",
+                      workers=8)
 
 
 def main(argv: list[str] | None = None) -> None:

@@ -88,11 +88,11 @@ import statistics
 import threading
 import time
 import urllib.error
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any
 
 from hops_tpu.runtime import faultinject, flight, qos
 from hops_tpu.runtime.httpclient import HTTPPool
+from hops_tpu.runtime.httpserver import HTTPServer
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.runtime.resilience import CircuitBreaker, with_deadline
 from hops_tpu.telemetry import export as telemetry_export
@@ -621,6 +621,7 @@ class Router:
         self.attempt_workers = int(attempt_workers)
         self._attempt_pool = None  # guarded by: self._hedge_lock
         self._hedge_pool = None  # guarded by: self._hedge_lock
+        self._scrape_pool = None  # guarded by: self._hedge_lock
         self._views_lock = threading.Lock()
         self._views: dict[str, _ReplicaView] = {}  # guarded by: self._views_lock
         self._rr = 0  # guarded by: self._views_lock
@@ -640,229 +641,217 @@ class Router:
         m_requests = _m_requests.labels(model=name)
         m_unrouted = _m_unrouted.labels(model=name)
 
-        class Handler(BaseHTTPRequestHandler):
-            # Keep-alive: the pool on the other side of this server
-            # (benches, sibling services) reuses connections; every
-            # reply frames itself with an explicit Content-Length.
-            protocol_version = "HTTP/1.1"
-            disable_nagle_algorithm = True  # headers+body are separate writes; Nagle + delayed ACK stalls the body ~40 ms
+        def _reply(code: int, body: dict[str, Any] | bytes,
+                   headers: dict[str, str] | None = None):
+            # Relay path hands bytes straight through (zero-copy:
+            # the replica's serialized body is the response);
+            # router-authored payloads (errors, /fleet) are dicts.
+            # A relayed byte body keeps the REPLICA's declared
+            # Content-Type (route() passes it through) — stamping
+            # application/json on, say, an HTML error page from the
+            # replica's HTTP stack would lie to the client; only
+            # Content-Length is always recomputed (by the transport
+            # core's assemble()).
+            data = body if isinstance(body, bytes) else json.dumps(body).encode()
+            hdrs = dict(headers or {})
+            ctype = hdrs.pop("Content-Type", "application/json")
+            out = {"Content-Type": ctype}
+            out.update(hdrs)
+            return code, out, data
 
-            def log_message(self, *args: Any) -> None:  # silence stderr spam
-                pass
-
-            def do_GET(self) -> None:
-                try:
-                    if telemetry_export.handle_metrics_path(self):
-                        return
+        def _do_get(path_full: str, headers: Any):
+            try:
+                resp = telemetry_export.metrics_response(path_full)
+                if resp is None:
                     # Debug surfaces on the router's own port: ITS span
                     # ring (for in-process fleets this includes replica
                     # spans — one shared ring) and flight recorder.
-                    if telemetry_export.handle_debug_path(self):
-                        return
-                    path = self.path.rstrip("/")
-                    if path == "/healthz":
-                        ready = router.routable()
-                        if ready:
-                            self._reply(200, {"status": "ok",
-                                              "ready_replicas": len(ready)})
-                        else:
-                            self._reply(503, {"status": "unready",
-                                              "ready_replicas": 0},
-                                        headers={"Retry-After": "1"})
-                        return
-                    if path == "/fleet":
-                        self._reply(200, router.describe())
-                        return
-                    self._reply(404, {"error": f"unknown path {self.path}"})
-                except Exception as e:  # noqa: BLE001 — server must stay up
-                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+                    resp = telemetry_export.debug_response(path_full)
+                if resp is not None:
+                    return resp
+                path = path_full.rstrip("/")
+                if path == "/healthz":
+                    ready = router.routable()
+                    if ready:
+                        return _reply(200, {"status": "ok",
+                                            "ready_replicas": len(ready)})
+                    return _reply(503, {"status": "unready",
+                                        "ready_replicas": 0},
+                                  headers={"Retry-After": "1"})
+                if path == "/fleet":
+                    return _reply(200, router.describe())
+                return _reply(404, {"error": f"unknown path {path_full}"})
+            except Exception as e:  # noqa: BLE001 — server must stay up
+                return _reply(500, {"error": f"{type(e).__name__}: {e}"})
 
-            def do_POST(self) -> None:
-                # Workload capture stamps the fleet-front-door ARRIVAL
-                # — the recorded stream is what clients sent, with
-                # rate-limited, unrouted, and handler-crash outcomes
-                # included (their status IS the outcome). Defined
-                # before any work so the outer except can record the
-                # 500s it answers.
-                t_arr_mono, t_arr_wall = time.monotonic(), time.time()
-                body = b"{}"
-                is_predict = False
+        def _do_post(path_full: str, headers: Any, body_in: bytes):
+            # Workload capture stamps the fleet-front-door ARRIVAL
+            # — the recorded stream is what clients sent, with
+            # rate-limited, unrouted, and handler-crash outcomes
+            # included (their status IS the outcome). Defined
+            # before any work so the outer except can record the
+            # 500s it answers.
+            t_arr_mono, t_arr_wall = time.monotonic(), time.time()
+            body = body_in or b"{}"
+            state = {"is_predict": False}
 
-                def capture(status: int, tspan: Any = None) -> None:
-                    if not (is_predict and workload.capturing()):
-                        return
-                    try:
-                        payload_obj = json.loads(body)
-                    except ValueError:
-                        payload_obj = None
-                    workload.record_request(
-                        surface="router",
-                        endpoint=name,
-                        path=self.path.rstrip("/"),
-                        tenant=self.headers.get("X-Tenant"),
-                        payload=payload_obj,
-                        instances=(
-                            payload_obj.get("instances")
-                            if isinstance(payload_obj, dict) else None
-                        ),
-                        status=status,
-                        latency_ms=(time.monotonic() - t_arr_mono) * 1e3,
-                        trace_id=(
-                            tspan.trace_id
-                            if getattr(tspan, "sampled", False) else None
-                        ),
-                        t_mono=t_arr_mono,
-                        t_wall=t_arr_wall,
-                    )
-
+            def capture(status: int, tspan: Any = None) -> None:
+                if not (state["is_predict"] and workload.capturing()):
+                    return
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    body = self.rfile.read(length) or b"{}"
-                    path = self.path.rstrip("/")
-                    if path.startswith("/admin/capture/"):
-                        # Workload-capture control plane on the fleet's
-                        # front door (status: GET /debug/workload).
-                        try:
-                            admin_payload = json.loads(body)
-                        except ValueError:
-                            admin_payload = {}
-                        self._reply(
-                            *workload.admin_action(path, admin_payload))
-                        return
-                    if path not in ("/predict", f"/v1/models/{name}:predict"):
-                        self._reply(404, {"error": f"unknown path {self.path}"})
-                        return
-                    is_predict = True
-                    m_requests.inc()
-                    tenant = self.headers.get("X-Tenant", "default")
-                    wait = router.limiter.acquire(tenant)
-                    if wait > 0:
-                        _m_rate_limited.inc(
-                            tenant=router.limiter.label_for(tenant))
-                        self._reply(
-                            429,
-                            {"error": f"tenant {tenant!r} rate limited"},
-                            headers={"Retry-After": f"{math.ceil(wait)}"},
-                        )
-                        capture(429)
-                        return
-                    # QoS class: tenant config is authoritative; the
-                    # untrusted header can only demote relative to it.
-                    priority = qos.parse_priority(
-                        self.headers.get(qos.PRIORITY_HEADER),
-                        router.limiter.priority_for(tenant),
-                    )
-                    # Brownout shed BEFORE the class bucket is charged:
-                    # a request that will be refused anyway must not
-                    # drain batch tokens — the bucket would sit empty
-                    # when the brownout lifts, turning recovery into a
-                    # burst of spurious 429s.
-                    if (router.brownout_level >= qos.SHED
-                            and qos.rank(priority) > 0):
-                        # Brownout shed: the lowest class yields first
-                        # so the interactive SLO survives the burn.
-                        _m_qos_shed.inc(model=name, priority=priority,
-                                        reason="brownout")
-                        self._reply(
-                            503,
-                            {"error": f"{priority} traffic shed "
-                                      "(brownout; SLO burn)"},
-                            headers={"Retry-After": "1"},
-                        )
-                        capture(503)
-                        return
-                    cwait = router._class_acquire(priority)
-                    if cwait > 0:
-                        _m_qos_shed.inc(model=name, priority=priority,
-                                        reason="rate")
-                        self._reply(
-                            429,
-                            {"error": f"{priority} class rate limited"},
-                            headers={"Retry-After": f"{math.ceil(cwait)}"},
-                        )
-                        capture(429)
-                        return
-                    t0 = time.perf_counter()
-                    # The trace starts (or, with an incoming
-                    # `traceparent`, extends) at the fleet's front
-                    # door; every forward hop below becomes a child,
-                    # and the chosen sampling decision rides the
-                    # injected header to the replicas.
-                    debug = (self.headers.get(tracing.DEBUG_HEADER) or "")
-                    # The resolved class rides every forward (replicas
-                    # must not re-derive it from the untrusted client
-                    # header); a brownout level rides too so
-                    # subprocess replicas degrade with the fleet.
-                    relay_headers = {qos.PRIORITY_HEADER: priority}
-                    if debug:
-                        relay_headers[tracing.DEBUG_HEADER] = debug
-                    lvl = router.brownout_level
-                    if lvl > 0:
-                        relay_headers[qos.BROWNOUT_HEADER] = str(lvl)
-                    # An explicit timeline ask force-samples: the
-                    # operator debugging a request must get the
-                    # breakdown whatever the ambient sample rate.
-                    tspan = tracing.start_trace(
-                        "fleet.request", headers=self.headers, model=name,
-                        force_sample=debug.strip().lower() == "timeline")
-                    with tspan:
-                        with span("hops_tpu_fleet_request", model=name):
-                            code, payload, headers = router.route(
-                                body, extra_headers=relay_headers)
-                        if debug.strip().lower() == "timeline":
-                            # The ONE relay path that needs the object:
-                            # the inline timeline merges the router's
-                            # own spans into the replica's breakdown.
-                            payload = router._merge_debug(payload, tspan)
-                    # Rolling window behind recent_p99_ms(): the
-                    # autoscaler's latency trigger reads this; the
-                    # per-class SLO histogram feeds histogram_p99_ms()
-                    # and the brownout controller.
-                    dt = time.perf_counter() - t0
-                    router.observe_latency(dt, priority=priority)
-                    _m_request_seconds.observe(
-                        dt, model=name, priority=priority)
-                    if code >= 500:
-                        m_unrouted.inc()
-                    self._reply(code, payload, headers=headers)
-                    # After the write — capture must not delay the
-                    # response, and neither may a shadow probe.
+                    payload_obj = json.loads(body)
+                except ValueError:
+                    payload_obj = None
+                workload.record_request(
+                    surface="router",
+                    endpoint=name,
+                    path=path_full.rstrip("/"),
+                    tenant=headers.get("X-Tenant"),
+                    payload=payload_obj,
+                    instances=(
+                        payload_obj.get("instances")
+                        if isinstance(payload_obj, dict) else None
+                    ),
+                    status=status,
+                    latency_ms=(time.monotonic() - t_arr_mono) * 1e3,
+                    trace_id=(
+                        tspan.trace_id
+                        if getattr(tspan, "sampled", False) else None
+                    ),
+                    t_mono=t_arr_mono,
+                    t_wall=t_arr_wall,
+                )
+
+            def done(resp, tspan: Any = None,
+                     probe_headers: dict[str, str] | None = None):
+                # Capture and shadow probes run as the route's `after`
+                # callback — after the reply is queued for write, so
+                # neither may delay the response.
+                code = resp[0]
+
+                def after() -> None:
                     capture(code, tspan)
-                    router._maybe_shadow_probe(body, relay_headers)
-                except Exception as e:  # noqa: BLE001 — server must stay up
-                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
-                    # A handler crash is a client-visible 500: it
-                    # belongs in the recorded error mix (capture()
-                    # never raises past the recorder's drop counter).
-                    capture(500)
+                    if probe_headers is not None:
+                        router._maybe_shadow_probe(body, probe_headers)
 
-            def _reply(self, code: int, body: dict[str, Any] | bytes,
-                       headers: dict[str, str] | None = None) -> None:
-                # Relay path hands bytes straight through (zero-copy:
-                # the replica's serialized body is the response);
-                # router-authored payloads (errors, /fleet) are dicts.
-                # A relayed byte body keeps the REPLICA's declared
-                # Content-Type (route() passes it through) — stamping
-                # application/json on, say, an HTML error page from the
-                # replica's HTTP stack would lie to the client; only
-                # Content-Length is always recomputed.
-                data = body if isinstance(body, bytes) else json.dumps(body).encode()
-                hdrs = dict(headers or {})
-                ctype = hdrs.pop("Content-Type", "application/json")
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                for k, v in hdrs.items():
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(data)
+                return resp[0], resp[1], resp[2], after
 
-        self._server = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name=f"fleet-router-{name}",
-        )
-        self._thread.start()
+            try:
+                path = path_full.rstrip("/")
+                if path.startswith("/admin/capture/"):
+                    # Workload-capture control plane on the fleet's
+                    # front door (status: GET /debug/workload).
+                    try:
+                        admin_payload = json.loads(body)
+                    except ValueError:
+                        admin_payload = {}
+                    return _reply(*workload.admin_action(path, admin_payload))
+                if path not in ("/predict", f"/v1/models/{name}:predict"):
+                    return _reply(404, {"error": f"unknown path {path_full}"})
+                state["is_predict"] = True
+                m_requests.inc()
+                tenant = headers.get("X-Tenant", "default")
+                wait = router.limiter.acquire(tenant)
+                if wait > 0:
+                    _m_rate_limited.inc(
+                        tenant=router.limiter.label_for(tenant))
+                    return done(_reply(
+                        429,
+                        {"error": f"tenant {tenant!r} rate limited"},
+                        headers={"Retry-After": f"{math.ceil(wait)}"},
+                    ))
+                # QoS class: tenant config is authoritative; the
+                # untrusted header can only demote relative to it.
+                priority = qos.parse_priority(
+                    headers.get(qos.PRIORITY_HEADER),
+                    router.limiter.priority_for(tenant),
+                )
+                # Brownout shed BEFORE the class bucket is charged:
+                # a request that will be refused anyway must not
+                # drain batch tokens — the bucket would sit empty
+                # when the brownout lifts, turning recovery into a
+                # burst of spurious 429s.
+                if (router.brownout_level >= qos.SHED
+                        and qos.rank(priority) > 0):
+                    # Brownout shed: the lowest class yields first
+                    # so the interactive SLO survives the burn.
+                    _m_qos_shed.inc(model=name, priority=priority,
+                                    reason="brownout")
+                    return done(_reply(
+                        503,
+                        {"error": f"{priority} traffic shed "
+                                  "(brownout; SLO burn)"},
+                        headers={"Retry-After": "1"},
+                    ))
+                cwait = router._class_acquire(priority)
+                if cwait > 0:
+                    _m_qos_shed.inc(model=name, priority=priority,
+                                    reason="rate")
+                    return done(_reply(
+                        429,
+                        {"error": f"{priority} class rate limited"},
+                        headers={"Retry-After": f"{math.ceil(cwait)}"},
+                    ))
+                t0 = time.perf_counter()
+                # The trace starts (or, with an incoming
+                # `traceparent`, extends) at the fleet's front
+                # door; every forward hop below becomes a child,
+                # and the chosen sampling decision rides the
+                # injected header to the replicas.
+                debug = (headers.get(tracing.DEBUG_HEADER) or "")
+                # The resolved class rides every forward (replicas
+                # must not re-derive it from the untrusted client
+                # header); a brownout level rides too so
+                # subprocess replicas degrade with the fleet.
+                relay_headers = {qos.PRIORITY_HEADER: priority}
+                if debug:
+                    relay_headers[tracing.DEBUG_HEADER] = debug
+                lvl = router.brownout_level
+                if lvl > 0:
+                    relay_headers[qos.BROWNOUT_HEADER] = str(lvl)
+                # An explicit timeline ask force-samples: the
+                # operator debugging a request must get the
+                # breakdown whatever the ambient sample rate.
+                tspan = tracing.start_trace(
+                    "fleet.request", headers=headers, model=name,
+                    force_sample=debug.strip().lower() == "timeline")
+                with tspan:
+                    with span("hops_tpu_fleet_request", model=name):
+                        code, payload, rheaders = router.route(
+                            body, extra_headers=relay_headers)
+                    if debug.strip().lower() == "timeline":
+                        # The ONE relay path that needs the object:
+                        # the inline timeline merges the router's
+                        # own spans into the replica's breakdown.
+                        payload = router._merge_debug(payload, tspan)
+                # Rolling window behind recent_p99_ms(): the
+                # autoscaler's latency trigger reads this; the
+                # per-class SLO histogram feeds histogram_p99_ms()
+                # and the brownout controller.
+                dt = time.perf_counter() - t0
+                router.observe_latency(dt, priority=priority)
+                _m_request_seconds.observe(
+                    dt, model=name, priority=priority)
+                if code >= 500:
+                    m_unrouted.inc()
+                return done(_reply(code, payload, headers=rheaders),
+                            tspan, relay_headers)
+            except Exception as e:  # noqa: BLE001 — server must stay up
+                # A handler crash is a client-visible 500: it
+                # belongs in the recorded error mix (capture()
+                # never raises past the recorder's drop counter).
+                return done(_reply(500, {"error": f"{type(e).__name__}: {e}"}))
+
+        def handler_route(method: str, path: str, headers: Any, body: bytes):
+            if method == "GET":
+                return _do_get(path, headers)
+            if method == "POST":
+                return _do_post(path, headers, body)
+            return _reply(404, {"error": f"unknown path {path}"})
+
+        self._server = HTTPServer(
+            handler_route, bind="127.0.0.1", port=port,
+            name=f"fleet-router-{name}", workers=32)
         self._scraper = threading.Thread(
             target=self._scrape_loop, daemon=True,
             name=f"fleet-scraper-{name}",
@@ -903,7 +892,14 @@ class Router:
                 log.exception("fleet %s: gray-failure tick failed", self.name)
 
     def scrape_once(self) -> None:
-        """One pass over every routable replica's ``/metrics.json``.
+        """One COALESCED pass over every routable replica's
+        ``/metrics.json``: all scrapes fire concurrently through the
+        shared keep-alive pool (one persistent connection per replica,
+        reused every 0.25 s cycle — no re-dialing), so the pass's
+        wall-time is the slowest replica, not the sum. Each scrape
+        still runs under its own deadline and its own
+        ``router.scrape`` fault point — a wedged or chaos-stalled
+        replica fails ONLY its own scrape.
 
         Also prunes views whose replica no longer exists (reaped,
         killed, or failed): every rollout and autoscale churn mints
@@ -915,11 +911,22 @@ class Router:
         with self._views_lock:
             for rid in [r for r in self._views if r not in live]:
                 del self._views[rid]
-        for rep in reps:
-            if rep.state not in ("ready", "starting") or rep.port is None:
-                continue
+        targets = [rep for rep in reps
+                   if rep.state in ("ready", "starting")
+                   and rep.port is not None]
+        if not targets:
+            return
+        if len(targets) == 1:
+            snaps = [self._scrape_replica(
+                self._rep_host(targets[0]), targets[0].port)]
+        else:
+            ex = self._scrape_executor()
+            snaps = list(ex.map(
+                lambda rep: self._scrape_replica(
+                    self._rep_host(rep), rep.port),
+                targets))
+        for rep, snap in zip(targets, snaps):
             view = self._view(rep.rid)
-            snap = self._scrape_replica(self._rep_host(rep), rep.port)
             if snap is None:
                 view.scrape_ok = False
                 continue
@@ -932,6 +939,17 @@ class Router:
             if view._last_shed_total is not None:
                 view.shed_rate = max(0.0, shed - view._last_shed_total)
             view._last_shed_total = shed
+
+    def _scrape_executor(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._hedge_lock:
+            if self._scrape_pool is None:
+                self._scrape_pool = ThreadPoolExecutor(
+                    max_workers=8,
+                    thread_name_prefix=f"fleet-scrape-{self.name}",
+                )
+            return self._scrape_pool
 
     #: The only families the routing score reads — the scrape asks the
     #: replica for exactly these, so each poll renders and parses a
@@ -1593,11 +1611,13 @@ class Router:
                 self._brownout.policy.slo_p99_ms)
         self._m_brownout.set(level)
         if level > 0:
-            # Raise/refresh only; level 0 arrives by TTL expiry so one
-            # fleet's recovery never stomps another's active brownout
-            # in a shared process.
+            # Raise/refresh only, under THIS fleet's scope: a
+            # co-hosted fleet's endpoints stay at full quality, and
+            # level 0 arrives by TTL expiry so recovery never stomps
+            # another controller's active brownout.
             qos.set_brownout(
-                level, hold_s=max(1.0, 6 * self.scrape_interval_s))
+                level, hold_s=max(1.0, 6 * self.scrape_interval_s),
+                scope=self.name)
 
     def _hist_snapshot_tick(self) -> None:
         snap = {
@@ -1663,7 +1683,7 @@ class Router:
 
     @property
     def port(self) -> int:
-        return self._server.server_address[1]
+        return self._server.port
 
     @property
     def endpoint(self) -> str:
@@ -1756,11 +1776,11 @@ class Router:
 
     def stop(self) -> None:
         self._stop.set()
-        self._server.shutdown()
-        self._server.server_close()
+        self._server.stop()
         self._scraper.join(timeout=5)
         with self._hedge_lock:
-            pools = [p for p in (self._attempt_pool, self._hedge_pool)
+            pools = [p for p in (self._attempt_pool, self._hedge_pool,
+                                 self._scrape_pool)
                      if p is not None]
         for p in pools:
             # In-flight abandoned losers finish against the live pool;

@@ -27,7 +27,6 @@ import sys
 import threading
 import time
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Any
 
@@ -36,6 +35,7 @@ import numpy as np
 from hops_tpu.messaging import pubsub
 from hops_tpu.modelrepo import registry
 from hops_tpu.runtime import faultinject, flight, fs, qos
+from hops_tpu.runtime.httpserver import HTTPServer
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.runtime.resilience import (
     CircuitBreaker,
@@ -756,332 +756,317 @@ class _RunningServing:
         running = self
         breaker = self.breaker
 
-        class Handler(BaseHTTPRequestHandler):
-            # Keep-alive for the router's persistent-connection pool:
-            # every reply frames itself with an explicit Content-Length.
-            protocol_version = "HTTP/1.1"
-            disable_nagle_algorithm = True  # headers+body are separate writes; Nagle + delayed ACK stalls the body ~40 ms
+        def _json(code: int, body: dict[str, Any],
+                  extra: dict[str, str] | None = None):
+            h = {"Content-Type": "application/json"}
+            if extra:
+                h.update(extra)
+            return code, h, json.dumps(body).encode()
 
-            def log_message(self, *args: Any) -> None:  # silence stderr spam
-                pass
-
-            def do_GET(self) -> None:
-                # TF-Serving's model-status contract
-                # (GET /v1/models/<name>), extended with live engine
-                # telemetry when the predictor exposes stats() — the
-                # LM engine's dispatches, occupancy, prefix hits, and
-                # speculation acceptance.
-                try:
-                    # Prometheus scrape rides the serving's own port
-                    # (GET /metrics, GET /metrics.json) — the whole
-                    # process's registry, not just this endpoint. The
-                    # debug surfaces (/debug/traces, /debug/flight)
-                    # ride the same port: this process's span ring and
-                    # flight recorder.
-                    if telemetry_export.handle_metrics_path(self):
-                        return
-                    if telemetry_export.handle_debug_path(self):
-                        return
-                    # Readiness: load balancers and supervisors poll
-                    # this; an open breaker = the predictor is down,
-                    # stop routing here until the half-open probe heals.
-                    # A DRAINING endpoint is also unready (503 +
-                    # Retry-After) and reports its in-flight count, so
-                    # a rollout can gate the reap on inflight == 0 off
-                    # the same probe the router stops routing on.
-                    if self.path.rstrip("/") == "/healthz":
-                        bstate = breaker.state
-                        if running.draining:
-                            self._reply(
-                                503,
-                                {"status": "draining", "breaker": bstate,
-                                 "inflight": running.inflight},
-                                headers={"Retry-After": "1"},
-                            )
-                        elif bstate == "open":
-                            retry = max(1.0, breaker.retry_after_s())
-                            self._reply(
-                                503,
-                                {"status": "unready", "breaker": bstate},
-                                headers={"Retry-After": f"{retry:.0f}"},
-                            )
-                        else:
-                            self._reply(200, {"status": "ok", "breaker": bstate})
-                        return
-                    # Exact TF-Serving routes only: /v1/models/<name>
-                    # and the versioned /v1/models/<name>/versions/<N>
-                    # form (a suffix match would accept arbitrary
-                    # prefixes like /junk/v1/models/<name>).
-                    path = self.path.rstrip("/")
-                    base = f"/v1/models/{name}"
-                    versioned = path.startswith(base + "/versions/")
-                    if versioned:
-                        ver = path[len(base) + len("/versions/"):]
-                        if ver != str(cfg.get("model_version", 1)):
-                            self._reply(404, {"error": f"unknown version {ver}"})
-                            return
-                    elif path != base:
-                        self._reply(404, {"error": f"unknown path {self.path}"})
-                        return
-                    body: dict[str, Any] = {
-                        "model_version_status": [{
-                            "version": str(cfg.get("model_version", 1)),
-                            "state": "AVAILABLE",
-                        }],
+        def _maybe_debug(headers: Any, body: dict[str, Any],
+                         tspan: Any) -> dict[str, Any]:
+            """Attach the inline per-hop timing breakdown when the
+            request asked for it (``X-Hops-Debug: timeline``) and this
+            request is traced — the router merges its own hops into the
+            same list on the way back out."""
+            want = headers.get(tracing.DEBUG_HEADER, "")
+            if want.strip().lower() == "timeline":
+                rows = tracing.timeline(tspan)
+                if rows:
+                    body["debug"] = {
+                        "trace_id": rows[0]["trace_id"],
+                        "timeline": rows,
                     }
-                    if hasattr(raw_predictor, "stats"):
-                        body["engine"] = raw_predictor.stats()
-                    self._reply(200, body)
-                except Exception as e:  # noqa: BLE001 — server must stay up
-                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return body
 
-            def do_POST(self) -> None:
-                try:
-                    # Workload capture stamps the ARRIVAL, not the
-                    # predict start — queueing ahead of the handler is
-                    # part of the workload being recorded.
-                    t_arr_mono, t_arr_wall = time.monotonic(), time.time()
-                    length = int(self.headers.get("Content-Length", 0))
-                    raw_body = self.rfile.read(length) or b"{}"
-                    # Workload-capture control plane (arm / finalize
-                    # the process-global recorder; status rides
-                    # GET /debug/workload). Checked BEFORE the strict
-                    # body parse so a sloppy body degrades to {} — the
-                    # same tolerant contract as the router's route
-                    # (a capture/stop must not fail on replicas while
-                    # succeeding on the front door).
-                    if self.path.split("?", 1)[0].rstrip("/").startswith(
-                            "/admin/capture/"):
-                        try:
-                            admin_payload = json.loads(raw_body)
-                        except ValueError:
-                            admin_payload = {}
-                        self._reply(*workload.admin_action(
-                            self.path, admin_payload))
-                        return
-                    payload = json.loads(raw_body)
-                    # Fleet control plane: flip this endpoint into the
-                    # draining state (rollouts, scale-downs). Replies
-                    # with the in-flight count the caller will poll to
-                    # zero on /healthz before reaping.
-                    if self.path.rstrip("/") == "/admin/drain":
-                        inflight = running.drain()
-                        self._reply(200, {"status": "draining",
-                                          "inflight": inflight})
-                        return
-                    # Exact route, like do_GET: a suffix match would
-                    # accept /junk/v1/models/<name>:predict.
-                    if self.path.rstrip("/") != f"/v1/models/{name}:predict":
-                        self._reply(404, {"error": f"unknown path {self.path}"})
-                        return
-                    instances = payload.get("instances")
-                    if instances is None:
-                        self._reply(400, {"error": "payload must carry 'instances'"})
-                        return
-                    m_requests.inc()
-                    if workload.capturing():
-                        # Arm the per-request capture tap: _reply (the
-                        # single exit every branch funnels through)
-                        # records the request WITH its final status —
-                        # sheds, deadline 504s, and 500s included.
-                        self._capture_ctx = (
-                            payload, instances, t_arr_mono, t_arr_wall)
-                    # The trace enters (or starts) here: an incoming
-                    # `traceparent` — the fleet router injects one per
-                    # forward hop — makes this request span a child of
-                    # that hop; a bare request starts a fresh trace
-                    # under the tracer's sampling decision.
-                    # QoS: the fleet router stamps the RESOLVED class
-                    # on its forwards (clients of a bare endpoint may
-                    # also claim one); a relayed brownout level is
-                    # adopted with a TTL so this replica degrades with
-                    # the fleet.
-                    priority = qos.parse_priority(
-                        self.headers.get(qos.PRIORITY_HEADER))
-                    qos.note_remote_brownout(
-                        self.headers.get(qos.BROWNOUT_HEADER))
-                    want_debug = (
-                        self.headers.get(tracing.DEBUG_HEADER) or ""
-                    ).strip().lower() == "timeline"
-                    tspan = tracing.start_trace(
-                        "serving.request", headers=self.headers, model=name,
-                        force_sample=want_debug)
-                    self._capture_span = tspan
-                    with tspan, qos.priority_scope(priority):
-                        # Shedding BEFORE any model work — draining (stop
-                        # ADMITTING, keep finishing; the admission check is
-                        # atomic with the in-flight count inside _enter, so
-                        # /healthz can never report inflight==0 while a
-                        # checked-but-not-yet-admitted request sneaks in)
-                        # and overload (under a burst past max_inflight the
-                        # cheapest correct answer is an immediate 503 +
-                        # Retry-After — queueing collapses every request's
-                        # latency, not just the excess). One 503 shape for
-                        # both: clients and the fleet router share a single
-                        # retry path.
-                        slot, shed_reason = running._enter(priority)
-                        if slot is None:
-                            m_shed.inc(model=name, reason=shed_reason)
-                            tspan.annotate(shed=shed_reason)
-                            if shed_reason == "draining":
-                                msg = "draining; endpoint is going away"
-                            elif shed_reason == "qos":
-                                msg = ("batch traffic shed; interactive "
-                                       "headroom reserved")
-                            else:
-                                msg = "overloaded; retry later"
-                            self._reply(
-                                503, {"error": msg},
-                                headers={"Retry-After": "1"},
-                            )
-                            return
-                        try:
-                            self._predict_and_reply(
-                                payload, instances, slot, tspan)
-                        finally:
-                            slot.release()  # no-op once transferred
-                except Exception as e:  # noqa: BLE001 — server must stay up
-                    m_errors.inc()
-                    self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        def _do_get(path: str, headers: Any):
+            # TF-Serving's model-status contract
+            # (GET /v1/models/<name>), extended with live engine
+            # telemetry when the predictor exposes stats() — the
+            # LM engine's dispatches, occupancy, prefix hits, and
+            # speculation acceptance.
+            try:
+                # Prometheus scrape rides the serving's own port
+                # (GET /metrics, GET /metrics.json) — the whole
+                # process's registry, not just this endpoint. The
+                # debug surfaces (/debug/traces, /debug/flight)
+                # ride the same port: this process's span ring and
+                # flight recorder.
+                resp = telemetry_export.metrics_response(path)
+                if resp is None:
+                    resp = telemetry_export.debug_response(path)
+                if resp is not None:
+                    return resp
+                # Readiness: load balancers and supervisors poll
+                # this; an open breaker = the predictor is down,
+                # stop routing here until the half-open probe heals.
+                # A DRAINING endpoint is also unready (503 +
+                # Retry-After) and reports its in-flight count, so
+                # a rollout can gate the reap on inflight == 0 off
+                # the same probe the router stops routing on.
+                if path.rstrip("/") == "/healthz":
+                    bstate = breaker.state
+                    if running.draining:
+                        return _json(
+                            503,
+                            {"status": "draining", "breaker": bstate,
+                             "inflight": running.inflight},
+                            extra={"Retry-After": "1"},
+                        )
+                    if bstate == "open":
+                        retry = max(1.0, breaker.retry_after_s())
+                        return _json(
+                            503,
+                            {"status": "unready", "breaker": bstate},
+                            extra={"Retry-After": f"{retry:.0f}"},
+                        )
+                    return _json(200, {"status": "ok", "breaker": bstate})
+                # Exact TF-Serving routes only: /v1/models/<name>
+                # and the versioned /v1/models/<name>/versions/<N>
+                # form (a suffix match would accept arbitrary
+                # prefixes like /junk/v1/models/<name>).
+                p = path.rstrip("/")
+                base = f"/v1/models/{name}"
+                versioned = p.startswith(base + "/versions/")
+                if versioned:
+                    ver = p[len(base) + len("/versions/"):]
+                    if ver != str(cfg.get("model_version", 1)):
+                        return _json(404, {"error": f"unknown version {ver}"})
+                elif p != base:
+                    return _json(404, {"error": f"unknown path {path}"})
+                body: dict[str, Any] = {
+                    "model_version_status": [{
+                        "version": str(cfg.get("model_version", 1)),
+                        "state": "AVAILABLE",
+                    }],
+                }
+                if hasattr(raw_predictor, "stats"):
+                    body["engine"] = raw_predictor.stats()
+                return _json(200, body)
+            except Exception as e:  # noqa: BLE001 — server must stay up
+                return _json(500, {"error": f"{type(e).__name__}: {e}"})
 
-            def _maybe_debug(self, body: dict[str, Any],
-                             tspan: Any) -> dict[str, Any]:
-                """Attach the inline per-hop timing breakdown when the
-                request asked for it (``X-Hops-Debug: timeline``) and
-                this request is traced — the router merges its own hops
-                into the same list on the way back out."""
-                want = self.headers.get(tracing.DEBUG_HEADER, "")
-                if want.strip().lower() == "timeline":
-                    rows = tracing.timeline(tspan)
-                    if rows:
-                        body["debug"] = {
-                            "trace_id": rows[0]["trace_id"],
-                            "timeline": rows,
-                        }
-                return body
-
-            def _predict_and_reply(
-                self, payload: dict[str, Any], instances: list[Any],
-                slot: _InflightSlot, tspan: Any,
-            ) -> None:
-                # Breaker check after shedding: an open breaker means
-                # the predictor itself is failing — don't waste a
-                # half-open probe on a request we'd shed anyway.
-                if not breaker.allow():
-                    m_shed.inc(model=name, reason="breaker")
-                    tspan.annotate(shed="breaker")
-                    retry = max(1.0, breaker.retry_after_s())
-                    self._reply(
-                        503,
-                        {"error": "circuit open; predictor failing"},
-                        headers={"Retry-After": f"{retry:.0f}"},
-                    )
-                    return
-                try:
-                    # span() records into the request-latency histogram
-                    # even when predict raises — error latency is
-                    # latency; the error counter increments below.
-                    with span("hops_tpu_serving_request", model=name):
-                        # Chaos point, keyed by this endpoint's port so
-                        # a gray (slow-not-dead) fault can target ONE
-                        # replica of an in-process fleet.
-                        faultinject.fire("serving.handle", key=running.port)
-                        if running.deadline_s:
-                            # The worker owns the slot from here: a
-                            # deadline overrun abandons the predict but
-                            # its computation still occupies predictor
-                            # capacity until it actually finishes.
-                            slot.transfer()
-
-                            def predict_holding_slot(rows):
-                                try:
-                                    return predictor.predict(rows)
-                                finally:
-                                    slot.release(from_worker=True)
-
-                            preds = with_deadline(
-                                predict_holding_slot, running.deadline_s,
-                                instances, op="serving.handle")
-                        else:
-                            preds = predictor.predict(instances)
-                except qos.ShedError as e:
-                    # Evicted from the batch queue by higher-priority
-                    # work (reason="qos") or refused at a full submit
-                    # queue (QueueFullError, reason="overload"): a
-                    # shed, not a failure — no breaker strike, same
-                    # 503 retry shape as every other shed.
-                    reason = (
-                        "overload" if isinstance(e, qos.QueueFullError)
-                        else "qos"
-                    )
-                    m_shed.inc(model=name, reason=reason)
-                    tspan.annotate(shed=reason)
-                    self._reply(
-                        503, self._maybe_debug(
-                            {"error": f"{type(e).__name__}: {e}"}, tspan),
-                        headers={"Retry-After": "1"},
-                    )
-                    return
-                except DeadlineExceeded as e:
-                    breaker.record_failure()
-                    m_errors.inc()
-                    self._reply(504, self._maybe_debug(
-                        {"error": f"{type(e).__name__}: {e}"}, tspan))
-                    return
-                except Exception as e:  # noqa: BLE001 — fail THIS request
-                    breaker.record_failure()
-                    m_errors.inc()
-                    self._reply(500, self._maybe_debug(
-                        {"error": f"{type(e).__name__}: {e}"}, tspan))
-                    return
-                breaker.record_success()
-                response = {"predictions": preds}
-                producer.send(
-                    {"request": payload, "response": response}, key=name
+        def _predict_resp(headers: Any, payload: dict[str, Any],
+                          instances: list[Any], slot: _InflightSlot,
+                          tspan: Any):
+            # Breaker check after shedding: an open breaker means
+            # the predictor itself is failing — don't waste a
+            # half-open probe on a request we'd shed anyway.
+            if not breaker.allow():
+                m_shed.inc(model=name, reason="breaker")
+                tspan.annotate(shed="breaker")
+                retry = max(1.0, breaker.retry_after_s())
+                return _json(
+                    503,
+                    {"error": "circuit open; predictor failing"},
+                    extra={"Retry-After": f"{retry:.0f}"},
                 )
-                m_logged.inc()
-                self._reply(200, self._maybe_debug(response, tspan))
+            try:
+                # span() records into the request-latency histogram
+                # even when predict raises — error latency is
+                # latency; the error counter increments below.
+                with span("hops_tpu_serving_request", model=name):
+                    # Chaos point, keyed by this endpoint's port so
+                    # a gray (slow-not-dead) fault can target ONE
+                    # replica of an in-process fleet.
+                    faultinject.fire("serving.handle", key=running.port)
+                    if running.deadline_s:
+                        # The worker owns the slot from here: a
+                        # deadline overrun abandons the predict but
+                        # its computation still occupies predictor
+                        # capacity until it actually finishes.
+                        slot.transfer()
 
-            def _reply(self, code: int, body: dict[str, Any],
-                       headers: dict[str, str] | None = None) -> None:
-                data = json.dumps(body).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                for k, v in (headers or {}).items():
-                    self.send_header(k, v)
-                self.end_headers()
-                self.wfile.write(data)
-                ctx = getattr(self, "_capture_ctx", None)
-                if ctx is not None:
-                    # The workload tap: every predict branch replies
-                    # exactly once, so this is the one place the final
-                    # status and latency are both known. After the
-                    # write — capture must not delay the response.
-                    self._capture_ctx = None
-                    req_payload, req_instances, t_mono, t_wall = ctx
-                    tspan = getattr(self, "_capture_span", None)
-                    workload.record_request(
-                        surface="serving",
-                        endpoint=name,
-                        path=self.path,
-                        tenant=self.headers.get("X-Tenant"),
-                        payload=req_payload,
-                        instances=req_instances,
-                        lm_mode=cfg["model_server"] == LM,
-                        status=code,
-                        latency_ms=(time.monotonic() - t_mono) * 1e3,
-                        trace_id=(
-                            tspan.trace_id
-                            if getattr(tspan, "sampled", False) else None
-                        ),
-                        t_mono=t_mono,
-                        t_wall=t_wall,
-                    )
+                        def predict_holding_slot(rows):
+                            try:
+                                return predictor.predict(rows)
+                            finally:
+                                slot.release(from_worker=True)
 
-        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
-        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
-        self.thread.start()
+                        preds = with_deadline(
+                            predict_holding_slot, running.deadline_s,
+                            instances, op="serving.handle")
+                    else:
+                        preds = predictor.predict(instances)
+            except qos.ShedError as e:
+                # Evicted from the batch queue by higher-priority
+                # work (reason="qos") or refused at a full submit
+                # queue (QueueFullError, reason="overload"): a
+                # shed, not a failure — no breaker strike, same
+                # 503 retry shape as every other shed.
+                reason = (
+                    "overload" if isinstance(e, qos.QueueFullError)
+                    else "qos"
+                )
+                m_shed.inc(model=name, reason=reason)
+                tspan.annotate(shed=reason)
+                return _json(
+                    503, _maybe_debug(
+                        headers, {"error": f"{type(e).__name__}: {e}"}, tspan),
+                    extra={"Retry-After": "1"},
+                )
+            except DeadlineExceeded as e:
+                breaker.record_failure()
+                m_errors.inc()
+                return _json(504, _maybe_debug(
+                    headers, {"error": f"{type(e).__name__}: {e}"}, tspan))
+            except Exception as e:  # noqa: BLE001 — fail THIS request
+                breaker.record_failure()
+                m_errors.inc()
+                return _json(500, _maybe_debug(
+                    headers, {"error": f"{type(e).__name__}: {e}"}, tspan))
+            breaker.record_success()
+            response = {"predictions": preds}
+            producer.send(
+                {"request": payload, "response": response}, key=name
+            )
+            m_logged.inc()
+            return _json(200, _maybe_debug(headers, response, tspan))
+
+        def _do_post_inner(path: str, headers: Any, raw_body: bytes,
+                           cap: dict[str, Any]):
+            # Workload-capture control plane (arm / finalize the
+            # process-global recorder; status rides GET
+            # /debug/workload). Checked BEFORE the strict body parse
+            # so a sloppy body degrades to {} — the same tolerant
+            # contract as the router's route (a capture/stop must not
+            # fail on replicas while succeeding on the front door).
+            if path.split("?", 1)[0].rstrip("/").startswith(
+                    "/admin/capture/"):
+                try:
+                    admin_payload = json.loads(raw_body)
+                except ValueError:
+                    admin_payload = {}
+                return _json(*workload.admin_action(path, admin_payload))
+            payload = json.loads(raw_body)
+            # Fleet control plane: flip this endpoint into the
+            # draining state (rollouts, scale-downs). Replies with
+            # the in-flight count the caller will poll to zero on
+            # /healthz before reaping.
+            if path.rstrip("/") == "/admin/drain":
+                inflight = running.drain()
+                return _json(200, {"status": "draining",
+                                   "inflight": inflight})
+            # Exact route, like GET: a suffix match would accept
+            # /junk/v1/models/<name>:predict.
+            if path.rstrip("/") != f"/v1/models/{name}:predict":
+                return _json(404, {"error": f"unknown path {path}"})
+            instances = payload.get("instances")
+            if instances is None:
+                return _json(400, {"error": "payload must carry 'instances'"})
+            m_requests.inc()
+            if workload.capturing():
+                # Arm the per-request capture tap: the route's single
+                # exit records the request WITH its final status —
+                # sheds, deadline 504s, and 500s included.
+                cap["payload"] = payload
+                cap["instances"] = instances
+            # The trace enters (or starts) here: an incoming
+            # `traceparent` — the fleet router injects one per
+            # forward hop — makes this request span a child of
+            # that hop; a bare request starts a fresh trace
+            # under the tracer's sampling decision.
+            # QoS: the fleet router stamps the RESOLVED class
+            # on its forwards (clients of a bare endpoint may
+            # also claim one); a relayed brownout level is
+            # adopted with a TTL under THIS model's scope so the
+            # replica degrades with its fleet — and only its
+            # fleet, on a host serving several.
+            priority = qos.parse_priority(headers.get(qos.PRIORITY_HEADER))
+            qos.note_remote_brownout(headers.get(qos.BROWNOUT_HEADER),
+                                     scope=name)
+            want_debug = (
+                headers.get(tracing.DEBUG_HEADER) or ""
+            ).strip().lower() == "timeline"
+            tspan = tracing.start_trace(
+                "serving.request", headers=headers, model=name,
+                force_sample=want_debug)
+            if cap:
+                cap["tspan"] = tspan
+            with tspan, qos.priority_scope(priority), \
+                    qos.brownout_scope(name):
+                # Shedding BEFORE any model work — draining (stop
+                # ADMITTING, keep finishing; the admission check is
+                # atomic with the in-flight count inside _enter, so
+                # /healthz can never report inflight==0 while a
+                # checked-but-not-yet-admitted request sneaks in)
+                # and overload (under a burst past max_inflight the
+                # cheapest correct answer is an immediate 503 +
+                # Retry-After — queueing collapses every request's
+                # latency, not just the excess). One 503 shape for
+                # both: clients and the fleet router share a single
+                # retry path.
+                slot, shed_reason = running._enter(priority)
+                if slot is None:
+                    m_shed.inc(model=name, reason=shed_reason)
+                    tspan.annotate(shed=shed_reason)
+                    if shed_reason == "draining":
+                        msg = "draining; endpoint is going away"
+                    elif shed_reason == "qos":
+                        msg = ("batch traffic shed; interactive "
+                               "headroom reserved")
+                    else:
+                        msg = "overloaded; retry later"
+                    return _json(503, {"error": msg},
+                                 extra={"Retry-After": "1"})
+                try:
+                    return _predict_resp(
+                        headers, payload, instances, slot, tspan)
+                finally:
+                    slot.release()  # no-op once transferred
+
+        def _do_post(path: str, headers: Any, body: bytes):
+            # Workload capture stamps the ARRIVAL, not the predict
+            # start — queueing ahead of the handler is part of the
+            # workload being recorded.
+            t_arr_mono, t_arr_wall = time.monotonic(), time.time()
+            cap: dict[str, Any] = {}
+            try:
+                resp = _do_post_inner(path, headers, body or b"{}", cap)
+            except Exception as e:  # noqa: BLE001 — server must stay up
+                m_errors.inc()
+                resp = _json(500, {"error": f"{type(e).__name__}: {e}"})
+            if not cap:
+                return resp
+            # The workload tap: every predict branch replies exactly
+            # once, so this is the one place the final status and
+            # latency are both known. Runs as the route's `after`
+            # callback — after the response is queued for write, so
+            # capture never delays the reply.
+            status = resp[0]
+            tspan = cap.get("tspan")
+
+            def after() -> None:
+                workload.record_request(
+                    surface="serving",
+                    endpoint=name,
+                    path=path,
+                    tenant=headers.get("X-Tenant"),
+                    payload=cap["payload"],
+                    instances=cap["instances"],
+                    lm_mode=cfg["model_server"] == LM,
+                    status=status,
+                    latency_ms=(time.monotonic() - t_arr_mono) * 1e3,
+                    trace_id=(
+                        tspan.trace_id
+                        if getattr(tspan, "sampled", False) else None
+                    ),
+                    t_mono=t_arr_mono,
+                    t_wall=t_arr_wall,
+                )
+
+            return resp[0], resp[1], resp[2], after
+
+        def route(method: str, path: str, headers: Any, body: bytes):
+            if method == "GET":
+                return _do_get(path, headers)
+            if method == "POST":
+                return _do_post(path, headers, body)
+            return _json(404, {"error": f"unknown path {path}"})
+
+        self.server = HTTPServer(
+            route, bind="127.0.0.1", port=0, name=f"serving-{name}",
+            workers=int(rcfg.get("http_workers", 16)))
 
     def _enter(
         self, priority: str = "interactive"
@@ -1143,11 +1128,10 @@ class _RunningServing:
 
     @property
     def port(self) -> int:
-        return self.server.server_address[1]
+        return self.server.port
 
     def stop(self) -> None:
-        self.server.shutdown()
-        self.server.server_close()
+        self.server.stop()
         if self.batcher is not None:
             self.batcher.stop()
         if hasattr(self.predictor, "stop"):  # LMEnginePredictor's driver thread

@@ -52,6 +52,7 @@ import bisect
 import dataclasses
 import json
 import os
+import threading
 import time
 from pathlib import Path
 from typing import Any, Callable
@@ -640,6 +641,92 @@ class RegistryFleetPublisher:
         return result
 
 
+class _CutoverWorker:
+    """FIFO background driver for cutover rollouts.
+
+    A passed gate used to run ``publisher.publish`` inline, pausing
+    training for the whole registry push + canary-judged fleet rollout
+    (~2 s per passed gate on the CPU tier) — visible as a freshness-lag
+    dip at the following gate. This worker moves the publish onto ONE
+    background thread so the next segment trains while the fleet bakes
+    the canary.
+
+    Semantics are preserved exactly, not approximately:
+
+    - **Version order**: one thread, one queue — rollouts reach the
+      fleet in gate order, never interleaved.
+    - **The comparison bar**: results are NOT folded into ``best`` by
+      the worker. The training loop calls :meth:`drain` right before
+      judging the next gate (and once more before returning), so every
+      gate decision sees all prior cutover outcomes — the same
+      happens-before as the inline call, minus the training pause.
+    - **Failures**: an exception from ``publish`` is re-raised out of
+      :meth:`drain` on the training thread, where the inline version
+      would have raised it; results that completed first still fold.
+
+    ``state`` is captured by reference at submit time — safe because
+    the training loop never mutates a state in place (train_step
+    returns a fresh pytree; the reference the gate judged is the
+    reference the publisher exports).
+    """
+
+    def __init__(self, publisher: "RegistryFleetPublisher"):
+        self._publisher = publisher
+        self._cond = threading.Condition()
+        self._queue: list[tuple[Any, int, float] | None] = []  # guarded by: self._cond
+        self._results: list[tuple[int, float, dict | None, BaseException | None]] = []  # guarded by: self._cond
+        self._inflight = 0  # guarded by: self._cond
+        self._thread = threading.Thread(
+            target=self._run, name="continuous-cutover", daemon=True)
+        self._thread.start()
+
+    def submit(self, state: Any, step: int, metric: float) -> None:
+        with self._cond:
+            self._queue.append((state, step, metric))
+            self._inflight += 1
+            self._cond.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue:
+                    self._cond.wait()
+                item = self._queue.pop(0)
+            if item is None:
+                return
+            state, step, metric = item
+            cut: dict | None = None
+            err: BaseException | None = None
+            try:
+                cut = self._publisher.publish(state, step, metric)
+            except BaseException as e:  # noqa: BLE001 — surfaced via drain()
+                err = e
+            with self._cond:
+                self._results.append((step, metric, cut, err))
+                self._inflight -= 1
+                self._cond.notify_all()
+
+    def drain(self) -> list[tuple[int, float, dict | None, BaseException | None]]:
+        """Block until every submitted cutover has settled; return the
+        ``(step, metric, result, error)`` tuples in submission order.
+        The caller folds successful results into its bookkeeping and
+        then re-raises the first error — so cutovers that completed
+        before a failing publish are never lost, exactly as if each had
+        run inline."""
+        with self._cond:
+            while self._inflight > 0:
+                self._cond.wait()
+            settled = self._results
+            self._results = []
+        return settled
+
+    def stop(self) -> None:
+        with self._cond:
+            self._queue.append(None)
+            self._cond.notify()
+        self._thread.join(timeout=30)
+
+
 # -- the loop ------------------------------------------------------------------
 
 
@@ -705,6 +792,14 @@ def run_continuous(
     that passes but trips the canary breaker is rolled back by the
     rollout itself; its metric is then not adopted as the bar.
 
+    Cutovers are **asynchronous**: a passed candidate is handed to a
+    single FIFO background thread (:class:`_CutoverWorker`) and the
+    next segment starts training immediately — the registry push and
+    canary-judged fleet rollout no longer pause the stream. Outcomes
+    are settled on the training thread right before the next gate (and
+    before returning), so the bar every gate judges against is
+    identical to the inline ordering.
+
     Runs until the stream finishes (``max_steps`` / ``stop_when`` /
     idle with ``stop_on_idle``) or a preemption notice arrives.
     ``mode`` is ``"max"`` (higher is better) or ``"min"``.
@@ -726,7 +821,38 @@ def run_continuous(
     best: float | None = None
     gates: list[dict[str, Any]] = []
     cutovers: list[dict[str, Any]] = []
+    worker = _CutoverWorker(publisher) if publisher is not None else None
     done = 0
+
+    def fold_cutovers() -> None:
+        """Settle in-flight rollouts and fold their outcomes into the
+        bookkeeping (cutover history, metrics, the comparison bar).
+        Runs on the training thread right before each gate decision and
+        once before returning — every gate judges against a bar that
+        reflects all prior cutover outcomes, same as the inline call."""
+        nonlocal best
+        failure: BaseException | None = None
+        for cstep, cmetric, cut, err in worker.drain():
+            if err is not None:
+                failure = failure or err
+                continue
+            _m_cutovers.inc(outcome=cut["outcome"])
+            flight.record("cutover", step=cstep,
+                          version=cut.get("version"),
+                          outcome=cut["outcome"])
+            cutovers.append({"step": cstep, "metric": cmetric, **cut})
+            if cut["outcome"] in ("pushed", "completed"):
+                best = _advance_bar(best, cmetric, mode)
+            else:
+                log.warning(
+                    "continuous: cutover of version %s at step %d "
+                    "ended %s — the fleet rolled back, the bar "
+                    "stays at %.6g",
+                    cut.get("version"), cstep, cut["outcome"],
+                    best if best is not None else float("nan"))
+        if failure is not None:
+            raise failure
+
     try:
         while True:
             prev_done = done
@@ -740,6 +866,11 @@ def run_continuous(
                 t0 = time.monotonic()
                 metric = float(eval_fn(state))
                 _m_gate_seconds.observe(time.monotonic() - t0)
+                if worker is not None:
+                    # The previous segment trained WHILE its cutover
+                    # rolled out; settle the outcome now so this gate
+                    # judges against the true bar.
+                    fold_cutovers()
                 passed = _improves(metric, best, mode, min_delta)
                 outcome = "pass" if passed else "fail"
                 _m_gates.inc(outcome=outcome)
@@ -753,22 +884,11 @@ def run_continuous(
                         "continuous: eval gate FAILED at step %d (%s=%.6g "
                         "vs best %.6g) — candidate held back, incumbent "
                         "keeps serving", done, mode, metric, best)
-                elif publisher is not None:
-                    cut = publisher.publish(state, done, metric)
-                    _m_cutovers.inc(outcome=cut["outcome"])
-                    flight.record("cutover", step=done,
-                                  version=cut.get("version"),
-                                  outcome=cut["outcome"])
-                    cutovers.append({"step": done, "metric": metric, **cut})
-                    if cut["outcome"] in ("pushed", "completed"):
-                        best = _advance_bar(best, metric, mode)
-                    else:
-                        log.warning(
-                            "continuous: cutover of version %s at step %d "
-                            "ended %s — the fleet rolled back, the bar "
-                            "stays at %.6g",
-                            cut.get("version"), done, cut["outcome"],
-                            best if best is not None else float("nan"))
+                elif worker is not None:
+                    # Hand the rollout to the background worker: the
+                    # next segment starts training immediately while
+                    # the registry push + canary bake run off-thread.
+                    worker.submit(state, done, metric)
                 else:
                     best = _advance_bar(best, metric, mode)
             if stream.finished or preempted:
@@ -779,7 +899,11 @@ def run_continuous(
                 log.warning("continuous: segment at step %d made no "
                             "progress; stopping", done)
                 break
+        if worker is not None:
+            fold_cutovers()  # the final segment's rollout, if any
     finally:
+        if worker is not None:
+            worker.stop()
         if own_guard:
             guard.uninstall()
     return ContinuousResult(

@@ -154,6 +154,163 @@ class HTTPPool:
             f"connection to {host}:{port} failed: {last_exc}"
         ) from last_exc
 
+    # -- multiplexing: pipelined batches --------------------------------------
+
+    @staticmethod
+    def _split(url: str) -> tuple[str, int, str]:
+        parts = urlsplit(url)
+        path = parts.path or "/"
+        if parts.query:
+            path = f"{path}?{parts.query}"
+        return parts.hostname or "127.0.0.1", parts.port or 80, path
+
+    def pipeline(
+        self,
+        requests: "list[tuple[str, str, bytes | None, Mapping[str, str] | None]]",
+        *,
+        timeout_s: float,
+    ) -> list[tuple[int, bytes, dict[str, str]]]:
+        """In-flight HTTP/1.1 pipelining on ONE pooled connection: every
+        request in the batch — ``(method, url, body, headers)`` tuples,
+        all on the same ``(host, port)`` — is written back-to-back
+        before the first response is read, then responses are read in
+        request order. One syscall burst and one connection for a whole
+        scrape/probe batch instead of a request-response round trip
+        each (the event-loop server core parses and answers pipelined
+        requests in order; see ``runtime/httpserver``).
+
+        All-or-nothing: any transport failure raises for the whole
+        batch (an ``OSError`` subclass, like :meth:`request`) — callers
+        that need per-request isolation use :meth:`get_many`, which
+        falls back to sequential requests. Batches must therefore stay
+        idempotent, the same contract as the stale-keep-alive retry."""
+        if not requests:
+            return []
+        host, port, _ = self._split(requests[0][1])
+        wire = bytearray()
+        methods: list[str] = []
+        for method, url, body, headers in requests:
+            h, p, path = self._split(url)
+            if (h, p) != (host, port):
+                raise ValueError(
+                    f"pipeline batch spans hosts: {host}:{port} vs {h}:{p}")
+            methods.append(method)
+            lines = [f"{method} {path} HTTP/1.1\r\n", f"Host: {host}:{port}\r\n"]
+            for k, v in dict(headers or {}).items():
+                lines.append(f"{k}: {v}\r\n")
+            if body is not None or method in ("POST", "PUT", "PATCH"):
+                lines.append(f"Content-Length: {len(body or b'')}\r\n")
+            lines.append("\r\n")
+            wire += "".join(lines).encode("latin-1")
+            if body:
+                wire += body
+        conn, reused = self._checkout(host, port, timeout_s)
+        try:
+            if conn.sock is None:
+                conn.connect()
+                conn.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn.sock.settimeout(timeout_s)
+            conn.sock.sendall(wire)
+            out: list[tuple[int, bytes, dict[str, str]]] = []
+            will_close = False
+            # One shared buffered reader for the whole batch:
+            # a fresh HTTPResponse per response would each wrap the
+            # socket in its OWN buffer and swallow the next pipelined
+            # response's bytes.
+            fp = conn.sock.makefile("rb")
+            try:
+                for _ in methods:
+                    status_line = fp.readline(65536)
+                    parts = status_line.split(None, 2)
+                    if len(parts) < 2 or not parts[0].startswith(b"HTTP/"):
+                        raise http.client.BadStatusLine(
+                            status_line.decode("latin-1", "replace"))
+                    status = int(parts[1])
+                    msg = http.client.parse_headers(fp)
+                    if "chunked" in (
+                            msg.get("Transfer-Encoding") or "").lower():
+                        raise http.client.HTTPException(
+                            "chunked responses are not pipelinable here")
+                    length = int(msg.get("Content-Length") or 0)
+                    data = fp.read(length) if length else b""
+                    if length and len(data) < length:
+                        raise http.client.IncompleteRead(data, length)
+                    out.append((status, data, dict(msg.items())))
+                    will_close = will_close or (
+                        (msg.get("Connection") or "").lower() == "close"
+                        or parts[0] == b"HTTP/1.0")
+            finally:
+                fp.close()  # drops the buffer; conn still owns the socket
+            if will_close:
+                conn.close()
+            else:
+                self._checkin(host, port, conn)
+            return out
+        except (OSError, http.client.HTTPException) as e:
+            conn.close()
+            if isinstance(e, http.client.HTTPException):
+                raise ConnectionError(
+                    f"http protocol failure pipelining to "
+                    f"{host}:{port}: {type(e).__name__}: {e}"
+                ) from e
+            raise
+
+    def get_many(
+        self,
+        requests: "list[tuple[str, str, bytes | None, Mapping[str, str] | None]]",
+        *,
+        timeout_s: float,
+    ) -> "list[tuple[int, bytes, dict[str, str]] | Exception]":
+        """Coalesced batch fetch: requests to the same ``(host, port)``
+        are pipelined on one pooled connection; distinct hosts run
+        concurrently (one thread per host group). Returns a list
+        aligned with ``requests`` where each entry is ``(status, body,
+        headers)`` or the ``Exception`` that request raised — one bad
+        peer never fails its batch-mates. A pipelined group that fails
+        at the transport falls back to per-request :meth:`request`
+        (idempotency required, as everywhere in this pool)."""
+        results: list[Any] = [None] * len(requests)
+        groups: dict[tuple[str, int], list[int]] = {}
+        for i, (_, url, _, _) in enumerate(requests):
+            host, port, _ = self._split(url)
+            groups.setdefault((host, port), []).append(i)
+
+        def run_group(idxs: list[int]) -> None:
+            if len(idxs) > 1:
+                try:
+                    outs = self.pipeline(
+                        [requests[i] for i in idxs], timeout_s=timeout_s)
+                except OSError:
+                    pass  # degrade to per-request isolation below
+                else:
+                    for i, out in zip(idxs, outs):
+                        results[i] = out
+                    return
+            for i in idxs:
+                method, url, body, headers = requests[i]
+                try:
+                    results[i] = self.request(
+                        method, url, body=body, headers=headers,
+                        timeout_s=timeout_s)
+                except OSError as e:
+                    results[i] = e
+
+        grouped = list(groups.values())
+        if len(grouped) <= 1:
+            for idxs in grouped:
+                run_group(idxs)
+            return results
+        threads = [
+            threading.Thread(target=run_group, args=(idxs,), daemon=True)
+            for idxs in grouped
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return results
+
     def idle_count(self) -> int:
         with self._lock:
             return sum(len(s) for s in self._idle.values())

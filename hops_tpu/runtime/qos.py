@@ -105,46 +105,98 @@ def priority_scope(priority: str) -> Iterator[None]:
         _current_priority.reset(token)
 
 
-# -- the brownout level, shared process-wide -----------------------------------
+# -- the brownout level, scoped per endpoint ------------------------------------
+#
+# Originally ONE process-wide level — which meant a multi-fleet host
+# (two in-process fleets, or a serving replica co-located with an
+# online-serving daemon) browned out EVERY endpoint the moment one
+# model's SLO burned. Levels are now keyed by a *scope* string (a fleet
+# or model name; ``""`` is the legacy process-global scope, kept for
+# standalone daemons and existing callers). The effective level a
+# component sees is ``max(global, its scope)`` — the global scope can
+# still degrade the whole host (an operator big-red-switch), but one
+# endpoint's controller only touches its own scope.
+#
+# The scope rides the request context like the priority class does
+# (:func:`brownout_scope`): the HTTP handler enters its endpoint's
+# scope, and every layer underneath (feature joins, LM decode budgets)
+# reads :func:`brownout_level` with no arguments and resolves the
+# request's own endpoint.
 
 _brownout_lock = threading.Lock()
-_brownout_level = 0  # guarded by: _brownout_lock
-_brownout_expires = 0.0  # guarded by: _brownout_lock
+#: scope -> (level, expires_monotonic). guarded by: _brownout_lock
+_brownout_state: dict[str, tuple[int, float]] = {}
+
+_current_brownout_scope: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "hops_tpu_qos_brownout_scope", default="")
+
+
+@contextlib.contextmanager
+def brownout_scope(scope: str) -> Iterator[None]:
+    """Bind the brownout scope of the request this context serves (the
+    endpoint's fleet/model name). Rides ``contextvars`` into batcher
+    and join layers exactly like :func:`priority_scope`."""
+    token = _current_brownout_scope.set(scope or "")
+    try:
+        yield
+    finally:
+        _current_brownout_scope.reset(token)
 
 
 def set_brownout(level: int, hold_s: float = 3.0,
-                 clock: Callable[[], float] = time.monotonic) -> None:
-    """Publish the brownout level with a hold TTL. The TTL is the
-    fail-safe direction: if the controller (or the router stamping
-    headers at a subprocess replica) dies, the fleet drifts back to
-    full quality instead of staying degraded forever."""
-    global _brownout_level, _brownout_expires
+                 clock: Callable[[], float] = time.monotonic,
+                 scope: str = "") -> None:
+    """Publish the brownout level for ``scope`` with a hold TTL. The
+    TTL is the fail-safe direction: if the controller (or the router
+    stamping headers at a subprocess replica) dies, the fleet drifts
+    back to full quality instead of staying degraded forever."""
     with _brownout_lock:
-        _brownout_level = max(0, int(level))
-        _brownout_expires = clock() + hold_s if level > 0 else 0.0
+        lvl = max(0, int(level))
+        if lvl == 0:
+            _brownout_state.pop(scope or "", None)
+        else:
+            _brownout_state[scope or ""] = (lvl, clock() + hold_s)
 
 
-def brownout_level(clock: Callable[[], float] = time.monotonic) -> int:
+def _level_locked(scope: str, now: float) -> int:  # guarded by: _brownout_lock
+    state = _brownout_state.get(scope)
+    if state is None:
+        return 0
+    level, expires = state
+    return 0 if now >= expires else level
+
+
+def brownout_level(clock: Callable[[], float] = time.monotonic,
+                   scope: str | None = None) -> int:
+    """The effective level for ``scope`` (default: the scope bound to
+    the current request context, or the global scope outside one) —
+    the max of the global level and the scoped level, each under its
+    own TTL."""
+    if scope is None:
+        scope = _current_brownout_scope.get()
+    now = clock()
     with _brownout_lock:
-        if _brownout_level and clock() >= _brownout_expires:
-            return 0
-        return _brownout_level
+        level = _level_locked("", now)
+        if scope:
+            level = max(level, _level_locked(scope, now))
+        return level
 
 
 def note_remote_brownout(header_value: str | None,
-                         hold_s: float = 3.0) -> None:
+                         hold_s: float = 3.0, scope: str = "") -> None:
     """Adopt a brownout level relayed on a forward's ``X-Hops-Brownout``
     header (subprocess replicas have no view of the router's
-    controller). Only raises or refreshes — expiry is by TTL, so a
-    brief gap in browned-out traffic cannot flap the level."""
+    controller), under the replica's own endpoint scope. Only raises or
+    refreshes — expiry is by TTL, so a brief gap in browned-out traffic
+    cannot flap the level."""
     if not header_value:
         return
     try:
         level = int(str(header_value).strip())
     except ValueError:
         return
-    if level > 0 and level >= brownout_level():
-        set_brownout(level, hold_s=hold_s)
+    if level > 0 and level >= brownout_level(scope=scope):
+        set_brownout(level, hold_s=hold_s, scope=scope)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -27,7 +27,7 @@ import math
 import os
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any
 
 from hops_tpu.telemetry import metrics as _metrics
@@ -127,17 +127,19 @@ def snapshot(registry: Registry = REGISTRY,
     return {"time": time.time(), "host": _metrics.hosttag(), "metrics": out}
 
 
-def handle_metrics_path(handler: BaseHTTPRequestHandler,
-                        registry: Registry = REGISTRY) -> bool:
-    """Serve ``GET /metrics`` / ``GET /metrics.json`` on an existing
-    ``BaseHTTPRequestHandler`` — the hook ``modelrepo/serving.py`` uses
-    to mount the scrape route on each serving's own port. Returns True
-    if the request path was a metrics route (and was answered).
+def metrics_response(
+    path_qs: str, registry: Registry = REGISTRY
+) -> "tuple[int, dict[str, str], bytes] | None":
+    """The pure half of :func:`handle_metrics_path`: given a request
+    path (query string attached), return ``(status, headers, body)``
+    for the metrics routes, or ``None`` when the path is not one —
+    the shape the event-loop transport's ``route`` contract consumes
+    directly (``runtime/httpserver.py``).
 
     ``GET /metrics.json?families=a,b`` serves only the named families
     (unknown names are simply absent) — the router's scrape asks for
     exactly the gauges it routes on instead of the whole registry."""
-    path, _, query = handler.path.partition("?")
+    path, _, query = path_qs.partition("?")
     path = path.rstrip("/")
     if path == "/metrics":
         data = render_prometheus(registry).encode()
@@ -153,20 +155,36 @@ def handle_metrics_path(handler: BaseHTTPRequestHandler,
         data = json.dumps(snapshot(registry, families=wanted)).encode()
         ctype = "application/json"
     else:
+        return None
+    return 200, {"Content-Type": ctype}, data
+
+
+def handle_metrics_path(handler: BaseHTTPRequestHandler,
+                        registry: Registry = REGISTRY) -> bool:
+    """Serve ``GET /metrics`` / ``GET /metrics.json`` on an existing
+    ``BaseHTTPRequestHandler`` — the stdlib-handler wrapper around
+    :func:`metrics_response`, kept for any embedder still on the
+    thread-per-connection transport. Returns True if the request path
+    was a metrics route (and was answered)."""
+    resp = metrics_response(handler.path, registry)
+    if resp is None:
         return False
-    handler.send_response(200)
-    handler.send_header("Content-Type", ctype)
+    status, headers, data = resp
+    handler.send_response(status)
+    for k, v in headers.items():
+        handler.send_header(k, v)
     handler.send_header("Content-Length", str(len(data)))
     handler.end_headers()
     handler.wfile.write(data)
     return True
 
 
-def handle_debug_path(handler: BaseHTTPRequestHandler) -> bool:
-    """Serve the debug surfaces on an existing handler — mounted beside
-    :func:`handle_metrics_path` on every serving, replica, and router
-    port (and on :class:`MetricsServer`). Routes (docs/operations.md
-    "Tracing & debugging"):
+def debug_response(path_qs: str) -> "tuple[int, dict[str, str], bytes] | None":
+    """The pure half of :func:`handle_debug_path`: ``(status, headers,
+    body)`` for the debug surfaces, or ``None`` when the path is not
+    one. Mounted beside :func:`metrics_response` on every serving,
+    replica, and router port (and on :class:`MetricsServer`). Routes
+    (docs/operations.md "Tracing & debugging"):
 
     - ``GET /debug/traces`` — newest-first trace summaries over this
       process's span ring; ``?limit=N`` caps the summary count and
@@ -177,15 +195,13 @@ def handle_debug_path(handler: BaseHTTPRequestHandler) -> bool:
     - ``GET /debug/flight`` — the flight recorder's event ring;
     - ``GET /debug/workload`` — workload-capture status (armed,
       artifact directory, segment/request/byte counts).
-
-    Returns True if the request path was a debug route (and answered).
     """
     # Lazy: flight lives in runtime (which imports this package).
     from hops_tpu.runtime import flight as _flight
     from hops_tpu.telemetry import tracing as _tracing
     from hops_tpu.telemetry import workload as _workload
 
-    path, _, query = handler.path.partition("?")
+    path, _, query = path_qs.partition("?")
     path = path.rstrip("/")
     code = 200
     if path == "/debug/traces":
@@ -225,10 +241,23 @@ def handle_debug_path(handler: BaseHTTPRequestHandler) -> bool:
     elif path == "/debug/workload":
         body = _workload.status()
     else:
-        return False
+        return None
     data = json.dumps(body, default=str).encode()
+    return code, {"Content-Type": "application/json"}, data
+
+
+def handle_debug_path(handler: BaseHTTPRequestHandler) -> bool:
+    """Serve the debug surfaces on an existing stdlib handler — the
+    thread-per-connection wrapper around :func:`debug_response`.
+    Returns True if the request path was a debug route (and answered).
+    """
+    resp = debug_response(handler.path)
+    if resp is None:
+        return False
+    code, headers, data = resp
     handler.send_response(code)
-    handler.send_header("Content-Type", "application/json")
+    for k, v in headers.items():
+        handler.send_header(k, v)
     handler.send_header("Content-Length", str(len(data)))
     handler.end_headers()
     handler.wfile.write(data)
@@ -236,49 +265,37 @@ def handle_debug_path(handler: BaseHTTPRequestHandler) -> bool:
 
 
 class MetricsServer:
-    """Standalone scrape endpoint: a daemon HTTP thread serving
-    ``/metrics`` (Prometheus text) and ``/metrics.json`` — plus the
-    ``/debug/*`` surfaces — for processes that have no serving port of
-    their own (training jobs, the search driver)."""
+    """Standalone scrape endpoint serving ``/metrics`` (Prometheus
+    text) and ``/metrics.json`` — plus the ``/debug/*`` surfaces — for
+    processes that have no serving port of their own (training jobs,
+    the search driver). Rides the shared event-loop transport
+    (``runtime/httpserver.py``); scrapes are read-only and cheap, so a
+    small worker pool is plenty."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
                  registry: Registry = REGISTRY):
+        # Lazy: runtime/httpserver imports this package's metrics
+        # module; importing it at export's module top would cycle.
+        from hops_tpu.runtime.httpserver import HTTPServer
+
         registry_ = registry
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *args: Any) -> None:  # silence stderr
-                pass
+        def route(method: str, path: str, headers: Any,
+                  body: bytes) -> tuple[int, dict[str, str], bytes]:
+            resp = metrics_response(path, registry_) or debug_response(path)
+            if resp is None:
+                return 404, {"Content-Type": "application/json"}, b"{}"
+            return resp
 
-            def do_GET(self) -> None:
-                try:
-                    if not (handle_metrics_path(self, registry_)
-                            or handle_debug_path(self)):
-                        self.send_response(404)
-                        self.end_headers()
-                except Exception:  # noqa: BLE001 — scrape must not kill the thread
-                    try:
-                        self.send_response(500)
-                        self.end_headers()
-                    # The scrape client hung up mid-error-reply: nothing
-                    # to tell it, and a log line per disconnect would
-                    # spam on every flaky scrape.
-                    except Exception:  # graftlint: disable=swallowed-exception
-                        pass
-
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True,
-            name="hops-metrics-http",
-        )
-        self._thread.start()
+        self._server = HTTPServer(
+            route, bind=host, port=port, name="metrics", workers=2)
 
     @property
     def port(self) -> int:
-        return self._server.server_address[1]
+        return self._server.port
 
     def stop(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
+        self._server.stop()
 
     def __enter__(self) -> "MetricsServer":
         return self

@@ -1328,6 +1328,100 @@ def test_unbounded_priority_queue_scoped_to_serving_tiers(tmp_path):
     assert rule_names(flagged) == ["unbounded-priority-queue"]
 
 
+# -- adhoc-http-server --------------------------------------------------------
+
+
+def test_adhoc_http_server_flags_instantiation_and_subclass(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        import http.server
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        srv2 = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Handler)
+        """,
+        rule="adhoc-http-server",
+        filename="hops_tpu/modelrepo/newthing.py",
+    )
+    assert rule_names(findings) == ["adhoc-http-server"] * 3
+    assert any("subclasses" in f.message for f in findings)
+
+
+def test_adhoc_http_server_sanctioned_core_exempt(tmp_path):
+    (tmp_path / "hops_tpu" / "runtime").mkdir(parents=True)
+    code = """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class _H(BaseHTTPRequestHandler):
+        pass
+
+    baseline = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+    """
+    assert lint_code(tmp_path, code, rule="adhoc-http-server",
+                     filename="hops_tpu/runtime/httpserver.py") == []
+    flagged = lint_code(tmp_path, code, rule="adhoc-http-server",
+                        filename="hops_tpu/runtime/other.py")
+    assert len(flagged) == 2
+
+
+def test_adhoc_http_server_allows_annotations_and_own_core(tmp_path):
+    """Type annotations on embedder shims (telemetry/export.py keeps
+    stdlib-handler wrappers) and the event-loop core's own identically
+    named HTTPServer class must not be flagged."""
+    (tmp_path / "hops_tpu" / "telemetry").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        from http.server import BaseHTTPRequestHandler
+
+        from hops_tpu.runtime.httpserver import HTTPServer
+
+        def handle_metrics_path(handler: BaseHTTPRequestHandler) -> bool:
+            return False
+
+        srv = HTTPServer(lambda m, p, h, b: (200, {}, b""), name="metrics")
+        """,
+        rule="adhoc-http-server",
+        filename="hops_tpu/telemetry/export.py",
+    )
+    assert findings == []
+
+
+def test_adhoc_http_server_stdlib_httpserver_import_disambiguates(tmp_path):
+    """Bare ``HTTPServer(...)`` is flagged exactly when the file
+    imported it from http.server — the stdlib class, not the core."""
+    (tmp_path / "hops_tpu" / "jobs").mkdir(parents=True)
+    flagged = lint_code(
+        tmp_path,
+        """
+        from http.server import HTTPServer
+
+        srv = HTTPServer(("127.0.0.1", 0), None)
+        """,
+        rule="adhoc-http-server",
+        filename="hops_tpu/jobs/snip.py",
+    )
+    assert rule_names(flagged) == ["adhoc-http-server"]
+
+
+def test_adhoc_http_server_tree_is_clean():
+    """All five server sites ride the event-loop core now — zero
+    findings, no baseline entries (the migration IS complete)."""
+    from hops_tpu.analysis.cli import default_target, lint_root
+
+    pkg = default_target()
+    root = lint_root([pkg])
+    rules = [r for r in engine.all_rules() if r.name == "adhoc-http-server"]
+    findings = engine.run([pkg], root=root, rules=rules)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 # -- hardcoded-loopback -------------------------------------------------------
 
 

@@ -314,6 +314,15 @@ class TestHotPathOverheadBounds:
             # mmap reads: a native lookup must at least keep pace with
             # sqlite (generous floor for noisy CI boxes).
             assert result["online_native_speedup"] > 0.9
+        # Transport: the event-loop core must cut the per-hop-pair cost
+        # at least in half on the pipelined scrape shape (measured
+        # ~2.9x; min-of-3 on both sides absorbs scheduler noise), and
+        # a fresh-dial hop must never be slower than thread-per-
+        # connection (measured ~2.4x — bounded loosely: dial cost is
+        # dominated by kernel connect/accept, noisier than the bursts).
+        assert result["transport_speedup"] >= 2.0
+        assert result["transport_dial_speedup"] > 1.0
+        assert result["transport_eventloop_us_per_request"] > 0
 
 
 # -- least-loaded selection ---------------------------------------------------
@@ -1625,6 +1634,37 @@ class TestQoSRouting:
             assert f.predict([[1]])["predictions"] == [[2]]
         assert shed.value(
             model="flt", priority="batch", reason="brownout") - base == 1
+
+    def test_brownout_scoped_per_fleet_in_shared_process(self, fleet_model):
+        """Regression: two fleets in one process — one fleet's SHED
+        must not brown out its neighbor. The browned-out fleet's
+        router sheds ITS batch traffic and its replicas adopt the
+        relayed level under their own scope; the neighbor's endpoints
+        (and the process-global scope) stay at full quality."""
+        from hops_tpu.runtime import qos
+
+        _export_version("flt2", "return [[v[0] * 3] for v in instances]")
+        serving.create_or_update("flt2", model_name="flt2",
+                                 model_version=1, model_server="PYTHON")
+        with _start(fleet_model, replicas=1,
+                    brownout={"slo_p99_ms": 50.0}) as fa, \
+                _start("flt2", replicas=1,
+                       brownout={"slo_p99_ms": 50.0}) as fb:
+            fa.router._brownout.level = 2  # force SHED (controller-owned)
+            with pytest.raises(urllib.error.HTTPError) as e:
+                fa.predict([[1]], priority="batch")
+            assert e.value.code == 503
+            # Interactive rides through; the forward stamps the level
+            # and the replica adopts it under scope "flt".
+            assert fa.predict([[1]])["predictions"] == [[2]]
+            assert qos.brownout_level(scope="flt") >= qos.DEGRADE
+            # The neighbor fleet and the global scope are untouched —
+            # the old process-global level would have browned out both.
+            assert qos.brownout_level(scope="flt2") == 0
+            assert qos.brownout_level() == 0
+            # flt2's batch traffic is NOT shed.
+            assert fb.predict([[1]], priority="batch")[
+                "predictions"] == [[3]]
 
     def test_histogram_p99_estimates_from_bucket_deltas(self):
         from hops_tpu.modelrepo.fleet import router as router_mod

@@ -132,6 +132,19 @@ def test_fleet_registered_in_drift_guard():
         assert mod in names
 
 
+def test_serving_transport_registered_in_drift_guard():
+    """The event-loop HTTP core is the ONE transport under every
+    server in the stack (serving replicas, the fleet router, hostd,
+    shardd, the metrics server) and the pooled client is every
+    cross-process hop; if either stops importing, all serving dies at
+    once. Pin both, plus the lint rule that keeps new server sites
+    from regrowing the thread-per-connection transport."""
+    names = _module_names()
+    assert "hops_tpu.runtime.httpserver" in names
+    assert "hops_tpu.runtime.httpclient" in names
+    assert "hops_tpu.analysis.rules.adhoc_http_server" in names
+
+
 def test_tracing_registered_in_drift_guard():
     """The distributed-tracing layer and the flight recorder are
     compiled into every serving hot path (router forwards, request

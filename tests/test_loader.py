@@ -504,6 +504,35 @@ def test_bench_stale_fallback_never_chains_stale_lines(tmp_path, monkeypatch, ca
     assert "ts=t1" in out["stale_artifact"]
 
 
+def test_bench_stale_fallback_demotes_vs_baseline(tmp_path, monkeypatch, capsys):
+    """Regression (emit_stale_or_fail): the re-emitted line used to
+    carry the ORIGINAL run's ``vs_baseline`` under the live key, so a
+    consumer reading the round artifact saw an hours-old comparison
+    (e.g. 1.40x) as this round's number. The fallback must move it to
+    ``vs_baseline_stale``."""
+    import importlib.util
+
+    root = Path(__file__).resolve().parents[1]
+    spec = importlib.util.spec_from_file_location("_bench_mod2", root / "bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    metric = "resnet50_samples_per_sec_per_chip"
+    green = {"step": "resnet50_bench", "rc": 0, "ts": "t1",
+             "stdout": json.dumps(
+                 {"metric": metric, "value": 10.0, "vs_baseline": 1.4})}
+    log = tmp_path / "HW_MEASURE.jsonl"
+    log.write_text(json.dumps(green) + "\n")
+    monkeypatch.setattr(bench, "HW_LOG", log)
+    with pytest.raises(SystemExit) as e:
+        bench.emit_stale_or_fail(metric, "relay wedged")
+    assert e.value.code == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "vs_baseline" not in out
+    assert out["vs_baseline_stale"] == 1.4
+    assert out["stale"] is True
+
+
 class TestCheckpointIntegration:
     def test_data_state_sidecar_roundtrip(self, tmp_path):
         from hops_tpu.runtime import checkpoint

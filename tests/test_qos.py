@@ -123,6 +123,69 @@ class TestBrownoutController:
         qos.set_brownout(0)
 
 
+class TestScopedBrownout:
+    """Per-endpoint brownout levels: one model's degradation must not
+    brown out its neighbors on a multi-fleet host (the two-fleets-in-
+    one-process integration rides in test_fleet.py)."""
+
+    def test_scoped_level_isolated_from_neighbors_and_global(self):
+        clock = [0.0]
+        qos.set_brownout(qos.SHED, hold_s=5.0, clock=lambda: clock[0],
+                         scope="fleet-a")
+        try:
+            assert qos.brownout_level(
+                clock=lambda: clock[0], scope="fleet-a") == qos.SHED
+            assert qos.brownout_level(
+                clock=lambda: clock[0], scope="fleet-b") == 0
+            assert qos.brownout_level(clock=lambda: clock[0]) == 0
+        finally:
+            qos.set_brownout(0, scope="fleet-a")
+
+    def test_global_level_floors_every_scope(self):
+        qos.set_brownout(qos.DEGRADE, hold_s=5.0)
+        qos.set_brownout(qos.SHED, hold_s=5.0, scope="fleet-a")
+        try:
+            # The global scope is the operator big-red-switch: every
+            # endpoint sees at least it; a deeper scoped level wins.
+            assert qos.brownout_level(scope="fleet-a") == qos.SHED
+            assert qos.brownout_level(scope="fleet-b") == qos.DEGRADE
+        finally:
+            qos.set_brownout(0)
+            qos.set_brownout(0, scope="fleet-a")
+
+    def test_scope_rides_the_request_context(self):
+        qos.set_brownout(qos.DEGRADE, hold_s=5.0, scope="model-m")
+        try:
+            assert qos.brownout_level() == 0  # outside any scope
+            with qos.brownout_scope("model-m"):
+                # The layers underneath (joins, decode budgets) call
+                # brownout_level() bare and resolve the request's own
+                # endpoint through the contextvar.
+                assert qos.brownout_level() == qos.DEGRADE
+            assert qos.brownout_level() == 0
+        finally:
+            qos.set_brownout(0, scope="model-m")
+
+    def test_remote_adoption_is_scoped(self):
+        qos.note_remote_brownout("2", hold_s=5.0, scope="model-m")
+        try:
+            assert qos.brownout_level(scope="model-m") == qos.SHED
+            assert qos.brownout_level(scope="other") == 0
+            assert qos.brownout_level() == 0
+        finally:
+            qos.set_brownout(0, scope="model-m")
+
+    def test_scoped_level_expires_by_ttl(self):
+        clock = [0.0]
+        qos.set_brownout(qos.SHED, hold_s=1.0, clock=lambda: clock[0],
+                         scope="model-m")
+        assert qos.brownout_level(
+            clock=lambda: clock[0], scope="model-m") == qos.SHED
+        clock[0] = 1.5
+        assert qos.brownout_level(
+            clock=lambda: clock[0], scope="model-m") == 0
+
+
 # -- bounded priority queue ---------------------------------------------------
 
 
