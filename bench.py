@@ -1385,7 +1385,11 @@ def run_tail_bench(
                 self.pool.close()
 
         def fleet_phase(robust: bool) -> dict:
-            kw: dict = {}
+            # Soak defaults are ON now: a bare start_fleet hedges and
+            # ejects out of the box, so the baseline leg must opt out
+            # explicitly (hedge=None / ejection=None) to stay a
+            # baseline — the same knob an operator uses.
+            kw: dict = dict(hedge=None, ejection=None)
             if robust:
                 kw = dict(
                     hedge=fleet.HedgePolicy(
@@ -2062,6 +2066,112 @@ def run_hot_path_bench(smoke: bool = False) -> dict:
         stdlib_thread.join(10)
         ev_srv.stop()
 
+    # -- 6. wire codec: packed columnar vs JSON on the predict body --------
+    # Decode produces the instance TENSOR on both legs (json.loads
+    # alone hands back nested lists the batcher would still have to
+    # np.asarray — pricing bytes→tensor is the honest comparison);
+    # encode starts from the ndarray, so the JSON leg pays the
+    # tolist() float loop the packed frame eliminates by design.
+    from hops_tpu.runtime import wirecodec
+
+    codec_arr = np.asarray(
+        [[float(i) / 7.0] * 8 for i in range(32)], dtype=np.float32)
+    codec_json_body = json.dumps({"instances": codec_arr.tolist()}).encode()
+    codec_frame = wirecodec.encode_instances(codec_arr)
+    codec_reps = max(1, iters // 4)
+
+    t0 = time.perf_counter()
+    for _ in range(codec_reps):
+        _ = json.dumps({"instances": codec_arr.tolist()}).encode()
+    codec_json_enc_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(codec_reps):
+        _ = wirecodec.encode_instances(codec_arr)
+    codec_packed_enc_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(codec_reps):
+        _ = np.asarray(json.loads(codec_json_body)["instances"],
+                       dtype=np.float32)
+    codec_json_dec_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(codec_reps):
+        _ = wirecodec.decode_instances(codec_frame)
+    codec_packed_dec_s = time.perf_counter() - t0
+
+    # The 32-key row batch (the shard get_many response shape; typed
+    # numeric columns — string features would ride a JSON-bytes column
+    # and land near parity).
+    codec_rows = [{"user_id": i, "score": float(i) / 4.0, "clicks": i * 3}
+                  for i in range(32)]
+    codec_rows_json = json.dumps({"rows": codec_rows}).encode()
+    codec_rows_frame = wirecodec.encode_rows(codec_rows)
+    t0 = time.perf_counter()
+    for _ in range(codec_reps):
+        _ = json.loads(codec_rows_json)["rows"]
+    rows_json_dec_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(codec_reps):
+        _ = wirecodec.decode_rows(codec_rows_frame)
+    rows_packed_dec_s = time.perf_counter() - t0
+
+    # -- 6b. shard multi_get: local vs remote-JSON vs remote-packed --------
+    # Same rows behind three paths: in-process shard files, a shardd
+    # server pinned JSON-only, and a packed-negotiating shardd — the
+    # per-key price of each wire. µs/key of 32-key batches, min of 3.
+    from hops_tpu.featurestore.online_serving import ShardedOnlineStore
+    from hops_tpu.jobs.placement import shardd
+
+    sh_rows = 256 if smoke else 1024
+    sh_batches = 10 if smoke else 40
+    sh_tmp = Path(tempfile.mkdtemp(prefix="hops_tpu_shardbench_"))
+    sdf = pd.DataFrame({
+        "user_id": np.arange(sh_rows),
+        "score": np.random.RandomState(3).randn(sh_rows),
+        "clicks": np.arange(sh_rows) * 3,
+    })
+    sh_keys = [
+        [{"user_id": int(k)}
+         for k in np.random.RandomState(4 + b).randint(0, sh_rows, 32)]
+        for b in range(sh_batches)
+    ]
+
+    def _multiget_us_per_key(store) -> float:
+        store.multi_get(sh_keys[0])  # warm (handshake + breaker state)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for batch_keys in sh_keys:
+                store.multi_get(batch_keys)
+            best = min(best, time.perf_counter() - t0)
+        return best / (sh_batches * 32) * 1e6
+
+    local_store = ShardedOnlineStore(
+        "bench_users", primary_key=["user_id"], shards=1,
+        root=sh_tmp / "local")
+    local_store.put_dataframe(sdf)
+    servers, remote_stores = [], {}
+    try:
+        for tag, codecs in (("json", ["json"]), ("packed", None)):
+            cfg = {"store": "bench_users", "shard_index": 0, "shards": 1,
+                   "primary_key": ["user_id"],
+                   "root": str(sh_tmp / f"srv_{tag}"), "port": 0}
+            if codecs is not None:
+                cfg["codecs"] = codecs
+            srv = shardd.ShardServer(cfg)
+            servers.append(srv)
+            srv._put_rows(sdf.to_dict("records"))
+            remote_stores[tag] = ShardedOnlineStore(
+                "bench_users", primary_key=["user_id"],
+                endpoints=[f"http://127.0.0.1:{srv.port}"])
+        shard_local_us = _multiget_us_per_key(local_store)
+        shard_json_us = _multiget_us_per_key(remote_stores["json"])
+        shard_packed_us = _multiget_us_per_key(remote_stores["packed"])
+    finally:
+        for srv in servers:
+            srv.stop()
+        local_store.close()
+        shutil.rmtree(sh_tmp, ignore_errors=True)
+
     shutil.rmtree(tmp, ignore_errors=True)
     out = {
         "relay_json_roundtrip_ns_per_request": round(
@@ -2094,6 +2204,28 @@ def run_hot_path_bench(smoke: bool = False) -> dict:
         "transport_dial_speedup": round(
             transport_dial_stdlib_us / max(transport_dial_eventloop_us, 1e-9),
             2),
+        "codec_predict_json_encode_ns": round(
+            codec_json_enc_s / codec_reps * 1e9, 1),
+        "codec_predict_packed_encode_ns": round(
+            codec_packed_enc_s / codec_reps * 1e9, 1),
+        "codec_predict_encode_speedup": round(
+            codec_json_enc_s / max(codec_packed_enc_s, 1e-12), 2),
+        "codec_predict_json_decode_ns": round(
+            codec_json_dec_s / codec_reps * 1e9, 1),
+        "codec_predict_packed_decode_ns": round(
+            codec_packed_dec_s / codec_reps * 1e9, 1),
+        "codec_predict_decode_speedup": round(
+            codec_json_dec_s / max(codec_packed_dec_s, 1e-12), 2),
+        "codec_rows_json_decode_ns": round(
+            rows_json_dec_s / codec_reps * 1e9, 1),
+        "codec_rows_packed_decode_ns": round(
+            rows_packed_dec_s / codec_reps * 1e9, 1),
+        "codec_rows_decode_speedup": round(
+            rows_json_dec_s / max(rows_packed_dec_s, 1e-12), 2),
+        "shard_multiget_local_us_per_key": round(shard_local_us, 2),
+        "shard_multiget_remote_json_us_per_key": round(shard_json_us, 2),
+        "shard_multiget_remote_packed_us_per_key": round(
+            shard_packed_us, 2),
     }
     return out
 

@@ -12,6 +12,7 @@ Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 - :mod:`.hardcoded_loopback` — ``hardcoded-loopback``
 - :mod:`.swallowed_exception` — ``swallowed-exception``
 - :mod:`.naked_retry` — ``naked-retry-loop``
+- :mod:`.json_on_hot_wire` — ``json-on-hot-wire``
 - :mod:`.blocking_call` — ``blocking-call-no-deadline``
 - :mod:`.relay_json_roundtrip` — ``relay-json-roundtrip``
 - :mod:`.unbounded_priority_queue` — ``unbounded-priority-queue``
@@ -25,6 +26,7 @@ from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effect
     hardcoded_loopback,
     host_sync,
     jit_purity,
+    json_on_hot_wire,
     lock_discipline,
     metric_consistency,
     naked_retry,

@@ -7,12 +7,17 @@ native"). The TPU build replaces it with an embedded key-value store:
 the native C++ engine in ``hops_tpu/native`` (open-addressing hash index
 over an append-only mmap'd log) when built, else a pure-sqlite fallback
 with identical semantics. Keys are the JSON-encoded primary-key values
-of a row; values are the JSON row.
+of a row; values are the row — packed struct records behind
+``wirecodec.ROW_FORMAT_PACKED`` by default, legacy JSON rows when
+``HOPS_TPU_ONLINE_ROW_FORMAT=json`` (and always on read: the format is
+sniffed per value, so existing ``.hkv``/``.db`` files keep working and
+the two formats coexist in one store).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sqlite3
 import threading
 from pathlib import Path
@@ -21,6 +26,7 @@ from typing import Any, Iterator
 import pandas as pd
 
 from hops_tpu.featurestore import storage
+from hops_tpu.runtime import wirecodec
 from hops_tpu.runtime.logging import get_logger
 
 log = get_logger(__name__)
@@ -30,19 +36,51 @@ def _key_of(pk_values: list[Any]) -> str:
     return json.dumps(pk_values, default=str, separators=(",", ":"))
 
 
+def _row_format() -> str:
+    """Write-side row format: ``packed`` (default) or ``json``.
+
+    Read paths sniff per value and never consult this — flipping the
+    env var mid-life is safe and only affects new writes.
+    """
+    fmt = os.environ.get("HOPS_TPU_ONLINE_ROW_FORMAT", "packed") \
+        .strip().lower()
+    if fmt not in ("packed", "json"):
+        raise ValueError(
+            f"HOPS_TPU_ONLINE_ROW_FORMAT={fmt!r}: pick packed|json")
+    return fmt
+
+
+def _encode_row(rec: dict, fmt: str) -> str:
+    if fmt == "packed":
+        return wirecodec.pack_row(rec)
+    return json.dumps(rec, default=str)
+
+
+def _decode_row(raw: str) -> dict:
+    """Decode one stored row value, sniffing the format byte."""
+    if wirecodec.is_packed_row(raw):
+        return wirecodec.unpack_row(raw)
+    return json.loads(raw)
+
+
 def _decode_rows(raws: list[str | None]) -> list[dict | None]:
     """Batched row decode for multi-gets: one ``json.loads`` of a
     joined array instead of one parser setup per key. After the native
     backend took the lookup itself to ~10us/key, the per-key Python
     ``json.loads`` became the dominant multi-get cost — joining the
     rows into a single array parses the whole batch in one C call
-    (``bench.py --hot-path`` carries the before/after). If the joined
-    parse fails (a malformed stored row), fall back to the per-row
-    decode so the error points at the guilty row, exactly like the
-    pre-batching path."""
+    (``bench.py --hot-path`` carries the before/after). Packed rows
+    (``wirecodec.ROW_FORMAT_PACKED`` sniffed per value) take the
+    struct-unpack path instead; a mixed batch decodes each row by its
+    own format, so stores written under either setting read back
+    correctly. If the joined parse fails (a malformed stored row), fall
+    back to the per-row decode so the error points at the guilty row,
+    exactly like the pre-batching path."""
     present = [r for r in raws if r is not None]
     if not present:
         return [None] * len(raws)
+    if any(wirecodec.is_packed_row(r) for r in present):
+        return [_decode_row(r) if r is not None else None for r in raws]
     try:
         decoded = json.loads("[" + ",".join(present) + "]")
     except ValueError:
@@ -82,10 +120,11 @@ class OnlineStore:
 
     def put_dataframe(self, df: pd.DataFrame, primary_key: list[str]) -> int:
         rows = 0
+        fmt = _row_format()
         with self._lock:
             for rec in df.to_dict(orient="records"):
                 key = _key_of([rec[k] for k in primary_key])
-                self._impl.put(key, json.dumps(rec, default=str))
+                self._impl.put(key, _encode_row(rec, fmt))
                 rows += 1
             self._impl.flush()
         return rows
@@ -116,7 +155,7 @@ class OnlineStore:
 
     def get(self, pk_values: list[Any]) -> dict | None:
         raw = self._read(lambda: self._impl.get(_key_of(pk_values)))
-        return json.loads(raw) if raw is not None else None
+        return _decode_row(raw) if raw is not None else None
 
     def get_many(self, pk_values_list: list[list[Any]]) -> list[dict | None]:
         """Batched point lookup, results in input order (the serving
@@ -135,7 +174,7 @@ class OnlineStore:
         # must not hold the writer lock across the caller's loop body —
         # and on the locked path the underlying cursor would otherwise
         # run outside the lock entirely.
-        rows = self._read(lambda: [json.loads(v) for v in self._impl.scan()])
+        rows = self._read(lambda: [_decode_row(v) for v in self._impl.scan()])
         yield from rows
 
     def count(self) -> int:

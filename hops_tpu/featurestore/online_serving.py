@@ -76,7 +76,7 @@ import pandas as pd
 from hops_tpu.featurestore import storage
 from hops_tpu.featurestore.online import OnlineStore, _key_of
 from hops_tpu.messaging import pubsub
-from hops_tpu.runtime import faultinject, qos
+from hops_tpu.runtime import faultinject, qos, wirecodec
 from hops_tpu.runtime.checkpoint import CheckpointCorruptError, _file_sha256
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.runtime.resilience import CircuitBreaker, with_deadline
@@ -165,39 +165,89 @@ class _RemoteShard:
         self.endpoint = endpoint.rstrip("/")
         self.timeout_s = float(timeout_s)
         self._pool = HTTPPool(max_idle_per_host=4)
+        #: Codecs the shard server advertised at handshake; ``None``
+        #: until the first ``get_many`` probes ``/healthz``. A server
+        #: that predates the handshake field is pinned JSON-only.
+        self._codecs: frozenset[str] | None = None
 
     def _exchange(self, method: str, path: str,
-                  payload: dict | None = None) -> dict:
-        body = (json.dumps(payload, default=str).encode()
+                  payload: dict | None = None,
+                  headers: dict[str, str] | None = None,
+                  ) -> tuple[bytes, dict]:
+        # Shard RPC *requests* (key lists, row batches to put) stay
+        # JSON: they are small and schema-free; only the get_many
+        # response rides the packed codec.
+        body = (json.dumps(payload, default=str).encode()  # graftlint: disable=json-on-hot-wire
                 if payload is not None else None)
-        code, data, _ = self._pool.request(
-            method, f"{self.endpoint}{path}", body,
-            {"Content-Type": "application/json"} if body else None,
+        hdrs = dict(headers or {})
+        if body:
+            hdrs.setdefault("Content-Type", "application/json")
+        code, data, resp_hdrs = self._pool.request(
+            method, f"{self.endpoint}{path}", body, hdrs or None,
             timeout_s=self.timeout_s,
         )
         if code != 200:
             raise ConnectionError(
                 f"shard server {self.endpoint}{path} answered {code}")
-        return json.loads(data) if data else {}
+        return data, resp_hdrs
+
+    def _json_exchange(self, method: str, path: str,
+                       payload: dict | None = None) -> dict:
+        # Control-plane verbs (healthz/stats/put/delete/scan) are
+        # JSON-only by contract; get_many negotiates separately.
+        data, _ = self._exchange(method, path, payload)
+        return json.loads(data) if data else {}  # graftlint: disable=json-on-hot-wire
+
+    def _handshake(self) -> frozenset[str]:
+        """Learn the server's codecs from ``/healthz`` (cached).
+
+        A non-200 answer pins the shard JSON-only (the request path will
+        surface the shard's real health); transport errors propagate so
+        the caller's breaker/hedge machinery sees them.
+        """
+        if self._codecs is None:
+            try:
+                health = self._json_exchange("GET", "/healthz")
+            except ConnectionError:
+                return frozenset({"json"})  # unhealthy answer — don't cache
+            self._codecs = frozenset(health.get("codecs") or ("json",))
+        return self._codecs
 
     def get_many(self, pk_values_list: list[list[Any]]) -> list[dict | None]:
-        return self._exchange("POST", "/get_many",
-                              {"pks": pk_values_list})["rows"]
+        accept = None
+        if "packed" in self._handshake():
+            accept = {"Accept": wirecodec.MEDIA_TYPE}
+        data, hdrs = self._exchange("POST", "/get_many",
+                                    {"pks": pk_values_list}, accept)
+        ctype = next((v for k, v in hdrs.items()
+                      if k.lower() == "content-type"), "")
+        if wirecodec.MEDIA_TYPE in ctype:
+            try:
+                return wirecodec.decode_rows(data)
+            except wirecodec.WireCodecError as e:
+                # Fail closed: a malformed frame is breaker food, never
+                # silently-wrong rows.
+                raise ConnectionError(
+                    f"shard server {self.endpoint}/get_many sent a bad "
+                    f"packed frame: {e}") from None
+        # Negotiated JSON fallback: the shard either answered a JSON
+        # Content-Type or predates the packed codec entirely.
+        return json.loads(data)["rows"] if data else []  # graftlint: disable=json-on-hot-wire
 
     def put_dataframe(self, df: pd.DataFrame, primary_key: list[str]) -> int:
         recs = df.to_dict(orient="records")
-        return int(self._exchange("POST", "/put",
+        return int(self._json_exchange("POST", "/put",
                                   {"records": recs}).get("applied", 0))
 
     def delete_keys(self, df: pd.DataFrame, primary_key: list[str]) -> None:
-        self._exchange("POST", "/delete",
-                       {"records": df.to_dict(orient="records")})
+        self._json_exchange("POST", "/delete",
+                            {"records": df.to_dict(orient="records")})
 
     def scan(self) -> Iterator[dict]:
-        yield from self._exchange("GET", "/scan")["rows"]
+        yield from self._json_exchange("GET", "/scan")["rows"]
 
     def count(self) -> int:
-        return int(self._exchange("GET", "/stats")["rows"])
+        return int(self._json_exchange("GET", "/stats")["rows"])
 
     def close(self) -> None:
         self._pool.close()

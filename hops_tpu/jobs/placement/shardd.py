@@ -13,9 +13,17 @@ a multi-second jax initialization per shard would dominate every
 placement and chaos-heal latency. That is also why this is its own
 process model rather than a ``serving_host`` mode.
 
-Verbs (JSON in, JSON out, HTTP/1.1 keep-alive for the pool)::
+Verbs (JSON in, JSON out by default, HTTP/1.1 keep-alive for the
+pool). ``/healthz`` doubles as the codec handshake: it advertises
+``"codecs"`` and a client that sees ``"packed"`` there may send
+``Accept: application/x-hops-packed`` on ``/get_many`` to receive the
+row batch as a packed columnar frame (``runtime/wirecodec.py``) instead
+of JSON — per shard, falling back to JSON whenever the batch cannot be
+packed. A ``"codecs": ["json"]`` config entry pins a shard JSON-only
+(mixed fleets are a supported state, e.g. mid-rollout)::
 
-    GET  /healthz            {"status": "ok", "store", "shard", "rows"}
+    GET  /healthz            {"status": "ok", "store", "shard", "rows",
+                              "codecs"}
     GET  /stats              {"rows": N}
     POST /get_many {"pks": [[...], ...]}        -> {"rows": [row|null, ...]}
     POST /put      {"records": [...]}           -> {"applied": N}
@@ -48,6 +56,7 @@ from typing import Any
 import pandas as pd
 
 from hops_tpu.featurestore.online import OnlineStore
+from hops_tpu.runtime import wirecodec
 from hops_tpu.runtime.httpserver import HTTPServer
 from hops_tpu.runtime.logging import get_logger
 
@@ -79,6 +88,11 @@ class ShardServer:
         self.shard_index = int(cfg["shard_index"])
         self.n_shards = int(cfg.get("shards", 1))
         self.primary_key = [k.lower() for k in cfg["primary_key"]]
+        self.codecs = tuple(cfg.get("codecs", ("json", "packed")))
+        if "json" not in self.codecs:
+            raise ValueError(
+                "shardd codecs must include 'json' (the negotiation "
+                f"fallback): {self.codecs!r}")
         self.label = f"{self.store_name}_{self.version}"
         root = Path(cfg["root"])
         root.mkdir(parents=True, exist_ok=True)
@@ -146,7 +160,8 @@ class ShardServer:
         if method == "GET" and path == "/healthz":
             return 200, {"status": "ok", "store": self.label,
                          "shard": self.shard_index,
-                         "rows": self._store.count()}
+                         "rows": self._store.count(),
+                         "codecs": list(self.codecs)}
         if method == "GET" and path == "/stats":
             return 200, {"rows": self._store.count()}
         if method == "GET" and path == "/scan":
@@ -179,6 +194,20 @@ def _make_server(shard: ShardServer, port: int,
                         shard.label, shard.shard_index, method, path,
                         type(e).__name__, e)
             status, out = 500, {"error": f"{type(e).__name__}: {e}"}
+        if (status == 200 and method == "POST" and path == "/get_many"
+                and "packed" in shard.codecs
+                and wirecodec.MEDIA_TYPE in headers.get("accept", "")):
+            try:
+                frame = wirecodec.encode_rows(out["rows"])
+            except wirecodec.WireCodecError:
+                # Un-packable batch (shouldn't happen for stored rows)
+                # — negotiation falls back to JSON, client sniffs the
+                # Content-Type.
+                log.warning("shardd %s shard %d: get_many batch not "
+                            "packable; answering JSON", shard.label,
+                            shard.shard_index, exc_info=True)
+            else:
+                return status, {"Content-Type": wirecodec.MEDIA_TYPE}, frame
         data = json.dumps(out, default=str).encode()
         return status, {"Content-Type": "application/json"}, data
 

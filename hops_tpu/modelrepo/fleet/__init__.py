@@ -66,8 +66,23 @@ __all__ = [
 ]
 
 
+#: Soak-proven default SLO the default brownout controller defends —
+#: deliberately loose (an interactive p99 of 1s) so it only fires on a
+#: genuinely burning fleet, never on CI jitter. Pass an explicit
+#: ``brownout=qos.BrownoutPolicy(...)`` to tighten it.
+DEFAULT_BROWNOUT_SLO_P99_MS = 1000.0
+
+
 class ServingFleet:
-    """Manager + router + (optional) autoscaler as one handle."""
+    """Manager + router + (optional) autoscaler as one handle.
+
+    Gray-failure tolerance is ON by default: unless the caller says
+    otherwise, the router runs adaptive hedging (:class:`HedgePolicy`),
+    outlier ejection (:class:`EjectionPolicy`), and brownout degradation
+    (``qos.BrownoutPolicy`` at :data:`DEFAULT_BROWNOUT_SLO_P99_MS`) —
+    the PR 14 soak configuration. An explicit ``hedge=None`` /
+    ``ejection=None`` / ``brownout=None`` opts that mechanism out (the
+    router maps ``None`` to its disabled policy)."""
 
     def __init__(
         self,
@@ -82,6 +97,16 @@ class ServingFleet:
         placement: Any = None,
         **router_kwargs: Any,
     ):
+        from hops_tpu.runtime import qos
+
+        # setdefault, not a default argument: an EXPLICIT None must
+        # survive to the Router (which maps it to the disabled policy)
+        # while an omitted kwarg gets the soak default.
+        router_kwargs.setdefault("hedge", HedgePolicy())
+        router_kwargs.setdefault("ejection", EjectionPolicy())
+        router_kwargs.setdefault(
+            "brownout",
+            qos.BrownoutPolicy(slo_p99_ms=DEFAULT_BROWNOUT_SLO_P99_MS))
         self.manager = ReplicaManager(
             name, inprocess=inprocess, spawn_timeout_s=spawn_timeout_s,
             placement=placement)

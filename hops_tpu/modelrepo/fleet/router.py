@@ -90,7 +90,7 @@ import time
 import urllib.error
 from typing import Any
 
-from hops_tpu.runtime import faultinject, flight, qos
+from hops_tpu.runtime import faultinject, flight, qos, wirecodec
 from hops_tpu.runtime.httpclient import HTTPPool
 from hops_tpu.runtime.httpserver import HTTPServer
 from hops_tpu.runtime.logging import get_logger
@@ -652,7 +652,10 @@ class Router:
             # replica's HTTP stack would lie to the client; only
             # Content-Length is always recomputed (by the transport
             # core's assemble()).
-            data = body if isinstance(body, bytes) else json.dumps(body).encode()
+            # bytes bodies (incl. packed frames) relay untouched; only
+            # the router's OWN dict responses serialize as JSON here.
+            data = body if isinstance(body, bytes) \
+                else json.dumps(body).encode()  # graftlint: disable=json-on-hot-wire
             hdrs = dict(headers or {})
             ctype = hdrs.pop("Content-Type", "application/json")
             out = {"Content-Type": ctype}
@@ -698,10 +701,37 @@ class Router:
             def capture(status: int, tspan: Any = None) -> None:
                 if not (state["is_predict"] and workload.capturing()):
                     return
-                try:
-                    payload_obj = json.loads(body)
-                except ValueError:
-                    payload_obj = None
+                # Format-aware lazy parse: the relay never decoded the
+                # body, so the summarizer must sniff the framing. A
+                # packed body gets a header-only shape summary — armed
+                # capture on a packed-body fleet records shapes, it
+                # does not log a JSON decode warning per request.
+                payload_obj, wire_format, summary = None, "json", None
+                if wirecodec.is_packed(body):
+                    wire_format = "packed"
+                    try:
+                        fs = wirecodec.frame_summary(body)
+                    except wirecodec.WireCodecError:
+                        fs = {"bytes": len(body), "format": "packed"}
+                    summary = {"bytes": fs["bytes"]}
+                    tensor = next(
+                        (c for c in fs.get("columns", ())
+                         if c.get("name") == "instances" and "shape" in c),
+                        None)
+                    if tensor is not None:
+                        shape = tensor["shape"]
+                        summary["instances"] = shape[0] if shape else 1
+                        summary["instance"] = {"kind": "list",
+                                               "shape": shape[1:]}
+                        summary["dtype"] = tensor["dtype"]
+                else:
+                    try:
+                        # Capture is the relay's one lazy-parse
+                        # consumer: runs post-reply, only while armed,
+                        # and only on non-packed bodies.
+                        payload_obj = json.loads(body)  # graftlint: disable=json-on-hot-wire
+                    except ValueError:
+                        payload_obj = None
                 workload.record_request(
                     surface="router",
                     endpoint=name,
@@ -720,6 +750,8 @@ class Router:
                     ),
                     t_mono=t_arr_mono,
                     t_wall=t_arr_wall,
+                    wire_format=wire_format,
+                    payload_summary=summary,
                 )
 
             def done(resp, tspan: Any = None,
@@ -742,7 +774,7 @@ class Router:
                     # Workload-capture control plane on the fleet's
                     # front door (status: GET /debug/workload).
                     try:
-                        admin_payload = json.loads(body)
+                        admin_payload = json.loads(body)  # graftlint: disable=json-on-hot-wire
                     except ValueError:
                         admin_payload = {}
                     return _reply(*workload.admin_action(path, admin_payload))
@@ -804,6 +836,16 @@ class Router:
                 # header); a brownout level rides too so
                 # subprocess replicas degrade with the fleet.
                 relay_headers = {qos.PRIORITY_HEADER: priority}
+                # Wire-format negotiation is end-to-end: the client's
+                # Content-Type/Accept ride the relay verbatim so the
+                # replica decides the framing (the router never decodes
+                # the body either way).
+                ctype = headers.get("Content-Type")
+                if ctype:
+                    relay_headers["Content-Type"] = ctype
+                accept = headers.get("Accept")
+                if accept:
+                    relay_headers["Accept"] = accept
                 if debug:
                     relay_headers[tracing.DEBUG_HEADER] = debug
                 lvl = router.brownout_level
@@ -986,7 +1028,9 @@ class Router:
             code, raw, _ = with_deadline(fetch, timeout, op="router.scrape")
             if code != 200:
                 return None
-            families = json.loads(raw).get("metrics", {})
+            # Metrics scrape of a replica's /metrics — telemetry
+            # control plane, not the request/response data wire.
+            families = json.loads(raw).get("metrics", {})  # graftlint: disable=json-on-hot-wire
         except (OSError, ValueError, RuntimeError):
             return None
 
@@ -1323,7 +1367,9 @@ class Router:
         if code >= 400 and not data:
             return (
                 code,
-                json.dumps({"error": f"replica answered {code}"}).encode(),
+                # Synthesized error body for an empty upstream error —
+                # errors are spec'd JSON regardless of negotiation.
+                json.dumps({"error": f"replica answered {code}"}).encode(),  # graftlint: disable=json-on-hot-wire
                 _relay_headers(resp_headers),
             )
         return code, data, _relayed_with_ctype(resp_headers)
@@ -1339,7 +1385,15 @@ class Router:
         object. A non-JSON body relays untouched."""
         if isinstance(payload, bytes):
             raw = payload
+            if wirecodec.is_packed(raw):
+                # A packed frame carries no debug dict by design
+                # (replicas answer timeline asks in JSON); relay the
+                # frame untouched rather than mis-parse it.
+                return raw
             try:
+                # graftlint: disable=json-on-hot-wire — the one relay
+                # path spec'd to parse: the operator asked for the
+                # merged timeline object.
                 parsed = json.loads(payload)
             except ValueError:
                 return raw
@@ -1484,7 +1538,9 @@ class Router:
 
                     art = load_artifact(self.probe_workload)
                     for rec in art["records"][:32]:
-                        bodies.append(json.dumps(
+                        # Shadow probes are spec'd JSON: they exercise
+                        # the replica's default (negotiation-free) path.
+                        bodies.append(json.dumps(  # graftlint: disable=json-on-hot-wire
                             materialize_payload(rec, seed=0)
                         ).encode())
                 except Exception:  # noqa: BLE001 — probes are optional

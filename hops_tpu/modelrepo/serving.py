@@ -34,7 +34,7 @@ import numpy as np
 
 from hops_tpu.messaging import pubsub
 from hops_tpu.modelrepo import registry
-from hops_tpu.runtime import faultinject, flight, fs, qos
+from hops_tpu.runtime import faultinject, flight, fs, qos, wirecodec
 from hops_tpu.runtime.httpserver import HTTPServer
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.runtime.resilience import (
@@ -756,12 +756,26 @@ class _RunningServing:
         running = self
         breaker = self.breaker
 
+        def _np_native(obj: Any):
+            # A packed request hands the predictor an ndarray; a user
+            # predictor may echo numpy scalars/arrays back into a JSON
+            # (non-negotiated) response. Only invoked on non-native
+            # objects, so the plain-JSON path pays nothing.
+            if hasattr(obj, "tolist"):
+                return obj.tolist()
+            if hasattr(obj, "item"):
+                return obj.item()
+            raise TypeError(
+                f"not JSON serializable: {type(obj).__name__}")
+
         def _json(code: int, body: dict[str, Any],
                   extra: dict[str, str] | None = None):
             h = {"Content-Type": "application/json"}
             if extra:
                 h.update(extra)
-            return code, h, json.dumps(body).encode()
+            # JSON is the default wire format; errors, debug timelines,
+            # and non-negotiated responses are spec'd to serialize here.
+            return code, h, json.dumps(body, default=_np_native).encode()  # graftlint: disable=json-on-hot-wire
 
         def _maybe_debug(headers: Any, body: dict[str, Any],
                          tspan: Any) -> dict[str, Any]:
@@ -921,7 +935,17 @@ class _RunningServing:
                 {"request": payload, "response": response}, key=name
             )
             m_logged.inc()
-            return _json(200, _maybe_debug(headers, response, tspan))
+            body = _maybe_debug(headers, response, tspan)
+            if ("debug" not in body
+                    and wirecodec.MEDIA_TYPE in (headers.get("Accept") or "")):
+                # Accept-negotiated packed response. Debug timelines
+                # always ride JSON (the router merges its hops into the
+                # body); ragged/object predictions fall back to JSON
+                # too — exactness over format.
+                frame = wirecodec.try_encode_predictions(preds)
+                if frame is not None:
+                    return 200, {"Content-Type": wirecodec.MEDIA_TYPE}, frame
+            return _json(200, body)
 
         def _do_post_inner(path: str, headers: Any, raw_body: bytes,
                            cap: dict[str, Any]):
@@ -934,15 +958,17 @@ class _RunningServing:
             if path.split("?", 1)[0].rstrip("/").startswith(
                     "/admin/capture/"):
                 try:
-                    admin_payload = json.loads(raw_body)
+                    # Capture control plane, tolerant parse; not the
+                    # data wire.
+                    admin_payload = json.loads(raw_body)  # graftlint: disable=json-on-hot-wire
                 except ValueError:
                     admin_payload = {}
                 return _json(*workload.admin_action(path, admin_payload))
-            payload = json.loads(raw_body)
             # Fleet control plane: flip this endpoint into the
             # draining state (rollouts, scale-downs). Replies with
             # the in-flight count the caller will poll to zero on
-            # /healthz before reaping.
+            # /healthz before reaping. Checked before the body parse —
+            # a drain must succeed whatever the body carries.
             if path.rstrip("/") == "/admin/drain":
                 inflight = running.drain()
                 return _json(200, {"status": "draining",
@@ -951,16 +977,55 @@ class _RunningServing:
             # /junk/v1/models/<name>:predict.
             if path.rstrip("/") != f"/v1/models/{name}:predict":
                 return _json(404, {"error": f"unknown path {path}"})
-            instances = payload.get("instances")
-            if instances is None:
-                return _json(400, {"error": "payload must carry 'instances'"})
+            # Content-Type negotiation: the packed columnar frame
+            # decodes zero-copy into the instance tensor; JSON stays
+            # the default. A malformed frame fails closed with a 400
+            # naming the offset — never a half-decoded batch.
+            ctype = (headers.get("Content-Type") or "") \
+                .split(";", 1)[0].strip().lower()
+            if ctype == wirecodec.MEDIA_TYPE:
+                wire_format = "packed"
+                try:
+                    instances = wirecodec.decode_instances(raw_body)
+                except wirecodec.WireCodecError as e:
+                    return _json(400, {"error": f"bad packed frame: {e}"})
+                # The inference-log tee and capture tap need a
+                # JSON-serializable request: a header-only shape
+                # summary stands in for the tensor body.
+                payload = {"format": "packed",
+                           "summary": wirecodec.frame_summary(raw_body)}
+            else:
+                wire_format = "json"
+                # The negotiated default path; packed bodies take the
+                # branch above.
+                payload = json.loads(raw_body)  # graftlint: disable=json-on-hot-wire
+                instances = payload.get("instances")
+                if instances is None:
+                    return _json(400,
+                                 {"error": "payload must carry 'instances'"})
             m_requests.inc()
+            wirecodec.count_request(wire_format)
             if workload.capturing():
                 # Arm the per-request capture tap: the route's single
                 # exit records the request WITH its final status —
                 # sheds, deadline 504s, and 500s included.
-                cap["payload"] = payload
-                cap["instances"] = instances
+                cap["wire_format"] = wire_format
+                if wire_format == "packed":
+                    # Tensor bodies don't JSON-serialize; record the
+                    # shape summary the replayer rebuilds from.
+                    arr = instances
+                    cap["payload"] = None
+                    cap["instances"] = None
+                    cap["summary"] = {
+                        "bytes": len(raw_body),
+                        "instances": int(arr.shape[0]) if arr.ndim else 1,
+                        "instance": {"kind": "list",
+                                     "shape": list(arr.shape[1:])},
+                        "dtype": arr.dtype.str,
+                    }
+                else:
+                    cap["payload"] = payload
+                    cap["instances"] = instances
             # The trace enters (or starts) here: an incoming
             # `traceparent` — the fleet router injects one per
             # forward hop — makes this request span a child of
@@ -1053,6 +1118,8 @@ class _RunningServing:
                     ),
                     t_mono=t_arr_mono,
                     t_wall=t_arr_wall,
+                    wire_format=cap.get("wire_format", "json"),
+                    payload_summary=cap.get("summary"),
                 )
 
             return resp[0], resp[1], resp[2], after
@@ -1571,7 +1638,9 @@ def make_inference_request(
     "instances": [...]})``)."""
     req = urllib.request.Request(
         f"{_endpoint(name)}/v1/models/{name}{verb}",
-        data=json.dumps(data).encode(),
+        # Convenience client for the TF-Serving-shaped verbs; JSON is
+        # that surface's contract.
+        data=json.dumps(data).encode(),  # graftlint: disable=json-on-hot-wire
         headers={"Content-Type": "application/json"},
     )
     with urllib.request.urlopen(req, timeout=30) as resp:

@@ -50,6 +50,7 @@ from hops_tpu.telemetry.workload.replay import (  # noqa: F401
     WorkloadCorruptError,
     issued_stream,
     load_artifact,
+    materialize_body,
     materialize_payload,
     replay,
 )

@@ -249,12 +249,24 @@ class WorkloadRecorder:
         trace_id: str | None = None,
         t_mono: float | None = None,
         t_wall: float | None = None,
+        wire_format: str | None = None,
+        payload_summary: Any = None,
     ) -> dict[str, Any] | None:
         """Append one request record; returns it, or None on a drop
         (counted on ``hops_tpu_workload_capture_dropped_total`` — by
-        contract a capture failure must never fail the request)."""
+        contract a capture failure must never fail the request).
+
+        ``payload_summary`` short-circuits :func:`summarize_payload`:
+        packed-wire call sites already hold a header-only shape summary
+        (the tensor body itself never JSON-serializes), so they pass it
+        explicitly along with ``wire_format="packed"`` — the replayer
+        re-materializes a same-shape packed frame from it."""
         try:
-            body, summary = summarize_payload(payload, self.payload_cap_bytes)
+            if payload_summary is not None:
+                body, summary = None, payload_summary
+            else:
+                body, summary = summarize_payload(
+                    payload, self.payload_cap_bytes)
             rec: dict[str, Any] = {
                 "v": RECORD_VERSION,
                 "t_mono": time.monotonic() if t_mono is None else t_mono,
@@ -266,6 +278,8 @@ class WorkloadRecorder:
                 rec["path"] = path
             if tenant is not None:
                 rec["tenant"] = tenant
+            if wire_format and wire_format != "json":
+                rec["wire_format"] = wire_format
             if body is not None:
                 rec["payload"] = body
             if summary is not None:

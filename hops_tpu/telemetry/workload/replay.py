@@ -194,6 +194,43 @@ def materialize_payload(rec: dict[str, Any], seed: int) -> dict[str, Any]:
                           for _ in range(n)]}
 
 
+def materialize_body(rec: dict[str, Any],
+                     seed: int) -> tuple[bytes, dict[str, str]]:
+    """``(body_bytes, headers)`` for one record — the wire-level twin of
+    :func:`materialize_payload`.
+
+    Records captured off the packed wire (``wire_format: "packed"``)
+    re-encode as a packed columnar frame in the summary's recorded
+    dtype, with the matching ``Content-Type`` — a packed-body capture
+    replays as packed traffic, not as a JSON approximation of it.
+    Everything else serializes to canonical JSON. Deterministic: same
+    record + same seed ⇒ identical bytes (the determinism test pins
+    this)."""
+    payload = materialize_payload(rec, seed)
+    if rec.get("wire_format") == "packed":
+        # Lazy import: wirecodec pulls numpy, which replay's jax-free
+        # consumers only need when a packed record is actually present.
+        import numpy as np
+
+        from hops_tpu.runtime import wirecodec
+
+        summary = rec.get("payload_summary") or {}
+        try:
+            arr = np.asarray(payload.get("instances"),
+                             dtype=np.dtype(summary.get("dtype", "<f4")))
+            frame = wirecodec.encode_frame([("instances", arr)])
+        except (wirecodec.WireCodecError, TypeError, ValueError) as e:
+            log.warning("workload replay: packed record seq=%s did not "
+                        "re-encode (%s); issuing JSON instead",
+                        rec.get("seq"), e)
+        else:
+            return frame, {"Content-Type": wirecodec.MEDIA_TYPE,
+                           "Accept": wirecodec.MEDIA_TYPE}
+    body = json.dumps(payload, separators=(",", ":"),
+                      sort_keys=True).encode()
+    return body, {"Content-Type": "application/json"}
+
+
 def issued_stream(
     records: list[dict[str, Any]], *, seed: int = 0, speed: float = 1.0,
 ) -> list[dict[str, Any]]:
@@ -208,7 +245,7 @@ def issued_stream(
     t0 = records[0].get("t_mono", 0.0)
     plan = []
     for rec in records:
-        headers = {"Content-Type": "application/json"}
+        body, headers = materialize_body(rec, seed)
         if rec.get("tenant"):
             headers["X-Tenant"] = str(rec["tenant"])
         plan.append({
@@ -216,10 +253,7 @@ def issued_stream(
             "offset_s": max(0.0, (rec.get("t_mono", t0) - t0)) / speed,
             "endpoint": rec.get("endpoint"),
             "tenant": rec.get("tenant"),
-            "body": json.dumps(
-                materialize_payload(rec, seed), separators=(",", ":"),
-                sort_keys=True,
-            ).encode(),
+            "body": body,
             "headers": headers,
         })
     return plan

@@ -900,6 +900,96 @@ def test_relay_roundtrip_tree_is_clean():
     assert findings == []
 
 
+# -- json-on-hot-wire ---------------------------------------------------------
+
+
+ROUTER_FILE = "hops_tpu/modelrepo/fleet/router.py"
+
+
+def test_json_on_hot_wire_flags_body_codec_calls(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        import json
+
+        def handle(body):
+            payload = json.loads(body)
+            return json.dumps(payload).encode()
+
+        def handle_default(raw_body):
+            return json.loads(raw_body or b"{}")
+        """,
+        rule="json-on-hot-wire",
+        filename=ROUTER_FILE,
+    )
+    assert len(findings) == 3
+    assert all(f.rule == "json-on-hot-wire" for f in findings)
+    assert any("json.loads" in f.message for f in findings)
+    assert any("json.dumps" in f.message for f in findings)
+
+
+def test_json_on_hot_wire_must_not_flag(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    findings = lint_code(
+        tmp_path,
+        """
+        import json
+
+        def config(path):
+            spec = json.loads(path.read_text())   # not a body variable
+            return spec
+
+        def dumps_no_encode(body):
+            return json.dumps({"n": 1})           # str, never hits wire
+
+        def other_codec(body):
+            import pickle
+            return pickle.loads(body)             # not json
+
+        def loads_of_literal():
+            return json.loads('{"a": 1}')         # constant, not a body
+        """,
+        rule="json-on-hot-wire",
+        filename=ROUTER_FILE,
+    )
+    assert findings == []
+
+
+def test_json_on_hot_wire_scoped_to_wire_tier(tmp_path):
+    (tmp_path / "hops_tpu" / "modelrepo" / "fleet").mkdir(parents=True)
+    (tmp_path / "hops_tpu" / "featurestore").mkdir(parents=True)
+    code = """
+    import json
+
+    def handle(body):
+        return json.loads(body)
+    """
+    outside = lint_code(tmp_path, code, rule="json-on-hot-wire",
+                        filename="hops_tpu/featurestore/offline.py")
+    assert outside == []
+    for scoped in (ROUTER_FILE, "hops_tpu/modelrepo/serving.py",
+                   "hops_tpu/featurestore/online_serving.py"):
+        inside = lint_code(tmp_path, code, rule="json-on-hot-wire",
+                           filename=scoped)
+        assert len(inside) == 1, scoped
+
+
+def test_json_on_hot_wire_tree_is_clean():
+    """Every JSON codec call left on the wire tier is a *negotiated*
+    fallback or control-plane site carrying a justified disable pragma
+    — zero un-annotated findings, no baseline entries."""
+    import hops_tpu
+
+    pkg = Path(hops_tpu.__file__).parent
+    rules = [r for r in engine.all_rules() if r.name == "json-on-hot-wire"]
+    findings = engine.run(
+        [pkg / "modelrepo", pkg / "featurestore"],
+        root=pkg.parent, rules=rules,
+    )
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
 # -- suppression --------------------------------------------------------------
 
 

@@ -216,3 +216,15 @@ def test_placement_registered_in_drift_guard():
     assert "hops_tpu.jobs.placement.registry" in names
     assert "hops_tpu.jobs.placement.shardd" in names
     assert "hops_tpu.analysis.rules.hardcoded_loopback" in names
+
+
+def test_wirecodec_registered_in_drift_guard():
+    """The packed columnar codec is the negotiated wire format on every
+    serving and feature data-plane hop (predict bodies, shard get_many,
+    kvstore rows, capture/replay); if it stops importing, every one of
+    those paths silently falls back to JSON and the --hot-path codec
+    bound goes unmeasured. Pin it and the lint rule that keeps JSON off
+    the hot wire."""
+    names = _module_names()
+    assert "hops_tpu.runtime.wirecodec" in names
+    assert "hops_tpu.analysis.rules.json_on_hot_wire" in names

@@ -939,3 +939,135 @@ class TestLMPriorityAdmission:
         inter_tickets = [r.ticket for r in admitted
                          if r.priority == "interactive"]
         assert inter_tickets == sorted(inter_tickets)
+
+
+class TestPackedWire:
+    """Content-Type/Accept negotiation for the packed columnar codec
+    (runtime/wirecodec.py) on a single serving endpoint: JSON stays the
+    default, both formats answer bit-identically, malformed frames are
+    a clean 400 naming the offset, and a debug ask always rides JSON."""
+
+    def _serve(self, tmp_path, name):
+        script = tmp_path / "p.py"
+        script.write_text(
+            "class Predict:\n"
+            "    def predict(self, instances):\n"
+            "        return [[float(v[0]) * 2.0] for v in instances]\n"
+        )
+        serving.create_or_update(name, model_path=str(tmp_path),
+                                 model_server="PYTHON")
+        serving.start(name)
+
+    def _post(self, name, body, headers):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            serving._endpoint(name) + f"/v1/models/{name}:predict",
+            data=body, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return r.status, dict(r.headers.items()), r.read()
+        except urllib.error.HTTPError as e:
+            return e.code, dict(e.headers.items()), e.read()
+
+    def test_packed_and_json_paths_bit_identical(self, tmp_path):
+        from hops_tpu.runtime import wirecodec
+        from hops_tpu.telemetry.metrics import REGISTRY
+
+        self._serve(tmp_path, "pk-par")
+        try:
+            arr = (np.arange(32 * 8, dtype=np.float32)
+                   .reshape(32, 8) / 7.0)
+            # The JSON twin: tolist() round-trips every f32 exactly
+            # through decimal repr, and the predictor computes in f64
+            # on both paths (float(v[0])) — so the comparison below is
+            # exact, not approximate.
+            code_j, hdrs_j, raw_j = self._post(
+                "pk-par", json.dumps({"instances": arr.tolist()}).encode(),
+                {"Content-Type": "application/json"})
+            assert code_j == 200
+            assert "json" in hdrs_j.get("Content-Type", "")
+            preds_json = json.loads(raw_j)["predictions"]
+
+            before = REGISTRY.counter(
+                "hops_tpu_wire_requests_total", labels=("format",)
+            ).value(format="packed")
+            code_p, hdrs_p, raw_p = self._post(
+                "pk-par", wirecodec.encode_instances(arr),
+                {"Content-Type": wirecodec.MEDIA_TYPE,
+                 "Accept": wirecodec.MEDIA_TYPE})
+            assert code_p == 200
+            assert hdrs_p.get("Content-Type") == wirecodec.MEDIA_TYPE
+            preds_packed = wirecodec.decode_predictions(raw_p)
+            assert preds_packed.tolist() == preds_json  # bit-identical
+            after = REGISTRY.counter(
+                "hops_tpu_wire_requests_total", labels=("format",)
+            ).value(format="packed")
+            assert after == before + 1
+        finally:
+            serving.stop("pk-par")
+
+    def test_packed_request_defaults_to_json_response(self, tmp_path):
+        from hops_tpu.runtime import wirecodec
+
+        self._serve(tmp_path, "pk-def")
+        try:
+            frame = wirecodec.encode_instances(
+                np.asarray([[1.5], [2.5]], dtype=np.float32))
+            # No Accept header: the response stays on the JSON default
+            # even though the request body was packed.
+            code, hdrs, raw = self._post(
+                "pk-def", frame, {"Content-Type": wirecodec.MEDIA_TYPE})
+            assert code == 200
+            assert "json" in hdrs.get("Content-Type", "")
+            assert json.loads(raw)["predictions"] == [[3.0], [5.0]]
+        finally:
+            serving.stop("pk-def")
+
+    def test_truncated_frame_is_400_and_server_survives(self, tmp_path):
+        from hops_tpu.runtime import wirecodec
+
+        self._serve(tmp_path, "pk-bad")
+        try:
+            frame = wirecodec.encode_instances(
+                np.ones((4, 2), dtype=np.float32))
+            code, _, raw = self._post(
+                "pk-bad", frame[:-5],
+                {"Content-Type": wirecodec.MEDIA_TYPE})
+            assert code == 400
+            err = json.loads(raw)["error"]
+            assert "offset" in err and "bad packed frame" in err
+            # Fail-closed, not fail-broken: the next request serves.
+            code2, _, raw2 = self._post(
+                "pk-bad", json.dumps({"instances": [[2.0]]}).encode(),
+                {"Content-Type": "application/json"})
+            assert code2 == 200
+            assert json.loads(raw2)["predictions"] == [[4.0]]
+        finally:
+            serving.stop("pk-bad")
+
+    def test_debug_ask_always_rides_json(self, tmp_path):
+        from hops_tpu.runtime import wirecodec
+        from hops_tpu.telemetry import tracing
+
+        self._serve(tmp_path, "pk-dbg")
+        try:
+            frame = wirecodec.encode_instances(
+                np.asarray([[4.0]], dtype=np.float32))
+            code, hdrs, raw = self._post(
+                "pk-dbg", frame,
+                {"Content-Type": wirecodec.MEDIA_TYPE,
+                 "Accept": wirecodec.MEDIA_TYPE,
+                 tracing.DEBUG_HEADER: "timeline",
+                 tracing.TRACEPARENT_HEADER:
+                     tracing.TraceContext("ab" * 16, "cd" * 8).traceparent()})
+            assert code == 200
+            # The router merges its hops into the debug body — a packed
+            # frame would have nowhere to carry it, so debug wins.
+            assert "json" in hdrs.get("Content-Type", "")
+            payload = json.loads(raw)
+            assert payload["predictions"] == [[8.0]]
+            assert "timeline" in payload.get("debug", {})
+        finally:
+            serving.stop("pk-dbg")

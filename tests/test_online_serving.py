@@ -1026,3 +1026,38 @@ class TestFanOutHedging:
         finally:
             qos.set_brownout(0)
             s.close()
+
+
+class TestRowFormats:
+    """The kvstore row encoding behind its format byte: packed rows are
+    the default, ``HOPS_TPU_ONLINE_ROW_FORMAT=json`` writes legacy
+    JSON, and a store holding BOTH reads every row identically — old
+    ``.hkv`` files keep working next to new writes."""
+
+    def test_mixed_packed_and_legacy_rows_read_identically(
+            self, tmp_path, monkeypatch):
+        store = online.OnlineStore(tmp_path / "mix")
+        monkeypatch.setenv("HOPS_TPU_ONLINE_ROW_FORMAT", "json")
+        store.put_dataframe(users_df(8), primary_key=["user_id"])
+        monkeypatch.setenv("HOPS_TPU_ONLINE_ROW_FORMAT", "packed")
+        newer = users_df(16).iloc[8:]
+        store.put_dataframe(newer, primary_key=["user_id"])
+
+        rows = store.get_many([[k] for k in range(16)])
+        assert all(r is not None for r in rows)
+        for k, row in enumerate(rows):
+            assert row["user_id"] == k
+            assert row["score"] == k / 4.0
+            assert row["clicks"] == k * 3
+        # Same Python types out of both eras: scan sees one schema.
+        scanned = sorted(store.scan(), key=lambda r: r["user_id"])
+        assert {type(r["score"]) for r in scanned} == {float}
+        assert {type(r["clicks"]) for r in scanned} == {int}
+        store.close()
+
+    def test_unknown_row_format_env_refused(self, tmp_path, monkeypatch):
+        store = online.OnlineStore(tmp_path / "badfmt")
+        monkeypatch.setenv("HOPS_TPU_ONLINE_ROW_FORMAT", "msgpack")
+        with pytest.raises(ValueError, match="HOPS_TPU_ONLINE_ROW_FORMAT"):
+            store.put_dataframe(users_df(2), primary_key=["user_id"])
+        store.close()

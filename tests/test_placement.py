@@ -429,3 +429,61 @@ def test_bench_multi_host_smoke(workspace):
     assert result["local_rps"] > 0 and result["placed_rps"] > 0
     assert result["placement_rpcs"] >= result["replicas"]
     assert result["placed_lookups_per_sec"] > 0
+
+
+class TestPackedShardWire:
+    """Codec negotiation on the remote-shard RPC: shardd advertises its
+    codecs at the healthz handshake, a packed-capable shard answers
+    get_many as a packed columnar frame, a JSON-only shard falls back —
+    with no client-visible difference between the two."""
+
+    def test_mixed_codec_shards_answer_identically(
+            self, hostds, tmp_path, workspace):
+        from hops_tpu.runtime import wirecodec  # noqa: F401 — codec leg
+        from hops_tpu.telemetry.metrics import REGISTRY as METRICS
+
+        df = users_df(12)
+        local = ShardedOnlineStore("mx_users", primary_key=["user_id"],
+                                   shards=2)
+        local.put_dataframe(df)
+        snap = local.snapshot(tmp_path / "mx_snap")
+
+        client = _client(hostds)
+        units = [
+            client.spawn("shard", _shard_cfg("mx_users", 0, 2,
+                                             tmp_path / "mx0", snap)),
+            # Shard 1 predates the codec: JSON-only, by config.
+            client.spawn("shard", dict(
+                _shard_cfg("mx_users", 1, 2, tmp_path / "mx1", snap),
+                codecs=["json"])),
+        ]
+        remote = ShardedOnlineStore(
+            "mx_users", primary_key=["user_id"],
+            endpoints=[f"http://{u.address}:{u.port}" for u in units])
+        try:
+            keys = [{"user_id": k} for k in (3, 999, 0, 7, 11, 2)]
+            decoded_before = METRICS.get(
+                "hops_tpu_wire_decode_seconds").labels().count
+            got = remote.multi_get(keys)
+            want = local.multi_get(keys)
+            assert got == want  # misses included, order preserved
+            # The handshake split the fleet: shard 0 negotiated packed,
+            # shard 1 stayed on JSON — and the packed leg actually ran.
+            assert "packed" in remote._shards[0]._handshake()
+            assert remote._shards[1]._handshake() == frozenset({"json"})
+            assert METRICS.get(
+                "hops_tpu_wire_decode_seconds").labels().count \
+                > decoded_before
+        finally:
+            for u in units:
+                client.reap(u)
+            local.close()
+
+    def test_codecs_config_must_keep_json(self, hostds, tmp_path):
+        client = _client(hostds)
+        with pytest.raises(placement.PlacementError, match="json"):
+            client.spawn("shard", dict(
+                _shard_cfg("cx_users", 0, 1, tmp_path / "cx0"),
+                codecs=["packed"]))
+        # A config-shaped reject is the caller's bug, not host failure.
+        assert len(client.healthy_hosts()) == 2

@@ -1,0 +1,335 @@
+"""Packed columnar wire codec: framing, robustness, row formats.
+
+Covers the ISSUE 18 satellite-3 checklist — dtype round-trips (incl.
+bf16-as-u16), 0-d and empty arrays, ragged rejection, truncation at
+every byte boundary with offset-naming errors, and cross-endianness
+header rejection — plus the row-batch and single-row formats the
+feature plane rides on.
+"""
+
+import json
+import struct
+
+import numpy as np
+import pytest
+
+from hops_tpu.runtime import wirecodec
+from hops_tpu.runtime.wirecodec import (
+    MAGIC,
+    MEDIA_TYPE,
+    WireCodecError,
+    decode_frame,
+    decode_instances,
+    decode_predictions,
+    decode_rows,
+    encode_frame,
+    encode_instances,
+    encode_rows,
+    frame_summary,
+    is_packed,
+    is_packed_row,
+    pack_row,
+    try_encode_predictions,
+    unpack_row,
+)
+
+
+class TestFrameRoundTrip:
+    @pytest.mark.parametrize("dtype", [
+        np.float32, np.float16, np.uint16,  # u16 is bf16's wire carrier
+        np.int8, np.int32, np.float64, np.int64, np.bool_,
+    ])
+    def test_dtype_round_trip(self, dtype):
+        rng = np.random.default_rng(7)
+        if np.issubdtype(dtype, np.floating):
+            arr = rng.standard_normal((5, 3)).astype(dtype)
+        elif dtype is np.bool_:
+            arr = rng.integers(0, 2, (5, 3)).astype(np.bool_)
+        else:
+            arr = rng.integers(-100 if np.issubdtype(dtype, np.signedinteger)
+                               else 0, 100, (5, 3)).astype(dtype)
+        frame = encode_frame([("x", arr)])
+        out = decode_frame(frame)["x"]
+        assert out.dtype == arr.dtype
+        assert out.shape == arr.shape
+        assert out.tobytes() == arr.tobytes()
+
+    def test_bf16_as_u16_is_bit_exact(self):
+        # bf16 travels as its raw u16 carrier; reinterpreting on the far
+        # side must give back the exact bits.
+        bits = np.array([0x3F80, 0xC000, 0x7F80, 0x0001], dtype=np.uint16)
+        frame = encode_frame([("bf16", bits)])
+        out = decode_frame(frame)["bf16"]
+        assert out.tobytes() == bits.tobytes()
+
+    def test_zero_dim_and_empty_arrays(self):
+        scalar = np.float32(3.5).reshape(())
+        empty = np.zeros((0, 8), dtype=np.float32)
+        frame = encode_frame([("s", scalar), ("e", empty)])
+        out = decode_frame(frame)
+        assert out["s"].shape == () and float(out["s"]) == 3.5
+        assert out["e"].shape == (0, 8)
+
+    def test_multi_column_order_and_bytes_columns(self):
+        frame = encode_frame([
+            ("a", np.arange(4, dtype=np.int32)),
+            ("blob", b"\x00\x01\xff raw"),
+            ("b", np.ones((2, 2), dtype=np.float64)),
+        ])
+        out = decode_frame(frame)
+        assert list(out.keys()) == ["a", "blob", "b"]
+        assert out["blob"] == b"\x00\x01\xff raw"
+
+    def test_decode_is_zero_copy(self):
+        arr = np.arange(32, dtype=np.float32)
+        frame = encode_frame([("x", arr)])
+        out = decode_frame(frame)["x"]
+        assert np.shares_memory(out, np.frombuffer(frame, dtype=np.uint8))
+        assert not out.flags.writeable
+
+    def test_big_endian_input_is_swapped_on_encode(self):
+        be = np.arange(4, dtype=">f4")
+        out = decode_frame(encode_frame([("x", be)]))["x"]
+        assert out.dtype.str == "<f4"
+        np.testing.assert_array_equal(out, be.astype("<f4"))
+
+    def test_non_contiguous_input(self):
+        base = np.arange(24, dtype=np.float32).reshape(4, 6)
+        view = base[:, ::2]
+        out = decode_frame(encode_frame([("x", view)]))["x"]
+        np.testing.assert_array_equal(out, view)
+
+    def test_ragged_column_rejected(self):
+        with pytest.raises(WireCodecError, match="wire-encodable"):
+            encode_frame([("x", np.array([[1, 2], [3]], dtype=object))])
+        with pytest.raises(WireCodecError):
+            encode_instances([[1.0, 2.0], [3.0]])
+
+    def test_string_column_rejected(self):
+        with pytest.raises(WireCodecError, match="wire-encodable"):
+            encode_frame([("x", np.array(["a", "b"]))])
+
+    def test_is_packed_sniff(self):
+        assert is_packed(encode_frame([]))
+        assert not is_packed(b'{"instances": [[1.0]]}')
+        assert not is_packed(b"")
+        assert not is_packed(None)
+
+
+class TestFrameRejection:
+    def test_truncation_at_every_boundary_names_offset(self):
+        frame = encode_frame([
+            ("instances", np.arange(6, dtype=np.float32).reshape(2, 3)),
+        ])
+        for cut in range(len(frame)):
+            with pytest.raises(WireCodecError) as ei:
+                decode_frame(frame[:cut])
+            assert "offset" in str(ei.value)
+
+    def test_trailing_garbage_rejected(self):
+        frame = encode_frame([("x", np.zeros(2, dtype=np.float32))])
+        with pytest.raises(WireCodecError, match="trailing"):
+            decode_frame(frame + b"\x00")
+
+    def test_bad_magic(self):
+        frame = bytearray(encode_frame([]))
+        frame[0] = 0x88
+        with pytest.raises(WireCodecError, match="magic"):
+            decode_frame(bytes(frame))
+
+    def test_unknown_version(self):
+        frame = bytearray(encode_frame([]))
+        frame[4] = 99
+        with pytest.raises(WireCodecError, match="version 99"):
+            decode_frame(bytes(frame))
+
+    def test_cross_endianness_bom_rejected(self):
+        frame = bytearray(encode_frame([]))
+        frame[5], frame[6] = frame[6], frame[5]  # byte-swap the BOM
+        with pytest.raises(WireCodecError, match="big-endian"):
+            decode_frame(bytes(frame))
+
+    def test_corrupt_bom_rejected(self):
+        frame = bytearray(encode_frame([]))
+        frame[5] = 0xAA
+        frame[6] = 0xAA
+        with pytest.raises(WireCodecError, match="byte-order"):
+            decode_frame(bytes(frame))
+
+    def test_nbytes_shape_mismatch(self):
+        frame = bytearray(encode_frame([("x", np.zeros(4, np.float32))]))
+        # Header ends 8 bytes of u64 nbytes before the 16-byte buffer.
+        nbytes_off = len(frame) - 16 - 8
+        frame[nbytes_off:nbytes_off + 8] = struct.pack("<Q", 12)
+        with pytest.raises(WireCodecError, match="declares"):
+            decode_frame(bytes(frame))
+
+    def test_duplicate_column_rejected(self):
+        header = MAGIC + struct.pack("<BHH", 1, 0x0102, 2)
+        colhdr = (struct.pack("<H", 1) + b"x"
+                  + struct.pack("<BB", 0, 3) + b"<f4"
+                  + struct.pack("<B", 1) + struct.pack("<I", 1)
+                  + struct.pack("<Q", 4))
+        payload = header + colhdr + colhdr + b"\x00" * 8
+        with pytest.raises(WireCodecError, match="duplicate"):
+            decode_frame(payload)
+
+    def test_unknown_kind_rejected(self):
+        header = MAGIC + struct.pack("<BHH", 1, 0x0102, 1)
+        colhdr = struct.pack("<H", 1) + b"x" + struct.pack("<B", 7)
+        with pytest.raises(WireCodecError, match="unknown kind"):
+            decode_frame(header + colhdr)
+
+    def test_disallowed_wire_dtype_rejected(self):
+        header = MAGIC + struct.pack("<BHH", 1, 0x0102, 1)
+        colhdr = (struct.pack("<H", 1) + b"x"
+                  + struct.pack("<BB", 0, 3) + b">f4"
+                  + struct.pack("<B", 1) + struct.pack("<I", 1)
+                  + struct.pack("<Q", 4))
+        with pytest.raises(WireCodecError, match="wire dtype"):
+            decode_frame(header + colhdr + b"\x00" * 4)
+
+    def test_json_body_is_a_clean_rejection(self):
+        with pytest.raises(WireCodecError, match="magic"):
+            decode_frame(b'{"instances": [[1.0, 2.0]]}')
+
+
+class TestPredictBodies:
+    def test_instances_round_trip(self):
+        body = [[float(i) / 7.0] * 8 for i in range(32)]
+        arr = decode_instances(encode_instances(body))
+        assert arr.shape == (32, 8)
+        np.testing.assert_array_equal(arr, np.asarray(body))
+
+    def test_instances_missing_column(self):
+        frame = encode_frame([("other", np.zeros(2, np.float32))])
+        with pytest.raises(WireCodecError, match="instances"):
+            decode_instances(frame)
+
+    def test_predictions_round_trip_preserves_f64(self):
+        preds = np.asarray([[0.5, 0.25], [1.0, 2.0]], np.float32) \
+            .tolist()  # what the replica actually emits
+        frame = try_encode_predictions(preds)
+        assert frame is not None
+        out = decode_predictions(frame)
+        assert out.dtype == np.float64
+        assert out.tolist() == preds
+
+    def test_ragged_predictions_fall_back(self):
+        assert try_encode_predictions([[1.0, 2.0], [3.0]]) is None
+        assert try_encode_predictions([{"a": 1}]) is None
+
+    def test_frame_summary_is_header_only(self):
+        frame = encode_instances(np.zeros((4, 8), np.float32))
+        s = frame_summary(frame)
+        assert s["format"] == "packed"
+        assert s["bytes"] == len(frame)
+        assert s["columns"] == [
+            {"name": "instances", "dtype": "<f4", "shape": [4, 8]}]
+
+
+class TestRowBatches:
+    def test_numeric_rows_round_trip(self):
+        rows = [{"id": i, "v": i / 3.0, "ok": i % 2 == 0} for i in range(8)]
+        out = decode_rows(encode_rows(rows))
+        assert out == rows
+        for rec in out:
+            assert type(rec["id"]) is int
+            assert type(rec["v"]) is float
+            assert type(rec["ok"]) is bool
+
+    def test_rows_match_json_semantics(self):
+        rows = [
+            {"id": 1, "v": 0.125, "name": "row-1"},
+            None,
+            {"id": 3, "v": 2.5, "name": "row-3"},
+        ]
+        packed = decode_rows(encode_rows(rows))
+        via_json = json.loads(json.dumps(rows, default=str))
+        assert packed == via_json
+
+    def test_all_missing_and_empty(self):
+        assert decode_rows(encode_rows([None, None])) == [None, None]
+        assert decode_rows(encode_rows([])) == []
+
+    def test_mixed_type_column_falls_back_to_json_values(self):
+        rows = [{"k": 1}, {"k": "two"}]
+        assert decode_rows(encode_rows(rows)) == rows
+
+    def test_non_homogeneous_keys_fall_back(self):
+        rows = [{"a": 1}, {"b": 2.0}, None]
+        assert decode_rows(encode_rows(rows)) == rows
+
+    def test_list_valued_features(self):
+        rows = [{"emb": [0.1, 0.2], "id": 1}, {"emb": [0.3, 0.4], "id": 2}]
+        assert decode_rows(encode_rows(rows)) == rows
+
+    def test_huge_int_column_falls_back(self):
+        rows = [{"big": 1 << 70}, {"big": 2}]
+        out = decode_rows(encode_rows(rows))
+        assert out == json.loads(json.dumps(rows, default=str))
+
+    def test_missing_presence_column_rejected(self):
+        frame = encode_frame([("id", np.arange(3, dtype=np.int64))])
+        with pytest.raises(WireCodecError, match="presence"):
+            decode_rows(frame)
+
+
+class TestPackedRow:
+    def test_round_trip(self):
+        rec = {"id": 7, "v": 7 / 3.0, "name": "row-7", "ok": True,
+               "missing": None, "emb": [1.0, 2.0]}
+        raw = pack_row(rec)
+        assert is_packed_row(raw)
+        assert unpack_row(raw) == rec
+
+    def test_survives_utf8_disk_round_trip(self):
+        # Both kvstore backends store str values as utf-8 on disk.
+        rec = {"v": -1.5e300, "blob": "héllo ÿ", "n": (1 << 62)}
+        raw = pack_row(rec)
+        assert raw.encode("utf-8").decode("utf-8") == raw
+        assert unpack_row(raw) == rec
+
+    def test_numpy_scalars_normalize(self):
+        rec = {"i": np.int64(5), "f": np.float64(0.5), "b": np.bool_(True)}
+        out = unpack_row(pack_row(rec))
+        assert out == {"i": 5, "f": 0.5, "b": True}
+        assert type(out["i"]) is int and type(out["b"]) is bool
+
+    def test_big_int_and_timestamp_take_json_path(self):
+        rec = {"big": 1 << 70}
+        out = unpack_row(pack_row(rec))
+        assert out == {"big": 1 << 70}
+
+    def test_legacy_json_rows_are_not_sniffed_as_packed(self):
+        legacy = json.dumps({"id": 1, "v": 0.5})
+        assert not is_packed_row(legacy)
+        with pytest.raises(WireCodecError):
+            unpack_row(legacy)
+
+    def test_truncation_names_offset(self):
+        raw = pack_row({"id": 7, "name": "x" * 40})
+        for cut in range(1, len(raw)):
+            with pytest.raises(WireCodecError) as ei:
+                unpack_row(raw[:cut])
+            assert "offset" in str(ei.value)
+
+    def test_trailing_bytes_rejected(self):
+        raw = pack_row({"id": 1})
+        with pytest.raises(WireCodecError, match="trailing"):
+            unpack_row(raw + "\x00")
+
+
+class TestMetrics:
+    def test_codec_metrics_registered_and_counted(self):
+        from hops_tpu.telemetry.metrics import REGISTRY
+        before = REGISTRY.get("hops_tpu_wire_decode_seconds").labels().count
+        decode_frame(encode_frame([("x", np.zeros(2, np.float32))]))
+        after = REGISTRY.get("hops_tpu_wire_decode_seconds").labels().count
+        assert after == before + 1
+        wirecodec.count_request("packed")
+        assert REGISTRY.get("hops_tpu_wire_requests_total") \
+            .value(format="packed") >= 1.0
+
+    def test_media_type(self):
+        assert MEDIA_TYPE == "application/x-hops-packed"
