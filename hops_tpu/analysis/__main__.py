@@ -10,4 +10,11 @@ import sys
 from hops_tpu.analysis.cli import main
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        code = main()
+    except BrokenPipeError:
+        # ``--graph lock | head`` closes stdout early; that's the
+        # reader's choice, not an error worth a traceback.
+        sys.stderr.close()
+        code = 0
+    sys.exit(code)

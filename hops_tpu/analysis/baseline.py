@@ -104,6 +104,16 @@ class Baseline:
         return new, baselined, stale
 
 
+def group_stale(stale: list[dict]) -> list[tuple[str, list[dict]]]:
+    """Stale entries grouped by rule, biggest group first (ties break on
+    rule name) — with one ledger spanning 17 rules, a flat list hides
+    which rule's debt actually rotted."""
+    groups: dict[str, list[dict]] = {}
+    for e in stale:
+        groups.setdefault(e.get("rule", "<unknown>"), []).append(e)
+    return sorted(groups.items(), key=lambda kv: (-len(kv[1]), kv[0]))
+
+
 def write(path: Path, findings: list[Finding]) -> None:
     """Emit a baseline holding ``findings``, merging with any existing
     file at ``path``: entries whose fingerprint still matches keep their

@@ -90,14 +90,52 @@ def build_parser() -> argparse.ArgumentParser:
              "(default: docs/operations.md near the lint root)",
     )
     parser.add_argument(
-        "--rules", default=None,
-        help="comma-separated rule names to run (default: all)",
+        "--only", "--rules", dest="rules", default=None,
+        help="comma-separated rule names to run (default: all); "
+             "--rules is accepted as an alias",
+    )
+    parser.add_argument(
+        "--changed", action="store_true",
+        help="lint only files changed vs git HEAD (plus untracked); "
+             "whole-program rules still analyze the full tree, with "
+             "findings filtered to the changed files",
+    )
+    parser.add_argument(
+        "--graph", choices=("lock",), default=None,
+        help="dump the whole-program lock-acquisition graph instead of "
+             "linting (DOT on text output, structured with --format json)",
     )
     parser.add_argument(
         "--list-rules", action="store_true",
         help="print the rule catalog and exit",
     )
     return parser
+
+
+def changed_paths(root: Path) -> "list[Path] | None":
+    """``.py`` files changed vs HEAD plus untracked ones, or None when
+    ``root`` is not a usable git checkout."""
+    import subprocess
+
+    names: set[str] = set()
+    for args in (
+        ["diff", "--name-only", "HEAD", "--"],
+        ["ls-files", "--others", "--exclude-standard"],
+    ):
+        try:
+            proc = subprocess.run(
+                ["git", "-C", str(root), *args],
+                capture_output=True, text=True, timeout=30,
+            )
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if proc.returncode != 0:
+            return None
+        names.update(ln.strip() for ln in proc.stdout.splitlines() if ln.strip())
+    return [
+        root / n for n in sorted(names)
+        if n.endswith(".py") and (root / n).is_file()
+    ]
 
 
 def main(argv: Sequence[str] | None = None) -> int:
@@ -134,8 +172,42 @@ def main(argv: Sequence[str] | None = None) -> int:
         print(f"error: --docs file not found: {args.docs}", file=sys.stderr)
         return EXIT_USAGE
 
+    if args.graph is not None:
+        try:
+            files = engine.parse_files([Path(p) for p in paths], root)
+        except engine.ParseError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return EXIT_USAGE
+        from hops_tpu.analysis import concurrency
+        from hops_tpu.analysis.project import ProjectIndex
+
+        model = concurrency.ConcurrencyModel(ProjectIndex(files))
+        if args.format == "json":
+            print(json.dumps(model.graph_dict(), indent=2))
+        else:
+            print(model.graph_dot())
+        return EXIT_CLEAN
+
+    focus = None
+    if args.changed:
+        focus = changed_paths(root)
+        if focus is None:
+            print(
+                f"error: --changed needs a git checkout at {root}",
+                file=sys.stderr,
+            )
+            return EXIT_USAGE
+        if not focus:
+            if args.format == "json":
+                print(json.dumps(report([], [], []), indent=2))
+            else:
+                print("0 finding(s) (no changed files)", file=sys.stderr)
+            return EXIT_CLEAN
+
     try:
-        findings = engine.run(paths, root=root, docs_path=docs, rules=rules)
+        findings = engine.run(
+            paths, root=root, docs_path=docs, rules=rules, focus=focus
+        )
     except engine.ParseError as e:
         print(f"error: {e}", file=sys.stderr)
         return EXIT_USAGE
@@ -167,10 +239,11 @@ def main(argv: Sequence[str] | None = None) -> int:
             print(f"error: {e}", file=sys.stderr)
             return EXIT_USAGE
         findings, baselined, stale = bl.split(findings)
-        if args.rules is not None:
-            # A subset run can't see the findings the other rules'
-            # entries match — calling them stale would tell the user to
-            # delete entries a full run still needs.
+        if args.rules is not None or args.changed:
+            # A subset run (--only, --changed) can't see the findings
+            # the other rules' / other files' entries match — calling
+            # them stale would tell the user to delete entries a full
+            # run still needs.
             stale = []
 
     if args.format == "json":
@@ -178,12 +251,20 @@ def main(argv: Sequence[str] | None = None) -> int:
     else:
         for f in findings:
             print(f.render())
-        for e in stale:
+        if stale:
             print(
-                f"warning: stale baseline entry (no matching finding): "
-                f"{e['rule']} in {e['path']}: {e['message']}",
+                f"warning: {len(stale)} stale baseline entrie(s) "
+                f"(no matching finding) — delete them from the ledger:",
                 file=sys.stderr,
             )
+            for rule_name, entries in baseline_mod.group_stale(stale):
+                print(f"  {rule_name}: {len(entries)}", file=sys.stderr)
+                for e in entries:
+                    print(
+                        f"    {e['path']} [{e.get('symbol', '<module>')}]: "
+                        f"{e['message']}",
+                        file=sys.stderr,
+                    )
         summary = f"{len(findings)} finding(s)"
         if baselined:
             summary += f", {len(baselined)} baselined"

@@ -26,6 +26,9 @@ class Context:
 
     root: Path  # lint root findings' paths are relative to
     docs_path: Path | None = None  # docs/operations.md for metric checks
+    #: Per-run scratch shared across rules — the concurrency rules
+    #: memoize one whole-program model here instead of building three.
+    cache: dict = dataclasses.field(default_factory=dict)
 
     def docs_text(self) -> str | None:
         if self.docs_path is not None and self.docs_path.is_file():
@@ -118,12 +121,21 @@ def run(
     root: Path | None = None,
     docs_path: Path | None = None,
     rules: Iterable[Rule] | None = None,
+    focus: Iterable[Path] | None = None,
 ) -> list[Finding]:
     """Lint ``paths`` and return suppression-filtered findings.
 
     Baseline filtering is the caller's job (:mod:`.baseline`): the
     engine only honors inline/file pragmas, so ``--write-baseline``
     sees exactly the findings a baseline could absorb.
+
+    ``focus`` (``--changed``) restricts REPORTING to those files while
+    keeping the whole-program rules sound: file-local rules simply skip
+    unfocused files, but project rules still analyze every parsed file
+    (a lock graph built from a diff would miss the cross-file half of
+    an inversion) and only their findings are filtered afterwards — a
+    project finding survives when its anchor file OR any file in
+    ``Finding.related`` (its cross-file evidence) is focused.
     """
     paths = [Path(p) for p in paths]
     if root is None:
@@ -131,17 +143,30 @@ def run(
     ctx = Context(root=root, docs_path=docs_path)
     files = parse_files(paths, root)
     by_path = {pf.relpath: pf for pf in files}
+    focus_keys: set[Path] | None = None
+    if focus is not None:
+        focus_keys = {Path(p).resolve() for p in focus}
     active = list(rules) if rules is not None else all_rules()
     findings: list[Finding] = []
     for rule in active:
         for pf in files:
+            if focus_keys is not None and pf.path.resolve() not in focus_keys:
+                continue
             for finding in rule.check_file(pf, ctx):
                 if not pf.suppressed(rule.name, finding.line):
                     findings.append(finding)
         for finding in rule.check_project(files, ctx):
             pf = by_path.get(finding.path)
-            if pf is None or not pf.suppressed(finding.rule, finding.line):
-                findings.append(finding)
+            if pf is not None and pf.suppressed(finding.rule, finding.line):
+                continue
+            if focus_keys is not None:
+                involved = [finding.path, *finding.related]
+                if not any(
+                    rp in by_path and by_path[rp].path.resolve() in focus_keys
+                    for rp in involved
+                ):
+                    continue
+            findings.append(finding)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
 

@@ -49,6 +49,16 @@ class Finding:
     col: int
     message: str
     symbol: str = "<module>"
+    #: Multi-line evidence (e.g. acquisition chains file:line by
+    #: file:line). Excluded from the fingerprint — chains move with
+    #: every unrelated edit, and a baseline keyed on them would churn
+    #: exactly like a line-keyed one.
+    detail: str = ""
+    #: Other relpaths the finding's evidence spans (a cross-file
+    #: inversion anchors on ONE acquisition site but implicates both).
+    #: Engine-internal: ``--changed`` keeps a finding when any related
+    #: file is in the changed set; not serialized, not fingerprinted.
+    related: tuple = ()
 
     @property
     def fingerprint(self) -> str:
@@ -66,10 +76,14 @@ class Finding:
             "message": self.message,
             "symbol": self.symbol,
             "fingerprint": self.fingerprint,
+            "detail": self.detail,
         }
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message} [{self.symbol}]"
+        out = f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message} [{self.symbol}]"
+        if self.detail:
+            out += "".join(f"\n    {ln}" for ln in self.detail.splitlines())
+        return out
 
 
 class ParsedFile:
@@ -166,7 +180,9 @@ class ParsedFile:
                 best = qual
         return best
 
-    def finding(self, rule: str, node: ast.AST, message: str) -> Finding:
+    def finding(
+        self, rule: str, node: ast.AST, message: str, detail: str = ""
+    ) -> Finding:
         line = getattr(node, "lineno", 1)
         col = getattr(node, "col_offset", 0)
         return Finding(
@@ -176,4 +192,5 @@ class ParsedFile:
             col=col,
             message=message,
             symbol=self.symbol_at(line),
+            detail=detail,
         )

@@ -16,18 +16,24 @@ Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 - :mod:`.blocking_call` — ``blocking-call-no-deadline``
 - :mod:`.relay_json_roundtrip` — ``relay-json-roundtrip``
 - :mod:`.unbounded_priority_queue` — ``unbounded-priority-queue``
+- :mod:`.lock_order_inversion` — ``lock-order-inversion``
+- :mod:`.blocking_under_lock` — ``blocking-under-lock``
+- :mod:`.event_loop_stall` — ``event-loop-stall``
 """
 
 from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effects
     adhoc_http_server,
     blocking_call,
+    blocking_under_lock,
     debug_surfaces,
     donation,
+    event_loop_stall,
     hardcoded_loopback,
     host_sync,
     jit_purity,
     json_on_hot_wire,
     lock_discipline,
+    lock_order_inversion,
     metric_consistency,
     naked_retry,
     relay_json_roundtrip,

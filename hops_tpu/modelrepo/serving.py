@@ -58,6 +58,11 @@ TENSORFLOW_SERVING = FLAX
 
 _servers: dict[str, "_RunningServing"] = {}  # guarded by: _lock
 _lock = threading.Lock()
+#: Names whose _RunningServing is mid-construction (single-flight):
+#: the builder holds the name here — NOT _lock — while it loads the
+#: model, so unrelated start()/stop()/status calls never queue behind
+#: a model load. The Event is set when construction ends (either way).
+_starting: dict[str, threading.Event] = {}  # guarded by: _lock
 
 
 def _servings_file() -> Path:
@@ -1477,24 +1482,54 @@ def _host_here(name: str, dedicated: bool = False) -> dict[str, Any]:
     reg = _load_registry()
     if name not in reg:
         raise KeyError(f"serving {name!r} not found")
-    with _lock:
-        if name in _servers:
-            return reg[name]
-        running = _RunningServing(reg[name])
-        _servers[name] = running
-    with _registry_lock():
+    while True:
+        with _lock:
+            if name in _servers:
+                return reg[name]
+            ev = _starting.get(name)
+            if ev is None:
+                ev = _starting[name] = threading.Event()
+                break
+        # Another thread is building this serving: wait for it OUTSIDE
+        # the module lock, then re-check (its construction may have
+        # failed, in which case this thread takes over the build).
+        ev.wait()
         reg = _load_registry()
-        reg[name]["status"] = "Running"
-        reg[name]["port"] = running.port
-        reg[name]["pid"] = os.getpid()
-        # Only a DEDICATED host process (serving_host <name>) may be
-        # killed by stop() — never a notebook or a shared supervisor
-        # whose pid happens to be on the record.
-        if dedicated:
-            reg[name]["host"] = "standalone"
-        else:
-            reg[name].pop("host", None)
-        _save_registry(reg)
+    try:
+        # The slow part — registry model load, feature-store open, HTTP
+        # bind — runs with _lock RELEASED (graftlint: blocking-under-
+        # lock). Construction used to hold the module-wide lock, so any
+        # start/stop/status of ANY serving stalled for a full model load.
+        faultinject.fire("serving.start", key=name)  # chaos: slow load
+        running = _RunningServing(reg[name])
+    except BaseException:
+        with _lock:
+            _starting.pop(name, None)
+        ev.set()
+        raise
+    with _lock:
+        _servers[name] = running
+        _starting.pop(name, None)
+    try:
+        with _registry_lock():
+            reg = _load_registry()
+            reg[name]["status"] = "Running"
+            reg[name]["port"] = running.port
+            reg[name]["pid"] = os.getpid()
+            # Only a DEDICATED host process (serving_host <name>) may be
+            # killed by stop() — never a notebook or a shared supervisor
+            # whose pid happens to be on the record.
+            if dedicated:
+                reg[name]["host"] = "standalone"
+            else:
+                reg[name].pop("host", None)
+            _save_registry(reg)
+    finally:
+        # Wake waiters only after the registry says Running: start()
+        # peers must return a published record, and a stop() issued
+        # mid-construction must sequence its "Stopped" write AFTER this
+        # one, not interleave with it.
+        ev.set()
     log.info("serving %s listening on 127.0.0.1:%d", name, running.port)
     return reg[name]
 
@@ -1581,6 +1616,13 @@ def _pid_alive(pid: int | None) -> bool:
 
 
 def stop(name: str) -> None:
+    with _lock:
+        ev = _starting.get(name)
+    if ev is not None:
+        # A start() is mid-construction: let it publish (outside the
+        # module lock), then stop what it built — the behavior callers
+        # had when construction itself held _lock.
+        ev.wait()
     with _lock:
         running = _servers.pop(name, None)
     if running is not None:

@@ -127,6 +127,8 @@ POINTS = (
     "shard.lookup",
     "fleet.spawn",
     "placement.rpc",
+    "serving.start",
+    "workload.publish",
 )
 
 _MODES = ("error", "latency", "corrupt")

@@ -47,6 +47,7 @@ import time
 from pathlib import Path
 from typing import Any
 
+from hops_tpu.runtime import faultinject
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.telemetry.metrics import REGISTRY
 
@@ -167,6 +168,9 @@ class WorkloadRecorder:
         # at a later flush), so the accounted byte count is always an
         # exact on-disk prefix and _resync_locked can truncate to it.
         self._fh = open(self._segment_path(0), "ab", buffering=0)  # guarded by: self._lock
+        #: Helper threads fsync-publishing rolled segments; stop() joins
+        #: them so the closed manifest holds every segment.
+        self._publishers: list[threading.Thread] = []  # guarded by: self._lock
         # Running digest of the open segment, updated per written line:
         # finalization is O(1) — no 4 MiB read-back + re-hash while
         # request threads queue on the recorder lock.
@@ -183,23 +187,58 @@ class WorkloadRecorder:
         tmp.write_text(json.dumps(self._manifest, indent=2))
         os.replace(tmp, self.directory / "manifest.json")
 
-    def _finalize_segment_locked(self) -> None:  # guarded by: self._lock
-        """Close the open segment into the manifest (skip if empty)."""
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
-        self._fh.close()
+    def _detach_segment_locked(  # guarded by: self._lock
+        self, open_next: bool = True
+    ) -> dict[str, Any]:
+        """Swap the full segment out of the recorder state and open its
+        successor, so :meth:`_publish_segment` can fsync and manifest it
+        WITHOUT the lock. The entry's accounting (bytes, hash, seq
+        range) is final at detach time — nothing writes to a detached
+        handle — only its durability is still pending."""
         path = self._segment_path(self._segment_index)
-        if self._segment_requests == 0:
-            path.unlink(missing_ok=True)
+        seg = {
+            "fh": self._fh,
+            "path": path,
+            "entry": {
+                "file": path.name,
+                "bytes": self._bytes_written,
+                "sha256": self._segment_hash.hexdigest(),
+                "requests": self._segment_requests,
+                "first_seq": self._segment_first_seq,
+                "last_seq": self._seq,
+            },
+        }
+        if open_next:
+            self._open_next_segment_locked()
+        else:
+            self._fh = None  # closed recorder: _closed gates every write
+        return seg
+
+    def _publish_segment(self, seg: dict[str, Any]) -> None:
+        """Make a detached segment durable, then manifest it.
+
+        The fsync runs OUTSIDE the recorder lock — request threads used
+        to queue behind a disk flush on every hot-path segment roll
+        (graftlint: blocking-under-lock). The manifest entry lands only
+        after the bytes are durable, so a crash can never leave the
+        manifest referencing an unsynced segment; entries are kept
+        sorted by ``first_seq`` because publishes may complete out of
+        detach order."""
+        fh = seg["fh"]
+        try:
+            faultinject.fire("workload.publish")  # chaos: slow disk
+            fh.flush()
+            os.fsync(fh.fileno())
+        finally:
+            fh.close()
+        if seg["entry"]["requests"] == 0:
+            seg["path"].unlink(missing_ok=True)
             return
-        self._manifest["segments"].append({
-            "file": path.name,
-            "bytes": self._bytes_written,
-            "sha256": self._segment_hash.hexdigest(),
-            "requests": self._segment_requests,
-            "first_seq": self._segment_first_seq,
-            "last_seq": self._seq,
-        })
+        with self._lock:
+            segments = self._manifest["segments"]
+            segments.append(seg["entry"])
+            segments.sort(key=lambda s: s["first_seq"])
+            self._write_manifest_locked()
         _m_segments.inc()
 
     def _resync_locked(self) -> None:  # guarded by: self._lock
@@ -340,9 +379,20 @@ class WorkloadRecorder:
                 self._segment_requests += 1
                 self._total_requests += 1
                 if self._bytes_written >= self.segment_bytes:
-                    self._finalize_segment_locked()
-                    self._write_manifest_locked()
-                    self._open_next_segment_locked()
+                    # Hot-path roll: detach under the lock, fsync +
+                    # manifest on a helper thread — concurrent record()
+                    # calls keep appending to the fresh segment instead
+                    # of queueing behind the flush. stop() joins these.
+                    seg = self._detach_segment_locked()
+                    t = threading.Thread(
+                        target=self._publish_segment, args=(seg,),
+                        daemon=True, name="workload-capture-publish",
+                    )
+                    self._publishers = [
+                        p for p in self._publishers if p.is_alive()
+                    ]
+                    self._publishers.append(t)
+                    t.start()
             _m_captured.inc(surface=surface)
             return rec
         except Exception:  # graftlint: disable=swallowed-exception
@@ -352,24 +402,31 @@ class WorkloadRecorder:
     def rotate(self) -> None:
         """Finalize the open segment into the manifest and start a new
         one — the crash-flush path: after this the artifact on disk is
-        complete and replayable even if the process dies mid-write."""
+        complete and replayable even if the process dies mid-write.
+        Synchronous (durable on return), but the fsync itself runs with
+        the lock released so concurrent record() calls don't stall."""
         with self._lock:
             if self._closed:
                 return
-            self._finalize_segment_locked()
-            self._write_manifest_locked()
-            self._open_next_segment_locked()
+            seg = self._detach_segment_locked()
+        self._publish_segment(seg)
 
     def stop(self) -> Path:
         """Finalize everything; the artifact directory is the result."""
         with self._lock:
-            if not self._closed:
-                self._finalize_segment_locked()
-                self._segment_requests = 0
-                self._bytes_written = 0
-                self._closed = True
-                self._manifest["closed"] = True
-                self._write_manifest_locked()
+            if self._closed:
+                return self.directory
+            self._closed = True
+            seg = self._detach_segment_locked(open_next=False)
+            self._segment_requests = 0
+            self._bytes_written = 0
+            pending = list(self._publishers)
+        self._publish_segment(seg)
+        for t in pending:
+            t.join()  # every in-flight roll must land before "closed"
+        with self._lock:
+            self._manifest["closed"] = True
+            self._write_manifest_locked()
         return self.directory
 
     def status(self) -> dict[str, Any]:

@@ -1125,6 +1125,7 @@ def test_cli_json_schema(tmp_path, capsys):
     (finding,) = doc["findings"]
     assert set(finding) == {
         "rule", "path", "line", "col", "message", "symbol", "fingerprint",
+        "detail",
     }
     assert finding["rule"] == "swallowed-exception"
     assert doc["summary"] == {"count": 1, "by_rule": {"swallowed-exception": 1}}
@@ -1568,3 +1569,436 @@ def test_hardcoded_loopback_scoped_to_multi_host_paths(tmp_path):
     flagged = lint_code(tmp_path, code, rule="hardcoded-loopback",
                         filename="hops_tpu/featurestore/online_serving.py")
     assert rule_names(flagged) == ["hardcoded-loopback"]
+
+
+# -- whole-program concurrency rules ------------------------------------------
+
+
+def lint_tree(tmp_path: Path, files: dict[str, str], rule: str | None = None):
+    """Write several modules into one scratch tree and lint them together
+    (the concurrency rules are whole-program: identity and call edges
+    span files)."""
+    for name, code in files.items():
+        target = tmp_path / name
+        target.parent.mkdir(parents=True, exist_ok=True)
+        target.write_text(textwrap.dedent(code))
+    rules = None
+    if rule is not None:
+        rules = [r for r in engine.all_rules() if r.name == rule]
+        assert rules, f"unknown rule {rule}"
+    return engine.run([tmp_path], root=tmp_path, rules=rules)
+
+
+def test_lock_order_inversion_flags_ab_ba(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import threading
+
+        _registry_lock = threading.Lock()
+        _cache_lock = threading.Lock()
+
+        def publish(entry):
+            with _registry_lock:
+                with _cache_lock:
+                    return entry
+
+        def evict(key):
+            with _cache_lock:
+                with _registry_lock:
+                    return key
+        """,
+        rule="lock-order-inversion",
+    )
+    assert rule_names(findings) == ["lock-order-inversion"]
+    (f,) = findings
+    assert "_registry_lock" in f.message and "_cache_lock" in f.message
+    assert "publish" in f.message and "evict" in f.message
+    # Both acquisition chains land in the detail as file:line steps —
+    # and the detail is rendered, but excluded from the fingerprint.
+    assert "snip.py:" in f.detail and "conflicting order" in f.detail
+    assert f.detail.splitlines()[1] in f.render()
+
+
+def test_lock_order_inversion_cross_file_needs_whole_program(tmp_path):
+    """The AB half lives in liba, the BA half in libb, joined by calls:
+    either file alone is provably clean — only the whole-program graph
+    closes the cycle."""
+    files = {
+        "liba.py": """
+            import threading
+            import libb
+
+            LOCK_A = threading.Lock()
+
+            def grab_a():
+                with LOCK_A:
+                    pass
+
+            def renew():
+                with LOCK_A:
+                    libb.flush()
+            """,
+        "libb.py": """
+            import threading
+            import liba
+
+            LOCK_B = threading.Lock()
+
+            def flush():
+                with LOCK_B:
+                    pass
+
+            def audit():
+                with LOCK_B:
+                    liba.grab_a()
+            """,
+    }
+    findings = lint_tree(tmp_path, files, rule="lock-order-inversion")
+    assert rule_names(findings) == ["lock-order-inversion"]
+    assert "liba.py:LOCK_A" in findings[0].message
+    assert "libb.py:LOCK_B" in findings[0].message
+    # Single-file runs cannot see the other half of the cycle.
+    one = engine.run([tmp_path / "liba.py"], root=tmp_path,
+                     rules=[r for r in engine.all_rules()
+                            if r.name == "lock-order-inversion"])
+    other = engine.run([tmp_path / "libb.py"], root=tmp_path,
+                       rules=[r for r in engine.all_rules()
+                              if r.name == "lock-order-inversion"])
+    assert one == [] and other == []
+
+
+def test_lock_order_inversion_must_not_flag_sanctioned_shapes(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+        _r = threading.RLock()
+
+        def first(x):
+            # Consistent order everywhere: no cycle.
+            with _a:
+                with _b:
+                    return x
+
+        def second(x):
+            with _a:
+                with _b:
+                    return x + 1
+
+        def reenter(x):
+            # Same-lock re-entry is RLock territory, not an inversion.
+            with _r:
+                with _r:
+                    return x
+
+        def local_locks(other):
+            # Anonymous locals have no global identity; they must not
+            # fabricate graph nodes.
+            mine = threading.Lock()
+            with mine:
+                with other:
+                    return True
+        """,
+        rule="lock-order-inversion",
+    )
+    assert findings == []
+
+
+def test_blocking_under_lock_flags_direct_and_interprocedural(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import threading
+        import time
+        from urllib.request import urlopen
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow_probe(self):
+                with self._lock:
+                    time.sleep(0.5)
+
+            def refresh(self):
+                with self._lock:
+                    return self._dial()
+
+            def _dial(self):
+                return urlopen("http://example/health").read()
+        """,
+        rule="blocking-under-lock",
+    )
+    assert rule_names(findings) == ["blocking-under-lock"] * 2
+    direct, via_call = sorted(findings, key=lambda f: f.line)
+    assert "time.sleep" in direct.message
+    assert "Store._lock" in direct.message
+    # The interprocedural one names the blocking op, not the call site's
+    # innocent-looking helper, and carries the witness chain.
+    assert "urlopen" in via_call.message
+    assert "_dial" in via_call.detail
+    assert via_call.detail.count("snip.py:") >= 2
+
+
+def test_blocking_under_lock_must_not_flag_sanctioned_shapes(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import threading
+        import time
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+
+            def snapshot_then_wait(self):
+                with self._lock:
+                    state = dict(vars(self))
+                # Blocking OUTSIDE the critical section is the fix shape.
+                time.sleep(0.01)
+                return state
+
+            def consume(self):
+                # cv.wait under its own cv releases the lock: sanctioned.
+                with self._cv:
+                    while not getattr(self, "_ready", False):
+                        self._cv.wait()
+        """,
+        rule="blocking-under-lock",
+    )
+    assert findings == []
+
+
+def test_blocking_under_lock_foreign_lock_across_wait_still_flagged(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import threading
+
+        class Pipe:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+
+            def drain(self):
+                with self._lock:
+                    with self._cv:
+                        self._cv.wait()
+        """,
+        rule="blocking-under-lock",
+    )
+    # The wait waives _cv (it releases it) but NOT the outer _lock.
+    assert rule_names(findings) == ["blocking-under-lock"]
+    assert "Pipe._lock" in findings[0].message
+    assert "Condition.wait" in findings[0].message
+
+
+def test_event_loop_stall_flags_blocking_reachable_from_select(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import selectors
+        import time
+
+        class Server:
+            def __init__(self):
+                self._sel = selectors.DefaultSelector()
+
+            def _io_loop(self):
+                while True:
+                    for key, _ in self._sel.select(0.1):
+                        self._on_ready(key)
+
+            def _on_ready(self, key):
+                self._handle(key)
+
+            def _handle(self, key):
+                time.sleep(0.1)
+        """,
+        rule="event-loop-stall",
+    )
+    assert rule_names(findings) == ["event-loop-stall"]
+    (f,) = findings
+    assert "_io_loop" in f.message and "time.sleep" in f.message
+    # The witness chain walks root -> _on_ready -> _handle -> sleep.
+    assert "_on_ready" in f.detail and "_handle" in f.detail
+
+
+def test_event_loop_stall_worker_dispatch_is_clean(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import selectors
+        import time
+        from concurrent.futures import ThreadPoolExecutor
+
+        class Server:
+            def __init__(self):
+                self._sel = selectors.DefaultSelector()
+                self._pool = ThreadPoolExecutor(4)
+
+            def _io_loop(self):
+                while True:
+                    for key, _ in self._sel.select(0.1):
+                        self._on_ready(key)
+
+            def _on_ready(self, key):
+                # Handoff: the blocking handler runs on a worker thread,
+                # not the IO loop — the sanctioned escape.
+                self._pool.submit(self._handle, key)
+
+            def _handle(self, key):
+                time.sleep(0.1)
+        """,
+        rule="event-loop-stall",
+    )
+    assert findings == []
+
+
+# -- CLI: --only / --changed / --graph / grouped stale report -----------------
+
+
+def test_cli_only_is_an_alias_for_rules(tmp_path, capsys):
+    (tmp_path / "bad.py").write_text(_FINDING_SNIPPET)
+    assert cli.main([str(tmp_path), "--only", "swallowed-exception"]) \
+        == cli.EXIT_FINDINGS
+    assert cli.main([str(tmp_path), "--only", "jit-purity"]) == cli.EXIT_CLEAN
+    assert cli.main([str(tmp_path), "--only", "nope"]) == cli.EXIT_USAGE
+
+
+def _git(tmp_path, *args):
+    import subprocess
+
+    return subprocess.run(
+        ["git", "-C", str(tmp_path), "-c", "user.email=t@t", "-c",
+         "user.name=t", *args],
+        capture_output=True, text=True, check=True,
+    )
+
+
+def test_cli_changed_lints_only_changed_files(tmp_path, capsys):
+    clean = tmp_path / "committed.py"
+    clean.write_text(_FINDING_SNIPPET)  # committed finding: out of scope
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    capsys.readouterr()
+    assert cli.main([str(tmp_path), "--changed"]) == cli.EXIT_CLEAN
+    assert "no changed files" in capsys.readouterr().err
+    # An untracked file with a finding IS in scope...
+    (tmp_path / "fresh.py").write_text(_FINDING_SNIPPET)
+    capsys.readouterr()
+    assert cli.main([str(tmp_path), "--changed"]) == cli.EXIT_FINDINGS
+    out = capsys.readouterr().out
+    assert "fresh.py" in out and "committed.py" not in out
+
+
+def test_cli_changed_outside_git_is_usage_error(tmp_path, capsys):
+    (tmp_path / "ok.py").write_text("x = 1\n")
+    assert cli.main([str(tmp_path), "--changed"]) == cli.EXIT_USAGE
+    assert "--changed" in capsys.readouterr().err
+
+
+def test_cli_changed_keeps_project_rules_sound(tmp_path, capsys):
+    """--changed must report a cross-file inversion whose OTHER half is
+    unchanged: project rules analyze the full tree and only the
+    reporting is filtered."""
+    (tmp_path / "libb.py").write_text(textwrap.dedent("""
+        import threading
+        import liba
+
+        LOCK_B = threading.Lock()
+
+        def flush():
+            with LOCK_B:
+                pass
+
+        def audit():
+            with LOCK_B:
+                liba.grab_a()
+        """))
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+    (tmp_path / "liba.py").write_text(textwrap.dedent("""
+        import threading
+        import libb
+
+        LOCK_A = threading.Lock()
+
+        def grab_a():
+            with LOCK_A:
+                pass
+
+        def renew():
+            with LOCK_A:
+                libb.flush()
+        """))
+    capsys.readouterr()
+    assert cli.main(
+        [str(tmp_path), "--changed", "--only", "lock-order-inversion"]
+    ) == cli.EXIT_FINDINGS
+    assert "lock-order inversion" in capsys.readouterr().out
+
+
+def test_cli_graph_lock_json_and_dot(tmp_path, capsys):
+    (tmp_path / "m.py").write_text(textwrap.dedent("""
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.RLock()
+
+        def nest():
+            with _a:
+                with _b:
+                    pass
+        """))
+    assert cli.main([str(tmp_path), "--graph", "lock", "--format", "json"]) \
+        == cli.EXIT_CLEAN
+    doc = json.loads(capsys.readouterr().out)
+    assert {l["id"]: l["kind"] for l in doc["locks"]} == {
+        "m.py:_a": "lock", "m.py:_b": "rlock",
+    }
+    (edge,) = doc["edges"]
+    assert edge["from"] == "m.py:_a" and edge["to"] == "m.py:_b"
+    assert edge["function"] == "nest"
+    assert all({"path", "line", "step"} <= set(s) for s in edge["chain"])
+    assert cli.main([str(tmp_path), "--graph", "lock"]) == cli.EXIT_CLEAN
+    dot = capsys.readouterr().out
+    assert dot.startswith("digraph lock_order {")
+    assert '"m.py:_a" -> "m.py:_b"' in dot
+
+
+def test_cli_stale_entries_grouped_by_rule(tmp_path, capsys):
+    (tmp_path / "clean.py").write_text("x = 1\n")
+    bl = tmp_path / "analysis_baseline.json"
+    entries = [
+        {"rule": "jit-purity", "path": "a.py", "symbol": "f",
+         "message": "m1", "justification": "was real once"},
+        {"rule": "jit-purity", "path": "b.py", "symbol": "g",
+         "message": "m2", "justification": "was real once"},
+        {"rule": "swallowed-exception", "path": "c.py", "symbol": "h",
+         "message": "m3", "justification": "was real once"},
+    ]
+    bl.write_text(json.dumps({"version": 1, "entries": entries}))
+    capsys.readouterr()
+    assert cli.main([str(tmp_path)]) == cli.EXIT_CLEAN
+    err = capsys.readouterr().err
+    assert "warning: 3 stale baseline entrie(s)" in err
+    # Grouped by rule, biggest group first, entries indented beneath.
+    assert err.index("jit-purity: 2") < err.index("swallowed-exception: 1")
+    assert "    a.py [f]: m1" in err
+    assert "3 stale baseline entrie(s)" in err.splitlines()[-1]
+
+
+def test_group_stale_orders_by_count_then_name():
+    stale = [
+        {"rule": "b"}, {"rule": "a"}, {"rule": "c"}, {"rule": "a"},
+    ]
+    grouped = baseline_mod.group_stale(stale)
+    assert [(r, len(es)) for r, es in grouped] == [("a", 2), ("b", 1), ("c", 1)]

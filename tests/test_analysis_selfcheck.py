@@ -61,3 +61,34 @@ def test_docs_metric_tables_match_code_without_baseline():
         [PACKAGE], root=root, docs_path=default_docs(root), rules=rules
     )
     assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_concurrency_rules_hold_tree_wide():
+    """The three whole-program concurrency rules — lock-order-inversion,
+    blocking-under-lock, event-loop-stall — are part of the gate: zero
+    non-baselined findings across the real tree. Anything new is either
+    a bug to fix or debt to justify in the ledger."""
+    root = lint_root([PACKAGE])
+    wanted = {"lock-order-inversion", "blocking-under-lock", "event-loop-stall"}
+    rules = [r for r in engine.all_rules() if r.name in wanted]
+    assert {r.name for r in rules} == wanted
+    findings = engine.run([PACKAGE], root=root, rules=rules)
+    if BASELINE.is_file():
+        findings, _, _ = Baseline.load(BASELINE).split(findings)
+    assert not findings, (
+        "new concurrency findings (fix, or baseline with a written "
+        "justification):\n" + "\n".join(f.render() for f in findings)
+    )
+
+
+def test_analyzer_full_tree_wall_clock_bound():
+    """The whole-program analysis (symbol table, lock graph, blocking
+    fixpoint, selector reachability) must stay cheap enough to run on
+    every CI push: the full tree with ALL rules in well under a minute."""
+    import time
+
+    root = lint_root([PACKAGE])
+    t0 = time.monotonic()
+    engine.run([PACKAGE], root=root, docs_path=default_docs(root))
+    elapsed = time.monotonic() - t0
+    assert elapsed < 60.0, f"full-tree lint took {elapsed:.1f}s"
