@@ -1185,6 +1185,311 @@ def run_multi_host_bench(
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def run_partition_bench(
+    smoke: bool = False,
+    *,
+    clients: int = 4,
+    work_ms: float = 5.0,
+    measure_pad_s: float = 0.3,
+    heartbeat_s: float = 0.15,
+    lease_ttl_s: float = 0.6,
+    entities: int = 200,
+) -> dict:
+    """The ``--partition`` tier: the headline partition-tolerance chaos
+    drill, with MTTR decomposed into its control-plane phases.
+
+    Two hostd-backed hosts carry a 2-replica placed fleet and a placed
+    feature-shard pair, under closed-loop predict clients and a lookup
+    loop. Then, deterministically (``faultinject.cut`` at the
+    ``transport.send`` seam):
+
+    **Leg A — zombie re-place.** Cut all traffic TO the victim host
+    (its own egress stays up, so its lease keeps renewing — the worst
+    case: a healthy-feeling host nobody can reach). The reconcile sweep
+    finds the replica unreachable, bumps its slot's generation (the
+    fence) and the autoscaler re-places on the survivor
+    (``time_to_replace_s``). Heal the cut and probe the still-running
+    zombie with a request stamped at the slot's CURRENT generation: it
+    must answer the typed 410 (``heal_to_zombie_reject_s``), and the
+    sweep then reaps it. A placed shard on the victim is superseded the
+    same way and must 410 a stamped lookup (miss-degrade, no breaker
+    strike).
+
+    **Leg B — lease fence.** Cut the victim's egress too: announces
+    stop landing, the lease runs out, and the hostd self-fences —
+    drains and kills its own units (``time_to_fence_s``).
+
+    Throughout: ZERO client-visible errors (the router retries around
+    the cut; lookups degrade to misses), and the flight-event record
+    must pass the slot invariant audit (at most one live unit per
+    slot). Both are asserted, not just reported.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    import pandas as pd
+
+    from hops_tpu.featurestore.online import _key_of
+    from hops_tpu.featurestore.online_serving import (
+        ShardedOnlineStore, _shard_of)
+    from hops_tpu.jobs import placement
+    from hops_tpu.jobs.placement.invariants import audit
+    from hops_tpu.modelrepo import fleet, registry, serving
+    from hops_tpu.modelrepo.fleet.autoscale import AutoscalePolicy
+    from hops_tpu.runtime import config as rtconfig, faultinject, flight
+    from hops_tpu.runtime.httpclient import HTTPPool
+
+    if smoke:
+        clients, work_ms, entities = 2, 2.0, 80
+
+    tmp = Path(tempfile.mkdtemp(prefix="hops_tpu_partbench_"))
+    rtconfig.configure(workspace=str(tmp / "ws"), project="bench")
+    seq0 = flight.FLIGHT.seq
+    hostds: list = []
+    stores: list = []
+    load = None
+    lookup_stop = threading.Event()
+    lookup_thread = None
+    client = None
+    try:
+        art = tmp / "art"
+        art.mkdir()
+        (art / "p.py").write_text(
+            "import time\n"
+            "class Predict:\n"
+            "    def predict(self, instances):\n"
+            f"        time.sleep({work_ms / 1e3})\n"
+            "        return [[v[0]] for v in instances]\n"
+        )
+        registry.export(art, "partbench", metrics={"v": 1.0})
+        serving.create_or_update("partbench", model_name="partbench",
+                                 model_version=1, model_server="PYTHON")
+
+        announce = tmp / "announce"
+        for i in range(2):
+            hostds.append(placement.Hostd(
+                f"h{i}", inprocess_units=True, unit_root=tmp / f"h{i}",
+                announce_dir=announce, heartbeat_s=heartbeat_s,
+                lease_ttl_s=lease_ttl_s))
+        client = placement.PlacementClient(placement.HostRegistry(
+            announce_dir=announce, ttl_s=10 * lease_ttl_s))
+
+        class _Load:
+            def __init__(self, f, n):
+                self.f = f
+                self.errors = 0
+                self.ok = 0
+                self.lock = threading.Lock()
+                self.stop = threading.Event()
+                self.threads = [
+                    threading.Thread(target=self._run, daemon=True)
+                    for _ in range(n)
+                ]
+                for t in self.threads:
+                    t.start()
+
+            def _run(self):
+                while not self.stop.is_set():
+                    try:
+                        self.f.predict([[1]], timeout_s=30.0)
+                        with self.lock:
+                            self.ok += 1
+                    except Exception:  # noqa: BLE001 — counted, asserted zero
+                        with self.lock:
+                            self.errors += 1
+
+            def halt(self):
+                self.stop.set()
+                for t in self.threads:
+                    t.join(timeout=10)
+
+        def _wait(cond, budget_s, what):
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < budget_s:
+                if cond():
+                    return time.perf_counter() - t0
+                time.sleep(0.02)
+            raise RuntimeError(f"partition bench: {what} did not happen "
+                               f"within {budget_s}s")
+
+        with fleet.start_fleet(
+            "partbench", 2, placement=client,
+            autoscale=AutoscalePolicy(min_replicas=2, max_replicas=3,
+                                      up_cooldown_s=0.1),
+            autoscale_interval_s=0.2, scrape_interval_s=0.05,
+        ) as f:
+            # Placed feature shards (one ends up on each host).
+            rows = pd.DataFrame({
+                "uid": list(range(entities)),
+                "score": [i * 0.5 for i in range(entities)],
+            })
+            shard_units = [
+                client.spawn("shard", {
+                    "store": "partfeats", "version": 1, "shard_index": i,
+                    "shards": 2, "primary_key": ["uid"],
+                    "root": str(tmp / f"shard{i}"), "port": 0,
+                })
+                for i in range(2)
+            ]
+            store = ShardedOnlineStore(
+                "partfeats", primary_key=["uid"], units=shard_units,
+                placement=client, root=tmp / "online",
+                breaker_reset_s=0.25)
+            stores.append(store)
+            store.put_dataframe(rows)
+            lookup_errors = [0]
+
+            def _lookups():
+                i = 0
+                while not lookup_stop.is_set():
+                    try:
+                        store.multi_get([[i % entities], [(i + 7) % entities]])
+                    except Exception:  # noqa: BLE001 — counted, asserted zero
+                        lookup_errors[0] += 1
+                    i += 1
+                    time.sleep(0.01)
+
+            lookup_thread = threading.Thread(target=_lookups, daemon=True)
+            lookup_thread.start()
+            load = _Load(f, clients)
+            time.sleep(measure_pad_s)  # steady-state traffic before the cut
+
+            # -- leg A: asymmetric cut -> fence by generation -> re-place
+            victim_rep = next(r for r in f.manager.ready()
+                              if r.unit is not None)
+            victim = victim_rep.unit.host.name
+            zombie = victim_rep.unit  # survives rep.unit = None
+            faultinject.cut(victim)
+            t_cut = time.perf_counter()
+            _wait(
+                lambda: (client.current_generation(zombie.slot)
+                         > zombie.generation
+                         and len([r for r in f.manager.ready()
+                                  if r.unit is not None
+                                  and r.unit.host.name != victim]) >= 2),
+                30.0, "generation bump + re-place on the survivor")
+            time_to_replace = time.perf_counter() - t_cut
+
+            faultinject.heal(victim)
+            t_heal = time.perf_counter()
+            pool = HTTPPool(identity="bench")
+            token = f"{zombie.slot}:{client.current_generation(zombie.slot)}"
+            zombie_outcome = None
+            while time.perf_counter() - t_heal < 10.0:
+                try:
+                    code, _, _ = pool.request(
+                        "POST",
+                        f"http://{zombie.address}:{zombie.port}"
+                        "/v1/models/partbench:predict",
+                        b'{"instances": [[1]]}',
+                        {"Content-Type": "application/json",
+                         "X-Hops-Generation": token},
+                        timeout_s=2.0)
+                except OSError:
+                    zombie_outcome = "reaped"  # sweep got there first
+                    break
+                if code == 410:
+                    zombie_outcome = "rejected"
+                    break
+                time.sleep(0.02)
+            heal_to_zombie_reject = time.perf_counter() - t_heal
+            pool.close()
+            if zombie_outcome is None:
+                raise RuntimeError("partition bench: healed zombie neither "
+                                   "410'd a stamped request nor was reaped")
+            # The sweep must reap the superseded worker either way.
+            _wait(lambda: all(u.slot != zombie.slot
+                              for h in hostds if h.name == victim
+                              for u in h.units()),
+                  15.0, "zombie reap after heal")
+
+            # Shard half of the fence: supersede the victim's shard and
+            # prove a stamped lookup 410s (miss, no breaker strike).
+            shard_rejected = None
+            vic_shard = next((u for u in shard_units
+                              if u.host.name == victim), None)
+            if vic_shard is not None:
+                client.bump_generation(vic_shard.slot)
+                idx = shard_units.index(vic_shard)
+                key = next(k for k in range(entities)
+                           if _shard_of(_key_of([k]), 2) == idx)
+                seq_shard = flight.FLIGHT.seq
+                # The leg-A cut fed this shard's breaker; retry past
+                # its (shortened) reset so the stamped lookup actually
+                # reaches the superseded server.
+                t_sh = time.perf_counter()
+                while time.perf_counter() - t_sh < 5.0:
+                    got = store.multi_get([[key]])
+                    if (got == [None]
+                            and flight.FLIGHT.events("generation_rejected",
+                                                     after_seq=seq_shard)):
+                        shard_rejected = True
+                        break
+                    time.sleep(0.05)
+                else:
+                    shard_rejected = False
+
+            # -- leg B: full cut -> lease starves -> self-fence ---------
+            seq_b = flight.FLIGHT.seq
+            faultinject.cut(victim)
+            faultinject.cut(f"{victim}->*")
+            t_cut_b = time.perf_counter()
+            _wait(lambda: flight.FLIGHT.events("fence", after_seq=seq_b),
+                  30 * lease_ttl_s, "lease-expiry self-fence")
+            time_to_fence = time.perf_counter() - t_cut_b
+            fence_event = flight.FLIGHT.events("fence", after_seq=seq_b)[0]
+            faultinject.heal()
+            time.sleep(measure_pad_s)  # healed steady state before halt
+
+            load.halt()
+            lookup_stop.set()
+            lookup_thread.join(timeout=10)
+
+            violations = audit(after_seq=seq0)
+            errors = load.errors + lookup_errors[0]
+            if errors:
+                raise RuntimeError(
+                    f"partition bench: {load.errors} client + "
+                    f"{lookup_errors[0]} lookup errors (must be zero)")
+            if violations:
+                raise RuntimeError(
+                    f"partition bench: slot-invariant audit failed: "
+                    f"{violations}")
+
+            return {
+                "victim": victim,
+                "time_to_replace_s": round(time_to_replace, 3),
+                "heal_to_zombie_reject_s": round(heal_to_zombie_reject, 3),
+                "zombie_outcome": zombie_outcome,
+                "shard_generation_rejected": shard_rejected,
+                "time_to_fence_s": round(time_to_fence, 3),
+                "lease_ttl_s": lease_ttl_s,
+                "fence_reaped_units": len(
+                    fence_event.get("data", {}).get("units", [])),
+                "requests_ok": load.ok,
+                "errors": 0,
+                "audit_violations": 0,
+            }
+    finally:
+        faultinject.heal()
+        if load is not None:
+            load.halt()
+        lookup_stop.set()
+        if lookup_thread is not None:
+            lookup_thread.join(timeout=10)
+        for s in stores:
+            try:
+                s.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
+        if client is not None:
+            client.close()
+        for h in hostds:
+            h.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def run_tail_bench(
     smoke: bool = False,
     *,
@@ -2913,6 +3218,15 @@ def main() -> None:
         "relay lock)",
     )
     parser.add_argument(
+        "--partition", action="store_true",
+        help="partition-tolerance chaos drill: asymmetric network cut "
+        "of a host carrying a placed replica + feature shard, with "
+        "MTTR decomposed (time-to-re-place after the generation fence, "
+        "heal-to-zombie-410, lease-expiry time-to-self-fence); asserts "
+        "zero client-visible errors and a clean slot-invariant audit; "
+        "host-only (no accelerator, no relay lock)",
+    )
+    parser.add_argument(
         "--tail", action="store_true",
         help="tail-robustness tier: Poisson load against a fleet with "
         "an injected slow-not-dead replica (hedging + outlier ejection "
@@ -3116,6 +3430,19 @@ def main() -> None:
             "metric": "multi_host_placed_over_local",
             "value": result["placed_over_local"],
             "unit": "x",
+            **result,
+        }))
+        return
+
+    if args.partition:
+        # Entirely host-side, like --multi-host: no accelerator touch,
+        # no relay lock.
+        _note("partition bench: asymmetric cut -> fence -> re-place -> heal")
+        result = run_partition_bench(smoke=args.smoke)
+        print(json.dumps({
+            "metric": "partition_time_to_replace_s",
+            "value": result["time_to_replace_s"],
+            "unit": "s",
             **result,
         }))
         return

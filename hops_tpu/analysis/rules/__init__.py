@@ -19,6 +19,7 @@ Each module registers one rule with :func:`hops_tpu.analysis.engine.register`:
 - :mod:`.lock_order_inversion` — ``lock-order-inversion``
 - :mod:`.blocking_under_lock` — ``blocking-under-lock``
 - :mod:`.event_loop_stall` — ``event-loop-stall``
+- :mod:`.wall_clock_deadline` — ``wall-clock-deadline``
 """
 
 from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effects
@@ -39,4 +40,5 @@ from hops_tpu.analysis.rules import (  # noqa: F401 — registration side effect
     relay_json_roundtrip,
     swallowed_exception,
     unbounded_priority_queue,
+    wall_clock_deadline,
 )

@@ -148,6 +148,17 @@ def _shard_of(key: str, n: int) -> int:
     return zlib.crc32(key.encode()) % n
 
 
+class GenerationSupersededError(RuntimeError):
+    """A shard server refused the request with a typed 410: the stamped
+    ``X-Hops-Generation`` token supersedes the server's own — the
+    endpoint is a ZOMBIE, a unit whose slot was re-placed while its
+    host was partitioned. Deliberately not an ``OSError``: the shard is
+    healthy and answering, so this must bypass the transport-failure
+    breaker accounting (no strike — striking would eject the slot while
+    the placement layer is already healing it) and degrade to missing
+    keys only."""
+
+
 class _RemoteShard:
     """Client proxy for one placed shard server (``jobs.placement.
     shardd``), shaped exactly like :class:`~hops_tpu.featurestore.
@@ -156,15 +167,25 @@ class _RemoteShard:
     Transport failures and non-200 answers raise ``OSError`` subclasses
     — precisely what ``multi_get``'s per-shard breaker/hedge/deadline
     machinery already catches, so placed shards inherit the local tail
-    semantics without a line of change there.
+    semantics without a line of change there. The one exception is a
+    410, which raises :class:`GenerationSupersededError` (see its docs).
+
+    ``generation_token`` stamps every exchange with the slot's identity
+    (``X-Hops-Generation``): a static ``"slot:gen"`` string, or a
+    zero-arg callable re-read per request so the stamp tracks the
+    placement client's LIVE generation counter — after a re-placement
+    bump, in-flight lookups immediately carry the new token and any
+    zombie still holding the old identity 410s.
     """
 
-    def __init__(self, endpoint: str, *, timeout_s: float = 5.0):
+    def __init__(self, endpoint: str, *, timeout_s: float = 5.0,
+                 generation_token: str | Callable[[], str] | None = None):
         from hops_tpu.runtime.httpclient import HTTPPool
 
         self.endpoint = endpoint.rstrip("/")
         self.timeout_s = float(timeout_s)
-        self._pool = HTTPPool(max_idle_per_host=4)
+        self._pool = HTTPPool(max_idle_per_host=4, identity="store-client")
+        self._generation_token = generation_token
         #: Codecs the shard server advertised at handshake; ``None``
         #: until the first ``get_many`` probes ``/healthz``. A server
         #: that predates the handshake field is pinned JSON-only.
@@ -182,10 +203,23 @@ class _RemoteShard:
         hdrs = dict(headers or {})
         if body:
             hdrs.setdefault("Content-Type", "application/json")
+        tok = self._generation_token
+        if callable(tok):
+            tok = tok()
+        if tok:
+            # Same literal as jobs.placement.client.GENERATION_HEADER
+            # (not imported: the featurestore stays decoupled from the
+            # placement package's import chain).
+            hdrs.setdefault("X-Hops-Generation", tok)
         code, data, resp_hdrs = self._pool.request(
             method, f"{self.endpoint}{path}", body, hdrs or None,
             timeout_s=self.timeout_s,
         )
+        if code == 410:
+            raise GenerationSupersededError(
+                f"shard server {self.endpoint}{path} answered 410 "
+                f"(superseded generation — zombie endpoint, stamped "
+                f"{tok!r})")
         if code != 200:
             raise ConnectionError(
                 f"shard server {self.endpoint}{path} answered {code}")
@@ -278,10 +312,34 @@ class ShardedOnlineStore:
         fanout: bool = True,
         hedge: bool = True,
         endpoints: list[str] | None = None,
+        units: list[Any] | None = None,
+        placement: Any = None,
         rpc_timeout_s: float = 5.0,
     ):
         if not primary_key:
             raise ValueError("ShardedOnlineStore needs a primary_key")
+        if units is not None and endpoints is not None:
+            raise ValueError("units= and endpoints= are exclusive: units "
+                             "derive their own endpoints")
+        tokens: list[Any] = []
+        if units is not None:
+            # PLACED mode by PlacedUnit: derive each shard's endpoint
+            # AND its generation identity. With a placement client the
+            # token is a live read of the slot's current generation
+            # (tracks re-placement bumps mid-flight); without one it is
+            # pinned to the unit's minted generation.
+            if not units:
+                raise ValueError("units= must name at least one shard unit")
+            endpoints = [f"http://{u.address}:{u.port}" for u in units]
+            for u in units:
+                slot = getattr(u, "slot", None)
+                if slot is None:
+                    tokens.append(None)
+                elif placement is not None:
+                    tokens.append(
+                        lambda s=slot: f"{s}:{placement.current_generation(s)}")
+                else:
+                    tokens.append(f"{slot}:{u.generation}")
         if endpoints is not None and not endpoints:
             raise ValueError("endpoints= must name at least one shard server")
         if shards < 1:
@@ -302,8 +360,11 @@ class ShardedOnlineStore:
             # consulted. Everything else (crc32 routing, per-shard
             # breakers, fan-out, hedging) is identical to local mode.
             shards = len(endpoints)
+            if not tokens:
+                tokens = [None] * shards
             self._shards: list[Any] = [
-                _RemoteShard(ep, timeout_s=rpc_timeout_s) for ep in endpoints
+                _RemoteShard(ep, timeout_s=rpc_timeout_s, generation_token=tok)
+                for ep, tok in zip(endpoints, tokens)
             ]
         else:
             # The shard layout is part of the data: crc32(key) % N only
@@ -555,6 +616,17 @@ class ShardedOnlineStore:
                         )
                     else:
                         rows = self._shard_lookup(shard, pk_lists)
+                except GenerationSupersededError as e:
+                    # Zombie endpoint (typed 410): degrade to missing
+                    # keys with NO breaker strike — the shard answered
+                    # healthily, it is the placement layer's job to
+                    # swap the endpoint, not the breaker's to eject it.
+                    self._m_error.inc(len(items))
+                    log.warning(
+                        "online store %s shard %d superseded: %s",
+                        self.label, idx, e,
+                    )
+                    continue
                 except Exception as e:  # noqa: BLE001 — a dead shard degrades, never raises
                     breaker.record_failure()
                     self._m_error.inc(len(items))
@@ -703,6 +775,14 @@ class ShardedOnlineStore:
                 continue
             ok, rows, elapsed = res
             if not ok:
+                if isinstance(rows, GenerationSupersededError):
+                    # Zombie endpoint (typed 410): miss-degrade, no
+                    # breaker strike — see the sequential path.
+                    self._m_error.inc(len(items))
+                    log.warning(
+                        "online store %s shard %d superseded: %s",
+                        self.label, idx, rows)
+                    continue
                 self._breakers[idx].record_failure()
                 self._m_error.inc(len(items))
                 log.warning(

@@ -291,8 +291,8 @@ def stop_job(name: str, execution_id: str | None = None) -> None:
 def wait_for_completion(name: str, execution_id: str, timeout_s: float = 600.0) -> Execution:
     """Poll an execution to a final state (the Flink client's 90 s poll
     loop, jobs_flink_client.py:55-61, with a configurable budget)."""
-    deadline = time.time() + timeout_s
-    while time.time() < deadline:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
         ex = get_execution(name, execution_id)
         if ex.final:
             return ex

@@ -107,8 +107,8 @@ class JobSuccessSensor(Operator):
         self.poke_s = poke_s
 
     def execute(self, context):
-        deadline = time.time() + self.timeout_s
-        while time.time() < deadline:
+        deadline = time.monotonic() + self.timeout_s
+        while time.monotonic() < deadline:
             exs = api.get_executions(self.job_name)
             if exs and exs[0].final:
                 if exs[0].state == "FINISHED":

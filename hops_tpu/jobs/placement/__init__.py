@@ -23,24 +23,42 @@ jobs service (PAPER.md L6, ``jobs-client/``):
 - :mod:`~hops_tpu.jobs.placement.shardd` — one feature-store shard
   (``featurestore.online.OnlineStore``) behind HTTP, warm-startable
   from a PR 8 snapshot manifest, jax-free so it starts in milliseconds.
+- :mod:`~hops_tpu.jobs.placement.lease` — :class:`Lease`: the TTL
+  contract behind hostd's self-fencing (a host that cannot renew
+  kills its own units before survivors re-place them).
+- :mod:`~hops_tpu.jobs.placement.invariants` — the post-hoc audit
+  proving "at most one live unit per slot" from flight events.
 
 Data plane vs control plane: the placement client places units and
 manages their lifecycle; request traffic (router forwards, shard
 ``multi_get`` fan-out) goes DIRECT to each unit's ``host:port`` — the
-hostd is never on the hot path.
+hostd is never on the hot path. Partition tolerance spans both: the
+client mints ``(slot, generation)`` identity for every unit, data
+planes refuse superseded generations, and the lease fences the host
+side (docs/operations.md "Partition tolerance & fencing").
 
 See docs/operations.md "Multi-host placement".
 """
 
-from hops_tpu.jobs.placement.client import PlacedUnit, PlacementClient, PlacementError
+from hops_tpu.jobs.placement.client import (
+    GENERATION_HEADER,
+    PlacedUnit,
+    PlacementClient,
+    PlacementError,
+)
 from hops_tpu.jobs.placement.hostd import Hostd
+from hops_tpu.jobs.placement.invariants import audit_slot_invariant
+from hops_tpu.jobs.placement.lease import Lease
 from hops_tpu.jobs.placement.registry import Host, HostRegistry
 
 __all__ = [
+    "GENERATION_HEADER",
     "Host",
     "HostRegistry",
     "Hostd",
+    "Lease",
     "PlacedUnit",
     "PlacementClient",
     "PlacementError",
+    "audit_slot_invariant",
 ]

@@ -21,6 +21,18 @@ what "the autoscaler re-places on survivors" means mechanically: the
 autoscaler just calls ``manager.spawn()``; this client routes it away
 from the dead host.
 
+This client also MINTS placement identity: every spawn fills a
+``slot`` (caller-named or auto) at a fresh ``generation`` — a
+monotonic per-slot counter this client owns. The cfg handed to the
+unit carries both, forwarders stamp ``X-Hops-Generation:
+<slot>:<current generation>`` on data-plane requests, and a unit whose
+own token differs refuses with a typed 410. ``bump_generation`` is the
+fencing verb: called BEFORE re-placing a lost unit, it supersedes the
+old one so a zombie healing from a partition is rejected at the data
+plane — "at most one live unit per slot", enforced, and audited post
+hoc by :mod:`~hops_tpu.jobs.placement.invariants` from the
+``generation``/``fence`` flight events recorded here.
+
 Metrics (docs/operations.md "Multi-host placement"):
 ``hops_tpu_placement_rpc_total{host,verb,outcome}``,
 ``hops_tpu_placement_rpc_seconds{verb}``,
@@ -37,7 +49,7 @@ import time
 from typing import Any
 
 from hops_tpu.jobs.placement.registry import Host, HostRegistry
-from hops_tpu.runtime import faultinject
+from hops_tpu.runtime import faultinject, flight
 from hops_tpu.runtime.httpclient import HTTPPool
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.runtime.resilience import CircuitBreaker, with_deadline
@@ -74,16 +86,27 @@ class PlacementError(RuntimeError):
     healthy host left to place on)."""
 
 
+#: The wire header carrying the placement identity a forward was
+#: routed under (see module docs): ``X-Hops-Generation: <slot>:<gen>``.
+GENERATION_HEADER = "X-Hops-Generation"
+
+
 @dataclasses.dataclass
 class PlacedUnit:
     """Handle to one unit placed on some host: the manager's record of
-    where its worker lives, and the argument to every lifecycle verb."""
+    where its worker lives, and the argument to every lifecycle verb.
+    ``slot``/``generation`` are the identity MINTED for this unit; the
+    slot's *current* generation lives in the client
+    (:meth:`PlacementClient.current_generation`) and moves past this
+    snapshot when the unit is superseded."""
 
     host: Host
     uid: str
     kind: str
     port: int
     pid: int | None = None
+    slot: str | None = None
+    generation: int = 0
 
     @property
     def address(self) -> str:
@@ -110,10 +133,12 @@ class PlacementClient:
         self.spawn_timeout_s = spawn_timeout_s
         self._breaker_failures = breaker_failures
         self._breaker_reset_s = breaker_reset_s
-        self._pool = pool if pool is not None else HTTPPool()
+        self._pool = pool if pool is not None else HTTPPool(identity="placement")
         self._lock = threading.Lock()
         self._breakers: dict[str, CircuitBreaker] = {}  # guarded by: self._lock
         self._placed: dict[str, int] = {}  # per-host unit count, guarded by: self._lock
+        self._generations: dict[str, int] = {}  # slot → current gen, guarded by: self._lock
+        self._slot_seq = 0  # auto-slot counter, guarded by: self._lock
 
     # -- host view ------------------------------------------------------------
 
@@ -232,10 +257,21 @@ class PlacementClient:
         return hosts
 
     def spawn(self, kind: str, cfg: dict[str, Any], *,
-              prefer: str | None = None) -> PlacedUnit:
+              prefer: str | None = None,
+              slot: str | None = None) -> PlacedUnit:
         """Place one unit on the least-placed healthy host, retrying the
         next candidate when a host fails — the caller sees one spawn,
-        however many hosts died under it."""
+        however many hosts died under it. The unit fills ``slot``
+        (auto-minted when None; pass the old slot to RE-place) at a
+        freshly minted generation, both injected into its cfg."""
+        with self._lock:
+            if slot is None:
+                self._slot_seq += 1
+                slot = f"{kind}-{self._slot_seq}"
+            gen = self._generations.get(slot, 0) + 1
+            self._generations[slot] = gen
+        cfg = dict(cfg)
+        cfg["slot"], cfg["generation"] = slot, gen
         errors: list[str] = []
         for host in self._candidates(prefer):
             try:
@@ -249,7 +285,11 @@ class PlacementClient:
                             "next host: %s", kind, host.name, e)
                 continue
             unit = PlacedUnit(host=host, uid=rec["uid"], kind=kind,
-                              port=int(rec["port"]), pid=rec.get("pid"))
+                              port=int(rec["port"]), pid=rec.get("pid"),
+                              slot=slot, generation=gen)
+            flight.record("generation", action="mint", slot=slot,
+                          generation=gen, unit_kind=kind, host=host.name,
+                          uid=unit.uid)
             with self._lock:
                 self._placed[host.name] = self._placed.get(host.name, 0) + 1
             _m_units.set(self._placed_count(host.name, kind),
@@ -258,6 +298,36 @@ class PlacementClient:
         raise PlacementError(
             "no healthy host could place a "
             f"{kind} unit: {'; '.join(errors) or 'registry is empty'}")
+
+    # -- generations (fencing tokens) -----------------------------------------
+
+    def bump_generation(self, slot: str) -> int:
+        """Supersede ``slot``'s current occupant BEFORE re-placing it:
+        any unit still holding an older generation — a zombie healing
+        from a partition — is now refused at the data plane (typed 410
+        against the stamped header) and reaped by ``reconcile()``."""
+        with self._lock:
+            gen = self._generations.get(slot, 0) + 1
+            self._generations[slot] = gen
+        flight.record("generation", action="bump", slot=slot, generation=gen)
+        log.warning("placement: slot %s bumped to generation %d "
+                    "(previous occupant superseded)", slot, gen)
+        return gen
+
+    def current_generation(self, slot: str) -> int:
+        with self._lock:
+            return self._generations.get(slot, 0)
+
+    def generation_header(self, unit: PlacedUnit) -> dict[str, str]:
+        """Headers stamping ``unit``'s slot at its CURRENT generation
+        (empty when the unit carries no identity). Deliberately the
+        live counter, not the unit's snapshot: a stale routing view
+        aiming at a superseded unit must present the newer token so
+        the zombie rejects it."""
+        if unit is None or unit.slot is None:
+            return {}
+        return {GENERATION_HEADER:
+                f"{unit.slot}:{self.current_generation(unit.slot)}"}
 
     def _placed_count(self, host_name: str, kind: str) -> int:
         # The gauge tracks per-(host, kind); the balance counter is

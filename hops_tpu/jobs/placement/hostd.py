@@ -38,7 +38,20 @@ Join-via-announce: given ``announce_dir``, the hostd heartbeats its
 ``heartbeat_s`` so registries list it while it lives and age it out
 when it stops.
 
-See docs/operations.md "Multi-host placement".
+Each heartbeat announce renews a :class:`~hops_tpu.jobs.placement.
+lease.Lease` (TTL ``lease_ttl_s``, default ``3 × heartbeat_s``). When
+renewals keep failing past the TTL — the host is partitioned from the
+registry — the hostd honors the suicide pact: :meth:`Hostd.self_fence`
+drains and kills every unit it runs, so a cut-off host can never keep
+serving a placement the survivors have re-placed. The agent itself
+stays up and keeps trying to renew; after the partition heals it
+rejoins empty. Announces pass the ``transport.send`` fault point
+(destination ``registry``), and the hostd registers its agent port and
+every unit port under its host name via ``faultinject.name_endpoint``
+— one ``cut("h1")`` severs the whole host, agent and units alike.
+
+See docs/operations.md "Multi-host placement" and "Partition
+tolerance & fencing".
 """
 
 from __future__ import annotations
@@ -55,8 +68,9 @@ import urllib.request
 from pathlib import Path
 from typing import Any
 
+from hops_tpu.jobs.placement.lease import Lease
 from hops_tpu.jobs.placement.registry import Host, HostRegistry
-from hops_tpu.runtime import faultinject
+from hops_tpu.runtime import faultinject, flight
 from hops_tpu.runtime.httpserver import HTTPServer
 from hops_tpu.runtime.logging import get_logger
 
@@ -68,7 +82,8 @@ UNIT_KINDS = ("replica", "shard")
 class _Unit:
     """One placed worker on this host."""
 
-    def __init__(self, uid: str, kind: str):
+    def __init__(self, uid: str, kind: str, *, slot: str | None = None,
+                 generation: int = 0):
         self.uid = uid
         self.kind = kind
         self.state = "starting"
@@ -76,6 +91,11 @@ class _Unit:
         self.proc: subprocess.Popen | None = None
         self.server: Any = None  # in-process _RunningServing / ShardServer
         self.dir: Path | None = None
+        # Placement identity (minted by PlacementClient, carried in
+        # cfg): which slot this unit fills and at which generation —
+        # the fence/audit trail's ground truth.
+        self.slot = slot
+        self.generation = generation
 
     @property
     def pid(self) -> int | None:
@@ -83,7 +103,8 @@ class _Unit:
 
     def record(self) -> dict[str, Any]:
         return {"uid": self.uid, "kind": self.kind, "state": self.state,
-                "port": self.port, "pid": self.pid}
+                "port": self.port, "pid": self.pid, "slot": self.slot,
+                "generation": self.generation}
 
 
 class Hostd:
@@ -101,6 +122,7 @@ class Hostd:
         unit_root: str | Path | None = None,
         announce_dir: str | Path | None = None,
         heartbeat_s: float = 3.0,
+        lease_ttl_s: float | None = None,
         spawn_timeout_s: float = 60.0,
     ):
         self.name = name
@@ -113,11 +135,18 @@ class Hostd:
         self._server = _make_server(self, bind, port)
         self.port = self._server.port
         self.address = bind
+        faultinject.name_endpoint(f"{bind}:{self.port}", name)
         self._announce_dir = Path(announce_dir) if announce_dir else None
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
+        self.lease: Lease | None = None
         if self._announce_dir is not None:
+            # Construction is the first renewal: announce before the
+            # heartbeat thread exists, lease granted from "now".
             HostRegistry.announce(self._announce_dir, self.host())
+            self.lease = Lease(
+                name,
+                lease_ttl_s if lease_ttl_s is not None else 3.0 * heartbeat_s)
             self._hb_thread = threading.Thread(
                 target=self._heartbeat, args=(heartbeat_s,),
                 name=f"hostd-{name}-hb", daemon=True)
@@ -130,7 +159,54 @@ class Hostd:
 
     def _heartbeat(self, interval_s: float) -> None:
         while not self._hb_stop.wait(interval_s):
+            self._renew_lease()
+
+    def _renew_lease(self) -> None:
+        """One heartbeat: announce (= renew), or fence once the lease
+        has run out. The announce passes the ``transport.send`` fault
+        point as this host → ``registry``, so a partition cut on this
+        host's egress starves the lease exactly like a real cut."""
+        try:
+            faultinject.fire_transport(self.name, "registry")
             HostRegistry.announce(self._announce_dir, self.host())
+        except OSError as e:
+            self.lease.renewal_failed()
+            log.warning(
+                "hostd %s: lease renewal failed (%s: %s); %.1fs of lease left",
+                self.name, type(e).__name__, e,
+                max(self.lease.remaining_s(), 0.0))
+        else:
+            self.lease.renew()
+        if self.lease.expired() and self.lease.mark_fenced():
+            self.self_fence(
+                f"lease expired: no successful renewal in "
+                f"{self.lease.ttl_s:.1f}s")
+
+    def self_fence(self, reason: str) -> None:
+        """The suicide-pact half of the lease contract: this host has
+        been unable to renew for a full TTL, so the registry (and
+        everything placing against it) has already given it up and may
+        be re-placing its units on survivors. Drain and kill every
+        unit NOW — a partitioned host must never keep serving. The
+        agent stays up; after the partition heals the next successful
+        renewal rejoins the (now empty) host."""
+        units = self.units()
+        flight.record("fence", host=self.name, reason=reason,
+                      units=[u.record() for u in units])
+        log.error("hostd %s: SELF-FENCE (%s) — draining and killing %d "
+                  "unit(s)", self.name, reason, len(units))
+        for unit in units:
+            try:
+                self.drain(unit.uid)
+            except Exception as e:  # noqa: BLE001 — best-effort drain;
+                # the reap below is the guarantee
+                log.warning("hostd %s: fence drain of %s failed: %s",
+                            self.name, unit.uid, e)
+            try:
+                self.reap(unit.uid)
+            except Exception as e:  # noqa: BLE001 — keep fencing the rest
+                log.warning("hostd %s: fence reap of %s failed: %s",
+                            self.name, unit.uid, e)
 
     # -- unit bookkeeping -----------------------------------------------------
 
@@ -161,7 +237,8 @@ class Hostd:
         with self._lock:
             uid = f"u{self._counter}"
             self._counter += 1
-            unit = _Unit(uid, kind)
+            unit = _Unit(uid, kind, slot=cfg.get("slot"),
+                         generation=int(cfg.get("generation", 0)))
             self._units[uid] = unit
         try:
             if self.inprocess_units:
@@ -180,6 +257,10 @@ class Hostd:
             with self._lock:
                 self._units.pop(unit.uid, None)
             raise
+        if unit.port is not None:
+            # Partition keying: the unit belongs to this host, so a
+            # cut of the host name black-holes its data plane too.
+            faultinject.name_endpoint(f"{self.address}:{unit.port}", self.name)
         log.info("hostd %s: unit %s (%s) up on port %s", self.name, uid,
                  kind, unit.port)
         return unit.record()
@@ -306,7 +387,9 @@ class Hostd:
     def handle(self, method: str, path: str, body: dict) -> tuple[int, dict]:
         if method == "GET" and path == "/healthz":
             return 200, {"status": "ok", "host": self.name,
-                         "units": len(self.units())}
+                         "units": len(self.units()),
+                         "fenced": bool(self.lease is not None
+                                        and self.lease.fenced)}
         if method == "GET" and path == "/units":
             return 200, {"units": [u.record() for u in self.units()]}
         if method == "POST" and path == "/units/spawn":
@@ -390,6 +473,11 @@ def main(argv: list[str] | None = None) -> None:
     parser.add_argument("--bind", default="127.0.0.1")
     parser.add_argument("--announce", default=None,
                         help="registry announce directory (join mode)")
+    parser.add_argument("--heartbeat", type=float, default=3.0,
+                        help="announce/lease-renewal cadence, seconds")
+    parser.add_argument("--lease-ttl", type=float, default=None,
+                        help="self-fence after this long without a "
+                             "successful renewal (default 3x heartbeat)")
     parser.add_argument("--unit-root", default=None)
     parser.add_argument("--inprocess-units", action="store_true")
     args = parser.parse_args(argv)
@@ -401,6 +489,7 @@ def main(argv: list[str] | None = None) -> None:
         args.name, port=args.port, bind=args.bind,
         inprocess_units=args.inprocess_units,
         unit_root=args.unit_root, announce_dir=args.announce,
+        heartbeat_s=args.heartbeat, lease_ttl_s=args.lease_ttl,
     )
     print(json.dumps({"name": hostd.name, "port": hostd.port,
                       "pid": os.getpid()}), flush=True)

@@ -7,9 +7,19 @@ Two population modes, composable:
   JSON/dicts — the operator hands placement a fixed fleet.
 - **Join-via-announce**: hostds started with ``--announce DIR`` write
   ``DIR/<name>.json`` atomically and re-stamp it every heartbeat;
-  ``HostRegistry(announce_dir=DIR)`` lists every record younger than
-  ``ttl_s`` as live. A host that dies simply stops heartbeating and
-  ages out — no deregistration RPC to lose.
+  ``HostRegistry(announce_dir=DIR)`` lists every record whose content
+  last CHANGED within ``ttl_s`` as live. A host that dies simply stops
+  heartbeating and ages out — no deregistration RPC to lose.
+
+Aging is **receiver-side, on the monotonic clock**: the registry
+remembers when *it* first observed each announce's current content and
+ages from that arrival time. The sender's ``ts`` stamp is display
+metadata only — a hostd with a skewed wall clock (hours behind, or
+stamping from the future) can neither be prematurely expired nor
+immortalized, and an NTP step on the registry's own host cannot mass-
+expire the fleet. This is half of the lease contract
+(:mod:`~hops_tpu.jobs.placement.lease` is the other half): both sides
+measure the same TTL on clocks that only move forward.
 
 The registry answers "who exists"; health ("who answers") is the
 :class:`~hops_tpu.jobs.placement.client.PlacementClient`'s per-host
@@ -27,9 +37,10 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import threading
 import time
 from pathlib import Path
-from typing import Any, Iterable
+from typing import Any, Callable, Iterable
 
 from hops_tpu.runtime.logging import get_logger
 
@@ -69,10 +80,17 @@ class HostRegistry:
         *,
         announce_dir: str | Path | None = None,
         ttl_s: float = 10.0,
+        clock: Callable[[], float] = time.monotonic,
     ):
         self._static: dict[str, Host] = {h.name: h for h in hosts}
         self._announce_dir = Path(announce_dir) if announce_dir else None
         self.ttl_s = float(ttl_s)
+        self._clock = clock  # injectable for clock-skew tests
+        self._obs_lock = threading.Lock()
+        #: announce name → (content fingerprint, arrival on self._clock).
+        #: Arrival-time aging: liveness = "this file's content changed
+        #: within ttl_s of OUR monotonic clock", never the sender's ts.
+        self._seen: dict[str, tuple[str, float]] = {}  # guarded by: self._obs_lock
 
     @classmethod
     def from_config(cls, config: Iterable[dict[str, Any]] | str | Path,
@@ -99,20 +117,42 @@ class HostRegistry:
         d = self._announce_dir
         if d is None or not d.is_dir():
             return []
-        now = time.time()
         live: list[Host] = []
-        for p in sorted(d.glob("*.json")):
-            try:
-                rec = json.loads(p.read_text())
-                if now - float(rec["ts"]) > self.ttl_s:
-                    continue  # stale: the hostd stopped heartbeating
-                live.append(Host(rec["name"], rec["address"], int(rec["port"])))
-            except (OSError, ValueError, KeyError, TypeError):
-                # A half-written or malformed record is skipped, not
-                # fatal: announces are atomic (write+rename) so this is
-                # only ever external corruption, and the next heartbeat
-                # repairs it.
-                log.warning("host registry: unreadable announce %s", p.name)
+        present: set[str] = set()
+        with self._obs_lock:
+            now = self._clock()
+            for p in sorted(d.glob("*.json")):
+                try:
+                    text = p.read_text()
+                    rec = json.loads(text)
+                    # The heartbeat re-stamps ts every announce, so the
+                    # file CONTENT is the fingerprint: new content means
+                    # the hostd is alive and beat recently. We age from
+                    # when WE first saw that content — the sender's ts
+                    # value itself is never compared against a clock.
+                    prev = self._seen.get(p.name)
+                    if prev is None or prev[0] != text:
+                        self._seen[p.name] = (text, now)
+                        arrival = now
+                    else:
+                        arrival = prev[1]
+                    present.add(p.name)
+                    if now - arrival > self.ttl_s:
+                        continue  # stale: the hostd stopped heartbeating
+                    live.append(
+                        Host(rec["name"], rec["address"], int(rec["port"])))
+                except (OSError, ValueError, KeyError, TypeError):
+                    # A half-written or malformed record is skipped, not
+                    # fatal: announces are atomic (write+rename) so this
+                    # is only ever external corruption, and the next
+                    # heartbeat repairs it.
+                    log.warning("host registry: unreadable announce %s", p.name)
+            # Retracted/removed announces must not pin observations: a
+            # host that retracts and later re-announces the same bytes
+            # would otherwise inherit its old arrival time.
+            for name in list(self._seen):
+                if name not in present:
+                    del self._seen[name]
         return live
 
     def hosts(self) -> list[Host]:

@@ -40,7 +40,14 @@ Config (``cfg.json`` for the CLI, a dict for in-process units)::
 
     {"store": "profile", "version": 1, "shard_index": 0, "shards": 4,
      "primary_key": ["uid"], "root": "/data/online", "port": 0,
-     "snapshot": "/data/snaps/profile_1"}        # optional
+     "snapshot": "/data/snaps/profile_1",        # optional
+     "slot": "profile/0", "generation": 2}       # placement identity
+
+A configured ``(slot, generation)`` arms the fencing gate: data verbs
+stamped with an ``X-Hops-Generation`` token that differs from the
+shard's own are refused with a typed 410 (no breaker strike client
+side) — how a zombie shard healing from a partition is kept from
+serving stale rows or absorbing writes after its slot was re-placed.
 """
 
 from __future__ import annotations
@@ -56,11 +63,20 @@ from typing import Any
 import pandas as pd
 
 from hops_tpu.featurestore.online import OnlineStore
-from hops_tpu.runtime import wirecodec
+from hops_tpu.runtime import flight, wirecodec
 from hops_tpu.runtime.httpserver import HTTPServer
 from hops_tpu.runtime.logging import get_logger
+from hops_tpu.telemetry.metrics import REGISTRY
 
 log = get_logger(__name__)
+
+_m_gen_rejected = REGISTRY.counter(
+    "hops_tpu_fleet_generation_rejected_total",
+    "Requests refused with a typed 410 because they stamped a "
+    "generation newer than the unit's own — a superseded zombie "
+    "fenced at the data plane, per unit kind",
+    labels=("kind",),
+)
 
 
 class SnapshotCorruptError(RuntimeError):
@@ -94,6 +110,15 @@ class ShardServer:
                 "shardd codecs must include 'json' (the negotiation "
                 f"fallback): {self.codecs!r}")
         self.label = f"{self.store_name}_{self.version}"
+        # Placement identity (minted by the PlacementClient): compared
+        # against the X-Hops-Generation stamp on every data verb so a
+        # superseded shard — a zombie healed from a partition — refuses
+        # with a typed 410 instead of serving stale rows or taking
+        # writes the live generation will never see.
+        self.slot = cfg.get("slot")
+        self.generation = int(cfg.get("generation", 0))
+        self.token = (f"{self.slot}:{self.generation}"
+                      if self.slot is not None else None)
         root = Path(cfg["root"])
         root.mkdir(parents=True, exist_ok=True)
         self._store = OnlineStore(
@@ -161,7 +186,9 @@ class ShardServer:
             return 200, {"status": "ok", "store": self.label,
                          "shard": self.shard_index,
                          "rows": self._store.count(),
-                         "codecs": list(self.codecs)}
+                         "codecs": list(self.codecs),
+                         "slot": self.slot,
+                         "generation": self.generation}
         if method == "GET" and path == "/stats":
             return 200, {"rows": self._store.count()}
         if method == "GET" and path == "/scan":
@@ -185,6 +212,23 @@ class ShardServer:
 def _make_server(shard: ShardServer, port: int,
                  bind: str = "127.0.0.1") -> HTTPServer:
     def route(method, path, headers, body):
+        # Fencing gate on the data verbs (health/stats stay open — the
+        # reconcile sweep identifies zombies through them): a stamped
+        # generation newer than this shard's own token means the shard
+        # has been superseded; refuse typed so the client degrades
+        # without a breaker strike. See docs/operations.md "Partition
+        # tolerance & fencing".
+        stamped = headers.get("x-hops-generation")
+        if (stamped and shard.token and stamped != shard.token
+                and path.rstrip("/") not in ("/healthz", "/stats")):
+            _m_gen_rejected.inc(kind="shard")
+            flight.record("generation_rejected", unit_kind="shard",
+                          store=shard.label, shard=shard.shard_index,
+                          slot=shard.slot, have=shard.token, got=stamped)
+            data = json.dumps({"error": "superseded generation",
+                               "slot": shard.slot, "have": shard.token,
+                               "got": stamped}).encode()
+            return 410, {"Content-Type": "application/json"}, data
         try:
             payload = json.loads(body or b"{}") if method == "POST" else {}
             status, out = shard.handle(method, path, payload)

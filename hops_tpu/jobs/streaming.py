@@ -116,8 +116,8 @@ class StreamingRunner:
         if self.state != "RUNNING":
             return
         if drain:
-            deadline = time.time() + 5
-            while time.time() < deadline and self._pump_once_safe():
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline and self._pump_once_safe():
                 pass
         self._stop.set()
         self._thread.join(timeout=10)

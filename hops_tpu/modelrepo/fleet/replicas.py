@@ -34,13 +34,12 @@ import subprocess
 import sys
 import threading
 import time
-import urllib.error
-import urllib.request
 from pathlib import Path
 from typing import Any
 
 from hops_tpu.modelrepo import serving
 from hops_tpu.runtime import faultinject, flight, fs
+from hops_tpu.runtime.httpclient import HTTPPool
 from hops_tpu.runtime.logging import get_logger
 from hops_tpu.telemetry.metrics import REGISTRY
 
@@ -114,6 +113,16 @@ class ReplicaManager:
         self._replicas: dict[str, Replica] = {}  # guarded by: self._lock
         self._counter = 0  # guarded by: self._lock
         self._closed = False  # guarded by: self._lock
+        #: Units whose slot was re-placed while their host was
+        #: unreachable (generation already bumped): kept so the
+        #: reconcile sweep can reap the zombie once the partition
+        #: heals instead of leaking the worker forever.
+        self._superseded: list[Any] = []  # guarded by: self._lock
+        # Probes and drains go through a pool rather than raw urllib so
+        # the transport.send fault seam covers them: a partitioned host
+        # must look unreachable to the liveness sweep, not just to the
+        # router's forwards.
+        self._probe_pool = HTTPPool(max_idle_per_host=2, identity="fleet")
         self._publish_states()
 
     # -- bookkeeping ----------------------------------------------------------
@@ -287,7 +296,8 @@ class ReplicaManager:
         survivors when one dies — ``placement.rpc`` faults land
         there). The worker is the same ``serving_host --fleet-worker``
         process; only who spawned it changes."""
-        unit = self.placement.spawn("replica", cfg)
+        unit = self.placement.spawn(
+            "replica", cfg, slot=f"{self.name}/{rep.rid}")
         rep.unit = unit
         rep.host = unit.address
         rep.port = unit.port
@@ -353,18 +363,18 @@ class ReplicaManager:
         if rep is None or rep.port is None:
             return "unreachable", {}
         try:
-            with urllib.request.urlopen(
-                f"http://{rep.host}:{rep.port}/healthz", timeout=2.0
-            ) as resp:
-                return "ok", json.loads(resp.read())
-        except urllib.error.HTTPError as e:
-            try:
-                body = json.loads(e.read())
-            except Exception:  # graftlint: disable=swallowed-exception
-                body = {}  # by contract: a probe never raises past here
-            return body.get("status", "unready"), body
+            code, data, _ = self._probe_pool.request(
+                "GET", f"http://{rep.host}:{rep.port}/healthz",
+                timeout_s=2.0)
         except OSError:
             return "unreachable", {}
+        try:
+            body = json.loads(data)
+        except Exception:  # graftlint: disable=swallowed-exception
+            body = {}  # by contract: a probe never raises past here
+        if code == 200:
+            return "ok", body
+        return body.get("status", "unready"), body
 
     def drain(self, rid: str) -> None:
         """Flip the replica into the draining state: it stops admitting
@@ -389,14 +399,12 @@ class ReplicaManager:
             # Placed replicas drain by the SAME direct POST (the drain
             # is the replica's own admission flip, not a host-lifecycle
             # action) — the hostd only owns spawn/reap/kill.
-            req = urllib.request.Request(
-                f"http://{rep.host}:{rep.port}/admin/drain", data=b"{}",
-                headers={"Content-Type": "application/json"},
-            )
             try:
-                with urllib.request.urlopen(req, timeout=2.0):
-                    pass
-            except (OSError, urllib.error.URLError):
+                self._probe_pool.request(
+                    "POST", f"http://{rep.host}:{rep.port}/admin/drain",
+                    b"{}", {"Content-Type": "application/json"},
+                    timeout_s=2.0)
+            except OSError:
                 log.warning("fleet %s: replica %s unreachable for drain "
                             "(already dead?); treating as draining",
                             self.name, rid)
@@ -488,17 +496,32 @@ class ReplicaManager:
         ready/starting replica; the unreachable ones are marked failed
         and forgotten, so the replica count drops and the autoscaler's
         next tick re-places them on the surviving hosts. Local fleets
-        (no placement client) are a no-op. Returns the failed rids."""
+        (no placement client) are a no-op. Returns the failed rids.
+
+        Fencing: "unreachable" may mean dead — or PARTITIONED, still
+        serving on the far side of a network cut. Before forgetting the
+        unit its slot's generation is bumped, so every router forward
+        from then on stamps a token the old worker cannot match: if the
+        host heals, the zombie answers 410 instead of serving stale
+        results under a retired identity. The unit itself is stashed so
+        the sweep can reap it once the cut heals (see
+        :meth:`_reap_superseded`)."""
         if self.placement is None:
             return []
+        self._reap_superseded()
         failed: list[str] = []
         for rep in self.replicas():
             if rep.unit is None or rep.state not in ("starting", "ready"):
                 continue
             if self._probe(rep)[0] != "unreachable":
                 continue
+            unit = rep.unit
+            if getattr(unit, "slot", None):
+                self.placement.bump_generation(unit.slot)
+                with self._lock:
+                    self._superseded.append(unit)
             rep.state = "failed"
-            rep.unit = None  # its host is gone; nothing left to reap
+            rep.unit = None  # fenced above; the zombie sweep owns the reap
             flight.record("replica_state", model=self.name,
                           rid=rep.rid, state="failed", how="reconcile")
             self._forget(rep.rid)
@@ -509,6 +532,31 @@ class ReplicaManager:
         if failed:
             self._publish_states()
         return failed
+
+    def _reap_superseded(self) -> None:
+        """Reap zombies: units whose slot was re-placed while their host
+        was unreachable. A reap that still cannot get through (cut not
+        healed, or the hostd's breaker is open) keeps the unit queued
+        for the next sweep; a reap that lands — or a host that was
+        truly dead, where the hostd answers "already stopped" — drops
+        it. Bounded: each sweep tries each zombie once."""
+        with self._lock:
+            pending = list(self._superseded)
+        for unit in pending:
+            try:
+                self.placement.reap(unit)
+            except Exception as e:  # noqa: BLE001 — partition still up or
+                # breaker open; keep the zombie queued for the next sweep
+                log.info("fleet %s: zombie %s on %s not reapable yet: %s",
+                         self.name, unit.uid, unit.host.name, e)
+                continue
+            with self._lock:
+                if unit in self._superseded:
+                    self._superseded.remove(unit)
+            flight.record("replica_state", model=self.name,
+                          rid=unit.uid, state="stopped", how="zombie_reap")
+            log.info("fleet %s: zombie %s on %s reaped after heal",
+                     self.name, unit.uid, unit.host.name)
 
     def commit_version(self, version: int | None) -> None:
         """Persist a completed rollout's version into the serving
@@ -540,3 +588,6 @@ class ReplicaManager:
             self._closed = True
         for rep in self.replicas():
             self.reap(rep.rid)
+        if self.placement is not None:
+            self._reap_superseded()
+        self._probe_pool.close()

@@ -611,7 +611,7 @@ class Router:
         #: Keep-alive connection pool for every router->replica hop
         #: (forwards, hedges, scrapes, shadow probes): a hedge must not
         #: pay a fresh handshake on top of the latency it is rescuing.
-        self.pool = HTTPPool()
+        self.pool = HTTPPool(identity="router")
         # Worker pools for raced attempts (a thread per forward would
         # be creation churn at request rate; lazily built because
         # un-hedged routers never race attempts). Hedges get their OWN
@@ -1152,14 +1152,18 @@ class Router:
     #
     # Outcome kinds: "ok" = terminal, relay to the client (2xx and
     # plain 4xx alike); "fail" = answered but retryable (shed-503/429,
-    # replica 5xx) — remembered as `last`, retried elsewhere;
+    # replica 5xx, superseded-generation 410) — remembered as `last`,
+    # retried elsewhere;
     # "transport" = never answered, retried with nothing client-visible.
 
     @staticmethod
     def _classify(code: int) -> str:
         if code < 400:
             return "ok"
-        if code in (429, 503) or code >= 500:
+        if code in (410, 429, 503) or code >= 500:
+            # 410 is the fencing refusal: a superseded-generation unit
+            # (zombie healed from a partition) typed-rejected the
+            # forward — answer is per-replica, so retry elsewhere.
             return "fail"
         return "ok"  # other 4xx: the client's request is bad everywhere
 
@@ -1168,6 +1172,16 @@ class Router:
         attempt — abandoned hedge losers never reach this."""
         if code < 400:
             view.breaker.record_success()
+        elif code == 410:
+            # Superseded-generation refusal: placement identity, not
+            # replica health — the unit is a fenced zombie doing
+            # exactly its job. No breaker strike; the route loop
+            # retries on the live generation, and reconcile() reaps
+            # the zombie.
+            _m_retries.inc(model=self.name, reason="generation")
+            flight.record("retry", op="router.forward",
+                          reason="generation", replica=view.rid,
+                          model=self.name)
         elif code in (429, 503):
             # Shedding/draining: load, not failure. Don't strike the
             # breaker; the route loop tries a less-loaded replica.
@@ -1212,7 +1226,8 @@ class Router:
                 except Exception as e:
                     raise urllib.error.URLError(e) from e
                 code, payload, headers = self._forward(
-                    self._rep_host(rep), rep.port, body, extra_headers)
+                    self._rep_host(rep), rep.port, body,
+                    self._stamp_generation(rep, extra_headers))
                 fspan.annotate(status=code)
         except (OSError, urllib.error.URLError) as e:
             # Transport failure: the replica is gone or wedged —
@@ -1291,7 +1306,7 @@ class Router:
                                 raise urllib.error.URLError(e) from e
                             code, payload, headers = self._forward(
                                 self._rep_host(rep), rep.port, body,
-                                extra_headers)
+                                self._stamp_generation(rep, extra_headers))
                             fspan.annotate(status=code)
                     except (OSError, urllib.error.URLError) as e:
                         err = e
@@ -1346,6 +1361,24 @@ class Router:
                     thread_name_prefix=f"fleet-attempt-{self.name}",
                 )
             return self._attempt_pool
+
+    def _stamp_generation(
+        self, rep: Any, extra_headers: dict[str, str] | None,
+    ) -> dict[str, str] | None:
+        """Fencing stamp (docs/operations.md "Partition tolerance &
+        fencing"): forwards to a PLACED replica carry its slot's
+        CURRENT generation — deliberately the placement client's live
+        counter, not the unit snapshot, so once reconcile() bumps the
+        slot every forward that still reaches the old unit presents
+        the newer token and the zombie typed-rejects it (410)."""
+        unit = getattr(rep, "unit", None)
+        placement = getattr(self.manager, "placement", None)
+        if (unit is None or placement is None
+                or getattr(unit, "slot", None) is None):
+            return extra_headers
+        gen = placement.current_generation(unit.slot)
+        return {**(extra_headers or {}),
+                "X-Hops-Generation": f"{unit.slot}:{gen}"}
 
     def _forward(
         self, host: str, port: int, body: bytes,

@@ -758,6 +758,18 @@ class _RunningServing:
             "first under load or evicted from the batch queue)",
             labels=("model", "reason"),
         )
+        m_gen_rejected = REGISTRY.counter(
+            "hops_tpu_fleet_generation_rejected_total",
+            "Requests refused with a typed 410 because they stamped a "
+            "generation newer than the unit's own — a superseded zombie "
+            "fenced at the data plane, per unit kind",
+            labels=("kind",),
+        )
+        # Placement identity (minted by the PlacementClient, carried in
+        # cfg): this unit's own (slot, generation) token, compared
+        # against the X-Hops-Generation stamp on every predict.
+        unit_token = (f"{cfg['slot']}:{int(cfg.get('generation', 0))}"
+                      if cfg.get("slot") else None)
         running = self
         breaker = self.breaker
 
@@ -982,6 +994,22 @@ class _RunningServing:
             # /junk/v1/models/<name>:predict.
             if path.rstrip("/") != f"/v1/models/{name}:predict":
                 return _json(404, {"error": f"unknown path {path}"})
+            # Fencing gate (docs/operations.md "Partition tolerance &
+            # fencing"): forwarders stamp the slot's CURRENT generation
+            # on X-Hops-Generation; a mismatch means THIS unit has been
+            # superseded (re-placed while it was partitioned) and must
+            # refuse — typed 410, which the router retries on the live
+            # generation without a breaker strike. Checked before
+            # admission/parse: a zombie must not even shed or predict.
+            stamped = headers.get("X-Hops-Generation")
+            if stamped and unit_token and stamped != unit_token:
+                m_gen_rejected.inc(kind="replica")
+                flight.record("generation_rejected", unit_kind="replica",
+                              model=name, slot=cfg.get("slot"),
+                              have=unit_token, got=stamped)
+                return _json(410, {"error": "superseded generation",
+                                   "slot": cfg.get("slot"),
+                                   "have": unit_token, "got": stamped})
             # Content-Type negotiation: the packed columnar frame
             # decodes zero-copy into the instance tensor; JSON stays
             # the default. A malformed frame fails closed with a 400

@@ -20,14 +20,26 @@ Grammar: ``point=mode[:arg][@key=val,...]`` joined by ``;``.
 Modes: ``error[:ExcName]`` raises (builtin exception, default
 ``RuntimeError``), ``latency:seconds`` sleeps, ``corrupt`` asks the
 fault point to damage its payload (bytes) or artifact (files) — points
-that have nothing to damage ignore it. Keys: ``p`` (probability,
-default 1), ``times`` (max firings, default unlimited), ``after``
-(passages to skip first, default 0), ``seed``, and ``key`` — a
-discriminator matched against the value the fault point passes to
+that have nothing to damage ignore it, and ``partition`` black-holes
+the passage (raises ``ConnectionError``, records a ``partition``
+flight event — the network-partition simulator, normally armed at the
+``transport.send`` point via :func:`cut`/:func:`heal`). Keys: ``p``
+(probability, default 1), ``times`` (max firings, default unlimited),
+``after`` (passages to skip first, default 0), ``seed``, and ``key`` —
+a discriminator matched against the value the fault point passes to
 ``fire(point, key=...)``, so a fault can target ONE replica port or
 ONE feature shard out of many sharing a process (gray failures are
 per-component by nature; a keyed spec counts passages only for its
 key, keeping replay deterministic per component).
+
+Partitions are **directional**: ``transport.send`` evaluates a send
+from ``src`` to ``dst`` against three keys — ``dst`` (anything → dst),
+``src->dst`` (that edge only) and ``src->*`` (src's whole egress) — so
+asymmetric cuts (A→B delivered while B→A is black-holed) are one keyed
+clause each. ``dst`` is the logical host name when the endpoint was
+registered via :func:`name_endpoint` (hostd names its own agent port
+and every unit it spawns), else the raw ``host:port``. See
+docs/operations.md "Partition tolerance & fencing".
 
 Determinism: each spec keeps a passage counter; probabilistic firing
 draws from ``random.Random((seed, point, passage))`` — a plan replays
@@ -86,6 +98,14 @@ docs/operations.md "Failure handling & fault injection"):
                         the hostd dispatcher. The per-host breaker
                         ejects the partitioned host; spawns re-place
                         on survivors
+``transport.send``      every ``HTTPPool`` exchange, evaluated by
+                        :func:`fire_transport` against the directional
+                        keys above before any bytes move — the network
+                        fabric itself. ``partition`` black-holes the
+                        send (the classic cut), ``latency`` models a
+                        slow link. Also fired by hostd's heartbeat
+                        announce (``dst=registry``) so a cut host's
+                        lease expires and it self-fences
 ==================  ========================================================
 """
 
@@ -129,9 +149,10 @@ POINTS = (
     "placement.rpc",
     "serving.start",
     "workload.publish",
+    "transport.send",
 )
 
-_MODES = ("error", "latency", "corrupt")
+_MODES = ("error", "latency", "corrupt", "partition")
 
 _m_injected = REGISTRY.counter(
     "hops_tpu_faults_injected_total",
@@ -185,6 +206,9 @@ class FaultSpec:
             except (TypeError, ValueError):
                 raise FaultPlanError(
                     f"latency mode needs seconds, got {self.arg!r}") from None
+        elif self.mode == "partition" and self.arg is not None:
+            raise FaultPlanError(
+                f"partition mode takes no argument, got {self.arg!r}")
         if not 0.0 <= self.probability <= 1.0:
             raise FaultPlanError(f"probability must be in [0,1], got "
                                  f"{self.probability}")
@@ -256,26 +280,60 @@ class FaultPlan:
             raise FaultPlanError(f"no fault specs in {text!r}")
         return cls(specs)
 
-    def evaluate(self, point: str, key: str | None = None) -> list[FaultSpec]:
+    def evaluate(self, point: str, key: str | None = None, *,
+                 keyed_only: bool = False) -> list[FaultSpec]:
         """The specs that fire on this passage of ``point``. A keyed
         spec sees (and counts) only passages carrying its key, so its
         ``times``/``after``/``p`` schedule replays deterministically
-        per component regardless of how other keys interleave."""
-        specs = self._by_point.get(point)
-        if not specs:
-            return []
+        per component regardless of how other keys interleave.
+        ``keyed_only`` skips key-less specs — :func:`fire_transport`
+        evaluates several directional keys per send and must count an
+        unkeyed spec's passage exactly once."""
         with self._lock:
+            specs = self._by_point.get(point)
+            if not specs:
+                return []
             return [
                 s for s in specs
-                if (s.key is None or s.key == key) and s._should_fire()
+                if (s.key == key if keyed_only or s.key is not None else True)
+                and s._should_fire()
             ]
 
+    def add(self, spec: FaultSpec) -> None:
+        """Arm one more spec in a live plan (:func:`cut` uses this to
+        open partitions mid-run without disturbing armed schedules)."""
+        with self._lock:
+            self._by_point.setdefault(spec.point, []).append(spec)
+
+    def remove(self, *, point: str | None = None, mode: str | None = None,
+               key: str | None = None) -> int:
+        """Drop armed specs matching every given filter; returns the
+        count removed (:func:`heal` closes partitions with this)."""
+        removed = 0
+        with self._lock:
+            for pt in list(self._by_point):
+                if point is not None and pt != point:
+                    continue
+                keep = [
+                    s for s in self._by_point[pt]
+                    if not ((mode is None or s.mode == mode)
+                            and (key is None or s.key == key))
+                ]
+                removed += len(self._by_point[pt]) - len(keep)
+                if keep:
+                    self._by_point[pt] = keep
+                else:
+                    del self._by_point[pt]
+        return removed
+
     def describe(self) -> str:
-        return "; ".join(
-            f"{s.point}={s.mode}"
-            + (f":{getattr(s.arg, '__name__', s.arg)}" if s.arg is not None else "")
-            for specs in self._by_point.values() for s in specs
-        )
+        with self._lock:
+            return "; ".join(
+                f"{s.point}={s.mode}"
+                + (f":{getattr(s.arg, '__name__', s.arg)}" if s.arg is not None else "")
+                + (f"@key={s.key}" if s.key is not None else "")
+                for specs in self._by_point.values() for s in specs
+            )
 
 
 #: The armed plan. ``None`` = disarmed: :func:`fire` is a single
@@ -312,15 +370,21 @@ def arm_from_env(environ: dict | None = None) -> FaultPlan | None:
     return arm(text)
 
 
-def _apply(spec: FaultSpec, point: str) -> bool:
-    """Execute one fired spec; returns True when it was ``corrupt``."""
+def _apply(spec: FaultSpec, point: str, **info: Any) -> bool:
+    """Execute one fired spec; returns True when it was ``corrupt``.
+    ``info`` rides into the flight event (``src``/``dst`` for
+    transport passages)."""
     _m_injected.inc(point=point, mode=spec.mode)
     # The black box + the causal thread: a fired fault lands in the
     # flight recorder and annotates whatever request trace it fired
     # under, so post-incident the injected failure, the retry it
     # provoked, and the breaker it tripped read in one sequence.
-    flight.record("fault_fired", point=point, mode=spec.mode)
-    tracing.add_event("fault_fired", point=point, mode=spec.mode)
+    # Partitions get their own flight kind: a chaos drill's timeline
+    # (cut → fence → re-place → heal → generation_rejected) must read
+    # from the recorder without grepping generic fault noise.
+    kind = "partition" if spec.mode == "partition" else "fault_fired"
+    flight.record(kind, point=point, mode=spec.mode, **info)
+    tracing.add_event(kind, point=point, mode=spec.mode, **info)
     if spec.mode == "latency":
         log.warning("faultinject: %s sleeping %.3fs", point, spec.arg)
         time.sleep(spec.arg)
@@ -328,6 +392,11 @@ def _apply(spec: FaultSpec, point: str) -> bool:
     if spec.mode == "error":
         log.warning("faultinject: %s raising %s", point, spec.arg.__name__)
         raise spec.arg(f"faultinject: injected {spec.arg.__name__} at {point}")
+    if spec.mode == "partition":
+        where = (f"{info.get('src')}->{info.get('dst')}"
+                 if "dst" in info else point)
+        log.warning("faultinject: partition black-holed %s", where)
+        raise ConnectionError(f"faultinject: partition at {where} (black-holed)")
     log.warning("faultinject: %s corrupt trigger", point)
     return True
 
@@ -354,6 +423,89 @@ def fire_data(point: str, data: bytes) -> bytes:
     if fire(point):
         return _corrupt_bytes(data)
     return data
+
+
+# ---------------------------------------------------------------- partitions
+#
+# The network-partition simulator. HTTPPool calls fire_transport()
+# before every exchange; a ``partition`` spec at ``transport.send``
+# black-holes matching sends with ConnectionError — exactly what a
+# dropped SYN looks like to the caller, so every breaker/retry/hedge
+# path exercises its real partition behavior. Cuts are directional
+# (see the module docstring) and deterministic: FaultSpec's
+# seed/p/times/after schedule applies per key.
+
+_endpoints_lock = threading.Lock()
+#: ``"host:port"`` → logical name, so chaos plans address hosts by the
+#: names operators know (``key=h1``), not ephemeral ports.
+_ENDPOINTS: dict[str, str] = {}
+
+
+def name_endpoint(hostport: str, name: str) -> None:
+    """Register ``host:port`` under a logical host name for partition
+    keying. Hostd registers its agent port and every unit it spawns,
+    so ``cut("h1")`` severs the whole host — agent and units alike."""
+    with _endpoints_lock:
+        _ENDPOINTS[hostport] = name
+
+
+def endpoint_name(hostport: str) -> str:
+    """The logical name for ``host:port`` (itself when unregistered)."""
+    with _endpoints_lock:
+        return _ENDPOINTS.get(hostport, hostport)
+
+
+def fire_transport(src: str, dst: str) -> None:
+    """Transport fault point: evaluate one send from the pool named
+    ``src`` to endpoint ``dst`` (``host:port`` or a logical name).
+    Matches specs keyed ``dst``, ``src->dst`` and ``src->*`` — plus
+    unkeyed ``transport.send`` specs, counted exactly once per send.
+    Raises ``ConnectionError`` on a fired partition; disarmed it is
+    one attribute load + ``is None`` test."""
+    plan = _PLAN
+    if plan is None:
+        return
+    dname = endpoint_name(dst)
+    fired = plan.evaluate("transport.send", key=dname)
+    for key in (f"{src}->{dname}", f"{src}->*"):
+        fired += plan.evaluate("transport.send", key=key, keyed_only=True)
+    for spec in fired:
+        _apply(spec, "transport.send", src=src, dst=dname)
+
+
+def cut(key: str, *, probability: float = 1.0, times: int | None = None,
+        after: int = 0, seed: int = 0) -> FaultSpec:
+    """Open a partition: black-hole ``transport.send`` passages
+    matching ``key`` (a destination name, ``src->dst`` edge, or
+    ``src->*`` egress). Arms an empty plan if none is armed; adds to
+    the live plan otherwise. Returns the armed spec; close the cut
+    with :func:`heal`."""
+    global _PLAN
+    spec = FaultSpec(point="transport.send", mode="partition",
+                     probability=probability, times=times, after=after,
+                     seed=seed, key=key)
+    plan = _PLAN
+    if plan is None:
+        plan = _PLAN = FaultPlan([])
+    plan.add(spec)
+    flight.record("partition", action="cut", key=key)
+    log.warning("faultinject: partition CUT %s", key)
+    return spec
+
+
+def heal(key: str | None = None) -> int:
+    """Close partitions: remove armed ``partition`` specs at
+    ``transport.send`` matching ``key`` (all of them when None).
+    Returns the number healed."""
+    plan = _PLAN
+    if plan is None:
+        return 0
+    healed = plan.remove(point="transport.send", mode="partition", key=key)
+    if healed:
+        flight.record("partition", action="heal", key=key or "*")
+        log.warning("faultinject: partition HEALED %s (%d cut%s)",
+                    key or "*", healed, "s" if healed != 1 else "")
+    return healed
 
 
 def _corrupt_bytes(data: bytes) -> bytes:

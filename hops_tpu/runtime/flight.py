@@ -21,7 +21,8 @@ Event kinds are a closed, documented catalog — docs/operations.md
 kinds: ``fault_fired``, ``retry``, ``giveup``, ``deadline_exceeded``,
 ``breaker_transition``, ``drain``, ``quarantine``, ``preemption``,
 ``recovery``, ``replica_state``, ``rollout``, ``dispatch_failure``,
-``span_replayed``, ``eval_gate``, ``cutover``, ``crash``.
+``span_replayed``, ``eval_gate``, ``cutover``, ``crash``,
+``partition``, ``fence``, ``generation``, ``generation_rejected``.
 
 Stdlib-only (this is imported by the same hot paths ``faultinject``
 rides); the trace-id peek goes through ``telemetry.tracing``, which is

@@ -33,6 +33,12 @@ Semantics the callers rely on:
 The server side of the bargain: the router and serving handlers declare
 ``protocol_version = "HTTP/1.1"`` and always send Content-Length, so
 connections actually survive between requests.
+
+Every exchange first passes the ``transport.send`` fault point
+(:func:`faultinject.fire_transport`) under the pool's ``identity`` as
+the source — the seam where the deterministic network-partition
+simulator cuts links (docs/operations.md "Partition tolerance &
+fencing"). Disarmed, that is one ``is None`` test.
 """
 
 from __future__ import annotations
@@ -43,6 +49,7 @@ import threading
 from typing import Any, Mapping
 from urllib.parse import urlsplit
 
+from hops_tpu.runtime import faultinject
 from hops_tpu.runtime.logging import get_logger
 
 log = get_logger(__name__)
@@ -51,10 +58,15 @@ log = get_logger(__name__)
 class HTTPPool:
     """Persistent-connection pool; one instance per client (the router
     owns one). ``max_idle_per_host`` bounds parked connections per
-    ``(host, port)`` — extras close instead of parking."""
+    ``(host, port)`` — extras close instead of parking. ``identity``
+    names this pool as the SOURCE side of partition keys
+    (``src->dst``); give each logical client its own so asymmetric
+    cuts can tell the router from a hostd from a bench client."""
 
-    def __init__(self, max_idle_per_host: int = 8):
+    def __init__(self, max_idle_per_host: int = 8, *,
+                 identity: str = "client"):
         self.max_idle_per_host = max_idle_per_host
+        self.identity = identity
         self._lock = threading.Lock()
         self._idle: dict[tuple[str, int], list[http.client.HTTPConnection]] = {}  # guarded by: self._lock
         self._closed = False  # guarded by: self._lock
@@ -108,6 +120,7 @@ class HTTPPool:
         path = parts.path or "/"
         if parts.query:
             path = f"{path}?{parts.query}"
+        faultinject.fire_transport(self.identity, f"{host}:{port}")
         last_exc: Exception | None = None
         for fresh_retry in (False, True):
             conn, reused = self._checkout(host, port, timeout_s)
@@ -204,6 +217,7 @@ class HTTPPool:
             wire += "".join(lines).encode("latin-1")
             if body:
                 wire += body
+        faultinject.fire_transport(self.identity, f"{host}:{port}")
         conn, reused = self._checkout(host, port, timeout_s)
         try:
             if conn.sock is None:

@@ -242,7 +242,7 @@ class TrialDriver:
         pending: dict[cf.Future, str] = {}
         free_groups = list(self.device_groups)
         leased: dict[str, tuple[Any, ...]] = {}
-        self._last_sweep = time.time()
+        self._last_sweep = time.monotonic()
         try:
             with cf.ThreadPoolExecutor(max_workers=self.max_parallel) as pool:
                 while True:
@@ -330,9 +330,9 @@ class TrialDriver:
         return final_path, summary
 
     def _early_stop_sweep(self) -> None:
-        if time.time() - self._last_sweep < self.es_interval:
+        if time.monotonic() - self._last_sweep < self.es_interval:
             return
-        self._last_sweep = time.time()
+        self._last_sweep = time.monotonic()
         with self._lock:
             finals = list(self._finished_finals)
             for rep in self._reporters.values():

@@ -1149,6 +1149,7 @@ def test_cli_list_rules_names_all_six(capsys):
     for rule in (
         "jit-purity", "use-after-donation", "host-sync-in-loop",
         "lock-discipline", "metric-name-consistency", "swallowed-exception",
+        "wall-clock-deadline",
     ):
         assert rule in out
 
@@ -2002,3 +2003,85 @@ def test_group_stale_orders_by_count_then_name():
     ]
     grouped = baseline_mod.group_stale(stale)
     assert [(r, len(es)) for r, es in grouped] == [("a", 2), ("b", 1), ("c", 1)]
+
+
+# -- wall-clock-deadline ------------------------------------------------------
+
+
+def test_wall_clock_deadline_flags_compares_and_add_mints(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import time
+
+        def wait(timeout_s):
+            deadline = time.time() + timeout_s
+            while time.time() < deadline:
+                pass
+
+        def expired(start, ttl):
+            return time.time() - start > ttl
+        """,
+        rule="wall-clock-deadline",
+    )
+    assert rule_names(findings) == ["wall-clock-deadline"] * 3
+    assert all("monotonic" in f.message for f in findings)
+
+
+def test_wall_clock_deadline_must_not_flag_timestamps_or_monotonic(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import time
+
+        def stamp(rec):
+            # Display/storage timestamps are what time.time() is FOR.
+            rec["started_at"] = time.time()
+            log.info("done in %.1fs", time.time() - rec["started_at"])
+            return rec
+
+        def wait(timeout_s):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                pass
+
+        def approx(probe, t0):
+            # time.time() buried in another call's argument list:
+            # comparing that call's RESULT is not a wall-clock compare.
+            assert probe(time.time() - t0, abs=2.0) is None
+        """,
+        rule="wall-clock-deadline",
+    )
+    assert findings == []
+
+
+def test_wall_clock_deadline_inline_pragma(tmp_path):
+    findings = lint_code(
+        tmp_path,
+        """
+        import time
+
+        def mtime_age_ok(path, ttl):
+            # st_mtime IS wall clock: same-timeline compare, on purpose.
+            return time.time() - path.stat().st_mtime > ttl  # graftlint: disable=wall-clock-deadline
+
+        def mtime_age_bad(path, ttl):
+            return time.time() - path.stat().st_mtime > ttl
+        """,
+        rule="wall-clock-deadline",
+    )
+    assert len(findings) == 1
+    assert "mtime_age_bad" in (findings[0].symbol or "")
+
+
+def test_wall_clock_deadline_tree_is_clean():
+    """Every deadline/elapsed computation the package ships runs on
+    time.monotonic() — zero findings, no baseline entries (the one
+    sanctioned wall-vs-mtime compare carries an inline disable)."""
+    from hops_tpu.analysis.cli import default_target, lint_root
+
+    pkg = default_target()
+    root = lint_root([pkg])
+    rules = [r for r in engine.all_rules() if r.name == "wall-clock-deadline"]
+    findings = engine.run([pkg], root=root, rules=rules)
+    assert findings == [], "\n".join(f.render() for f in findings)

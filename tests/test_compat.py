@@ -168,9 +168,9 @@ def test_beam_runner_cells(tmp_path):
     beam.start_runner("fl")
     try:
         import time
-        deadline = time.time() + 10
+        deadline = time.monotonic() + 10
         sink = tmp_path / "sink"
-        while time.time() < deadline and not list(sink.glob("part-*.parquet")):
+        while time.monotonic() < deadline and not list(sink.glob("part-*.parquet")):
             time.sleep(0.05)
     finally:
         runner.stop()  # drains before stopping

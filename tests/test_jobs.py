@@ -151,8 +151,8 @@ def test_streaming_runner_checkpointed_sink():
     prod.flush()
     runner = streaming.create_runner("sink1", "events", poll_interval_s=0.02)
     streaming.start_runner("sink1")
-    deadline = time.time() + 10
-    while time.time() < deadline and len(runner.read_sink()) < 5:
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(runner.read_sink()) < 5:
         time.sleep(0.05)
     streaming.stop_runner("sink1")
     df = runner.read_sink()
@@ -164,8 +164,8 @@ def test_streaming_runner_checkpointed_sink():
     prod.flush()
     runner2 = streaming.StreamingRunner("sink1", "events", sink_dir=str(runner.sink_dir), poll_interval_s=0.02)
     runner2.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and len(runner2.read_sink()) < 8:
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(runner2.read_sink()) < 8:
         time.sleep(0.05)
     runner2.stop()
     df = runner2.read_sink()

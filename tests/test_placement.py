@@ -150,22 +150,59 @@ class TestHostRegistry:
 
     def test_announce_join_ttl_ageout_and_retract(self, tmp_path):
         d = tmp_path / "announce"
-        reg = placement.HostRegistry(announce_dir=d, ttl_s=5.0)
+        now = [0.0]
+        reg = placement.HostRegistry(announce_dir=d, ttl_s=5.0,
+                                     clock=lambda: now[0])
         assert reg.hosts() == []
         a = placement.Hostd("ann0", inprocess_units=True, announce_dir=d,
                             unit_root=tmp_path / "u")
         try:
             assert [h.name for h in reg.hosts()] == ["ann0"]
             assert reg.get("ann0").port == a.port
-            # A record past its TTL is a dead host: aged out, not listed.
-            stale = json.loads((d / "ann0.json").read_text())
-            stale["ts"] -= 60.0
-            (d / "ann0.json").write_text(json.dumps(stale))
-            assert reg.hosts() == []
         finally:
             a.stop()
         # Clean shutdown retracts the announce entirely.
         assert not (d / "ann0.json").exists()
+        assert reg.hosts() == []
+        # A crashed host never retracts — it just goes silent: its
+        # record stops changing and ages out ttl_s after the registry
+        # last observed fresh content (receiver-side arrival aging).
+        placement.HostRegistry.announce(
+            d, placement.Host("dead", "127.0.0.1", 7070))
+        assert [h.name for h in reg.hosts()] == ["dead"]
+        now[0] += 5.1
+        assert reg.hosts() == []
+        # A re-announce (fresh content) rejoins immediately.
+        placement.HostRegistry.announce(
+            d, placement.Host("dead", "127.0.0.1", 7070))
+        assert [h.name for h in reg.hosts()] == ["dead"]
+
+    def test_announce_aging_by_arrival_not_sender_ts(self, tmp_path):
+        """The sender's ``ts`` stamp is display metadata: a hostd with a
+        wall clock hours behind (or ahead) must neither be prematurely
+        expired nor immortalized — liveness is 'the content changed
+        within ttl_s of OUR monotonic clock'."""
+        d = tmp_path / "announce"
+        now = [100.0]
+        reg = placement.HostRegistry(announce_dir=d, ttl_s=5.0,
+                                     clock=lambda: now[0])
+        placement.HostRegistry.announce(
+            d, placement.Host("skew", "127.0.0.1", 7070))
+        p = d / "skew.json"
+        rec = json.loads(p.read_text())
+        # An hour behind: sender-clock aging would call this long dead.
+        rec["ts"] -= 3600.0
+        p.write_text(json.dumps(rec))
+        assert [h.name for h in reg.hosts()] == ["skew"]
+        # Two hours ahead: sender-clock aging would immortalize it.
+        rec["ts"] += 7200.0
+        p.write_text(json.dumps(rec))
+        assert [h.name for h in reg.hosts()] == ["skew"]
+        # Unchanged content + our clock advancing is the ONLY age-out.
+        now[0] += 4.9
+        assert [h.name for h in reg.hosts()] == ["skew"]
+        now[0] += 0.2
+        assert reg.hosts() == []
 
     def test_static_and_announce_compose(self, tmp_path):
         d = tmp_path / "announce"
@@ -175,6 +212,49 @@ class TestHostRegistry:
             hosts=[placement.Host("fixed", "127.0.0.1", 7070)],
             announce_dir=d)
         assert [h.name for h in reg.hosts()] == ["fixed", "live"]
+
+
+# -- the lease (hostd's suicide pact) -----------------------------------------
+
+
+class TestLease:
+    def test_expiry_fence_latch_and_rejoin(self):
+        now = [0.0]
+        lease = placement.Lease("h0", 1.0, clock=lambda: now[0])
+        # Construction is the first grant.
+        assert not lease.expired()
+        assert lease.remaining_s() == pytest.approx(1.0)
+        now[0] = 0.5
+        lease.renew()
+        now[0] = 1.4  # 0.9s since renewal: still granted
+        assert not lease.expired()
+        lease.renewal_failed()  # a failed announce does not extend it
+        now[0] = 1.6
+        assert lease.expired() and lease.remaining_s() < 0
+        # The fence decision latches exactly once per expiry episode.
+        assert lease.mark_fenced() is True
+        assert lease.mark_fenced() is False
+        assert lease.fenced
+        # The renewal after a heal un-latches: host rejoins (empty).
+        lease.renew()
+        assert not lease.fenced and not lease.expired()
+        now[0] = 2.7
+        assert lease.expired() and lease.mark_fenced() is True
+
+    def test_wall_clock_step_is_invisible(self, monkeypatch):
+        """The lease measures on time.monotonic: an NTP step — hours
+        forward or back — can neither fire a spurious fence nor hold
+        one open."""
+        lease = placement.Lease("h1", 60.0)
+        monkeypatch.setattr(time, "time", lambda: 1e12)  # step forward
+        assert not lease.expired()
+        assert lease.remaining_s() == pytest.approx(60.0, abs=1.0)
+        monkeypatch.setattr(time, "time", lambda: 0.0)  # step back
+        assert not lease.expired()
+
+    def test_invalid_ttl_rejected(self):
+        with pytest.raises(ValueError, match="ttl"):
+            placement.Lease("h", 0.0)
 
 
 # -- hostd verbs over the real HTTP surface -----------------------------------
